@@ -1,0 +1,52 @@
+"""incubator_mxnet_tpu — a TPU-native deep-learning framework with the
+capabilities of Apache MXNet 1.x (reference: zixuanweeei/incubator-mxnet).
+
+Conventional import:  ``import incubator_mxnet_tpu as mx``
+
+The compute path is jax/XLA (Pallas for hot kernels); the surrounding
+runtime (dispatch, RNG facade, IO, profiling) re-creates the reference's
+user surface: mx.nd, mx.autograd, mx.gluon, mx.optimizer, mx.kvstore …
+See SURVEY.md at the repo root for the layer-by-layer mapping.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError, MXTPUError
+from .context import (Context, cpu, gpu, tpu, cpu_pinned, cpu_shared,
+                      current_context, num_gpus, num_tpus)
+from . import engine
+from . import random
+from . import autograd
+from . import ndarray
+from . import ndarray as nd            # canonical alias mx.nd
+from .ndarray import NDArray
+
+from . import initializer
+from . import init                     # alias namespace
+from . import optimizer
+from .optimizer import Optimizer
+from . import lr_scheduler
+from . import metric
+from . import gluon
+from . import kvstore as kv
+from . import kvstore
+from . import io
+from . import image
+from . import profiler
+from . import runtime
+from . import test_utils
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import module as mod
+from . import module
+from . import parallel
+from .util import is_np_array, set_np, reset_np
+
+__all__ = ["MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",
+           "nd", "ndarray", "NDArray", "autograd", "engine", "random",
+           "gluon", "optimizer", "Optimizer", "metric", "initializer",
+           "kvstore", "kv", "io", "image", "profiler", "runtime",
+           "test_utils", "symbol", "sym", "Symbol", "module", "mod",
+           "parallel", "__version__"]

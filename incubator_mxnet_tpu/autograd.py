@@ -1,0 +1,299 @@
+"""Imperative autograd.
+
+TPU-native re-design of the reference autograd
+(ref: src/imperative/imperative.cc — Imperative::RecordOp/Backward, the
+nnvm tape over AGInfo nodes; python/mxnet/autograd.py — record/pause/
+train_mode/backward/grad).
+
+Design: instead of building an nnvm graph and running a `Gradient` pass,
+every recorded op captures a **jax.vjp pullback** at forward time (the
+residuals play the role of the reference's saved forward buffers).
+`backward()` walks the Python-level tape in reverse topological order and
+applies pullbacks; each pullback executes as XLA computations, and for
+hybridized blocks the whole block is ONE pullback whose transpose is a
+single compiled executable (ref CachedOp::Backward equivalence).
+
+Thread-local `is_recording`/`is_training` flags mirror the reference's
+(`Imperative::is_recording_`/`is_np_shape_` TLS).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "backward", "grad", "mark_variables",
+           "set_recording", "set_training", "get_symbol"]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_STATE = _State()
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+def set_recording(flag: bool) -> bool:
+    prev = _STATE.recording
+    _STATE.recording = bool(flag)
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    prev = _STATE.training
+    _STATE.training = bool(flag)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec, self._train = recording, training
+        self._prev_rec = self._prev_train = None
+
+    def __enter__(self):
+        if self._rec is not None:
+            self._prev_rec = set_recording(self._rec)
+        if self._train is not None:
+            self._prev_train = set_training(self._train)
+        return self
+
+    def __exit__(self, *exc):
+        if self._rec is not None:
+            set_recording(self._prev_rec)
+        if self._train is not None:
+            set_training(self._prev_train)
+
+
+def record(train_mode: bool = True):
+    """`with autograd.record():` — turn on recording + training mode."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# tape
+# ---------------------------------------------------------------------------
+
+
+class Node:
+    """One recorded op application (ref: nnvm node + AGInfo).
+
+    Holds the vjp pullback (with residuals), references to input NDArrays
+    (for graph connectivity) and output array metadata (to synthesise zero
+    cotangents for unused outputs).
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "n_out", "out_shapes", "out_dtypes",
+                 "name", "out_is_tuple")
+
+    def __init__(self, vjp_fn, inputs, outputs, name="", out_is_tuple=False):
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)          # NDArray refs (graph edges)
+        self.n_out = len(outputs)
+        self.out_shapes = [o.shape for o in outputs]
+        self.out_dtypes = [o.dtype for o in outputs]
+        self.name = name
+        self.out_is_tuple = out_is_tuple
+
+
+def _is_float0(x):
+    return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+def _requires_tracking(nd) -> bool:
+    return nd is not None and (nd._tape_node is not None or
+                               nd._grad_req not in (None, "null"))
+
+
+def record_op(vjp_fn, input_nds, output_nds, name="", out_is_tuple=False):
+    """Attach a tape node linking inputs → outputs. Called by the NDArray
+    dispatch layer when recording is on and ≥1 input is tracked."""
+    node = Node(vjp_fn, input_nds, output_nds, name, out_is_tuple)
+    for i, o in enumerate(output_nds):
+        o._tape_node = node
+        o._out_index = i
+    return node
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _topo_order(root_nodes):
+    order, seen = [], set()
+    stack = [(n, False) for n in root_nodes]
+    while stack:
+        node, done = stack.pop()
+        if done:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for inp in node.inputs:
+            pn = inp._tape_node
+            if pn is not None and id(pn) not in seen:
+                stack.append((pn, False))
+    return order   # parents before children
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
+             variables=None):
+    """Run backward from `heads`.
+
+    If `variables` is given, returns their gradients (autograd.grad
+    semantics, ref: MXAutogradBackwardEx w/ var handles); otherwise
+    accumulates into leaves' `.grad` per their grad_req.
+    """
+    import jax.numpy as jnp
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+
+    root_nodes = []
+    cot = {}               # (id(node), out_idx) -> jax array cotangent
+    for h, hg in zip(heads, head_grads):
+        node = h._tape_node
+        if node is None:
+            raise MXNetError(
+                "cannot differentiate: output was not computed while "
+                "recording (is autograd.record() active?)")
+        root_nodes.append(node)
+        g = jnp.ones(h.shape, h.dtype) if hg is None else hg._data
+        key = (id(node), h._out_index)
+        cot[key] = cot[key] + g if key in cot else g
+
+    order = _topo_order(root_nodes)
+
+    var_ids = None
+    var_grads = {}
+    if variables is not None:
+        if not isinstance(variables, (list, tuple)):
+            variables = [variables]
+        var_ids = {id(v): i for i, v in enumerate(variables)}
+
+    leaf_updates = {}       # id(nd) -> (nd, jax array)
+
+    for node in reversed(order):
+        cots = []
+        any_c = False
+        for i in range(node.n_out):
+            c = cot.pop((id(node), i), None)
+            if c is None:
+                dt = node.out_dtypes[i]
+                if not jnp.issubdtype(dt, jnp.inexact):
+                    # integer/bool outputs take float0 cotangents
+                    c = _np.zeros(node.out_shapes[i], jax.dtypes.float0)
+                else:
+                    c = jnp.zeros(node.out_shapes[i], dt)
+            else:
+                any_c = True
+            cots.append(c)
+        if not any_c:
+            continue
+        if node.vjp_fn is None:
+            raise MXNetError(
+                "graph already freed — pass retain_graph=True to backward "
+                "to call it twice (ref: same contract as MXNet autograd)")
+        arg = tuple(cots) if node.out_is_tuple else cots[0]
+        in_cots = node.vjp_fn(arg)
+        for inp, ic in zip(node.inputs, in_cots):
+            if inp is None or _is_float0(ic):
+                continue
+            pn = inp._tape_node
+            if pn is not None:
+                key = (id(pn), inp._out_index)
+                cot[key] = cot[key] + ic if key in cot else ic
+            if var_ids is not None:
+                if id(inp) in var_ids and pn is None:
+                    k = id(inp)
+                    var_grads[k] = var_grads[k] + ic if k in var_grads else ic
+            if pn is None and inp._grad_req not in (None, "null"):
+                k = id(inp)
+                if k in leaf_updates:
+                    leaf_updates[k] = (inp, leaf_updates[k][1] + ic)
+                else:
+                    leaf_updates[k] = (inp, ic)
+
+    if not retain_graph:
+        for node in order:
+            node.vjp_fn = None
+
+    if variables is not None:
+        from .ndarray import NDArray
+        out = []
+        for v in variables:
+            g = var_grads.get(id(v))
+            if g is None:
+                g = jnp.zeros(v.shape, v.dtype)
+            out.append(NDArray(g, ctx=v.context))
+        return out
+
+    # accumulate into leaf .grad per grad_req
+    for nd, g in leaf_updates.values():
+        if nd._grad is None:
+            continue
+        if nd._grad_req == "add":
+            nd._grad._data = nd._grad._data + g.astype(nd._grad._data.dtype)
+        else:   # write
+            nd._grad._data = g.astype(nd._grad._data.dtype)
+    return None
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """ref: python/mxnet/autograd.py grad(). Higher-order (create_graph)
+    is deferred to a later round — the jax machinery supports it but the
+    tape would need to record pullback applications."""
+    if create_graph:
+        raise NotImplementedError("create_graph=True not yet supported")
+    if retain_graph is None:
+        retain_graph = create_graph
+    return backward(heads, head_grads, retain_graph=retain_graph,
+                    train_mode=train_mode, variables=variables)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """ref: autograd.mark_variables — attach explicit grad buffers."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, r in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = r
+
+
+def get_symbol(x):
+    raise NotImplementedError(
+        "autograd.get_symbol: the TPU build records jax pullbacks, not nnvm "
+        "graphs; use HybridBlock.export for a serialisable graph")

@@ -1,0 +1,62 @@
+"""Base utilities: errors, dtype handling, registry plumbing.
+
+TPU-native re-design of the reference's base layer
+(ref: python/mxnet/base.py — _LIB ctypes plumbing, MXNetError).  There is no
+C ABI here: the "engine" is XLA/PJRT async dispatch, so the base layer only
+standardises errors, dtypes and naming.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["MXNetError", "MXTPUError", "string_types", "numeric_types",
+           "integer_types", "dtype_np", "dtype_name", "DTYPE_ALIASES"]
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (ref: python/mxnet/base.py MXNetError)."""
+
+
+# Alias under the new framework's own name.
+MXTPUError = MXNetError
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+# Canonical dtype set (ref: mshadow type enum: kFloat32/kFloat64/kFloat16/
+# kUint8/kInt32/kInt8/kInt64 + TPU-native bfloat16 first-class).
+DTYPE_ALIASES = {
+    "float32": "float32", "float64": "float64", "float16": "float16",
+    "bfloat16": "bfloat16", "uint8": "uint8", "int8": "int8",
+    "int32": "int32", "int64": "int64", "bool": "bool",
+    "uint16": "uint16", "uint32": "uint32", "uint64": "uint64",
+    "int16": "int16",
+}
+
+
+def dtype_np(dtype):
+    """Normalise a dtype-ish value to a numpy dtype (bfloat16 supported)."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = DTYPE_ALIASES.get(dtype)
+        if name is None:
+            raise TypeError("unknown dtype %r" % (dtype,))
+        if name == "bfloat16":
+            import ml_dtypes
+            return _np.dtype(ml_dtypes.bfloat16)
+        return _np.dtype(name)
+    if dtype in (float,):
+        return _np.dtype("float32")
+    if dtype in (int,):
+        return _np.dtype("int32")
+    if dtype in (bool,):
+        return _np.dtype("bool")
+    return _np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    """Canonical string name of a dtype."""
+    d = dtype_np(dtype)
+    return d.name

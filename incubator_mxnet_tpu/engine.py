@@ -1,0 +1,94 @@
+"""Dispatch layer ("engine").
+
+TPU-native stand-in for the reference dependency engine
+(ref: include/mxnet/engine.h, src/engine/threaded_engine*.cc).
+
+There is deliberately NO thread-pool scheduler here: XLA/PJRT dispatch is
+already asynchronous and per-buffer ordered, which is exactly what the
+ThreadedEngine's var-queue machinery provided (SURVEY §7.0).  What remains
+at framework level:
+
+- `MXNET_ENGINE_TYPE=NaiveEngine` — synchronous debug mode: every op
+  blocks until ready (the reference's engine-bisection tool, SURVEY §5.2).
+- dispatch hooks — profiler instrumentation wraps every imperative op
+  (ref: ThreadedEngine::ExecuteOprBlock profiling).
+- `wait_all()` ≙ Engine::WaitForAll.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Callable, List
+
+__all__ = ["naive_mode", "set_naive_mode", "wait_all", "add_dispatch_listener",
+           "remove_dispatch_listener", "_dispatch_hook", "bulk",
+           "set_bulk_size"]
+
+_NAIVE = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+
+# Listeners: callables (name, ctx, elapsed_s) — used by the profiler.
+_LISTENERS: List[Callable] = []
+
+
+def naive_mode() -> bool:
+    return _NAIVE
+
+
+def set_naive_mode(flag: bool) -> bool:
+    global _NAIVE
+    prev = _NAIVE
+    _NAIVE = bool(flag)
+    return prev
+
+
+def add_dispatch_listener(fn: Callable):
+    _LISTENERS.append(fn)
+
+
+def remove_dispatch_listener(fn: Callable):
+    if fn in _LISTENERS:
+        _LISTENERS.remove(fn)
+
+
+@contextlib.contextmanager
+def _dispatch_hook(name: str, ctx):
+    if not _LISTENERS:
+        yield
+        return
+    t0 = time.perf_counter()
+    yield
+    dt = time.perf_counter() - t0
+    for fn in _LISTENERS:
+        fn(name, ctx, dt)
+
+
+def wait_all():
+    """Engine::WaitForAll — barrier on all outstanding device work."""
+    import jax
+    (jax.device_put(0) + 0).block_until_ready()
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+# Bulking knobs kept for API familiarity (ref: MXNET_EXEC_BULK_EXEC_*).
+# XLA fusion inside jitted executables is the actual bulking mechanism;
+# these are accepted and recorded but change nothing imperatively.
+_BULK_SIZE = int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15))
+
+
+def set_bulk_size(size: int) -> int:
+    global _BULK_SIZE
+    prev, _BULK_SIZE = _BULK_SIZE, int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

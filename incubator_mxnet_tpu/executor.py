@@ -1,0 +1,115 @@
+"""Executor — bound symbolic graph.
+
+TPU-native re-design of ref: src/executor/graph_executor.{h,cc} +
+python/mxnet/executor.py.
+
+`GraphExecutor::Init`'s pass pipeline (InferShape → InferType →
+PlanMemory → AttachOpExecs → bulking) collapses into two jitted XLA
+executables: forward, and forward+vjp for backward.  The shared-memory
+rebind trick BucketingModule relied on (`shared_buffer`) is subsumed by
+the jit cache keyed on input shapes — each bucket shape compiles once and
+XLA's buffer assignment shares what it can.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+from . import ndarray as nd
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write"):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_names = symbol.list_arguments()
+        if isinstance(args, dict):
+            self.arg_dict = dict(args)
+        else:
+            self.arg_dict = dict(zip(self.arg_names, args))
+        missing = set(self.arg_names) - set(self.arg_dict)
+        if missing:
+            raise MXNetError("executor missing args: %s" % missing)
+        if args_grad is None:
+            self.grad_dict = {}
+        elif isinstance(args_grad, dict):
+            self.grad_dict = dict(args_grad)
+        else:
+            self.grad_dict = dict(zip(self.arg_names, args_grad))
+        self.grad_req = grad_req if isinstance(grad_req, dict) else \
+            {n: grad_req for n in self.arg_names}
+        self.outputs: List[NDArray] = []
+        self.aux_dict = {}
+        self._fwd_jit = None
+        self._vjp_fn = None
+
+    # ------------------------------------------------------------------
+    def _build_fwd(self):
+        symbol = self._symbol
+        names = self.arg_names
+
+        def f(*arrs):
+            from .symbol.symbol import _eval_symbol
+            feed = dict(zip(names, arrs))
+            out = _eval_symbol(symbol, feed, raw=True)
+            if isinstance(out, (list, tuple)):
+                return tuple(out)
+            return (out,)
+        return jax.jit(f)
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown input %r" % k)
+            self.arg_dict[k]._data = v._data if isinstance(v, NDArray) \
+                else nd.array(v)._data
+        if self._fwd_jit is None:
+            self._fwd_jit = self._build_fwd()
+        arrs = [self.arg_dict[n]._data for n in self.arg_names]
+        if is_train:
+            outs, self._vjp_fn = jax.vjp(
+                lambda *a: self._fwd_jit(*a), *arrs)
+        else:
+            outs = self._fwd_jit(*arrs)
+            self._vjp_fn = None
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        if self._vjp_fn is None:
+            raise MXNetError("backward called without forward(is_train=True)")
+        import jax.numpy as jnp
+        if out_grads is None:
+            cots = tuple(jnp.ones(o.shape, o._data.dtype)
+                         for o in self.outputs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cots = tuple(g._data for g in out_grads)
+        in_cots = self._vjp_fn(cots)
+        for name, g in zip(self.arg_names, in_cots):
+            req = self.grad_req.get(name, "null")
+            if req == "null" or name not in self.grad_dict:
+                continue
+            tgt = self.grad_dict[name]
+            if req == "add":
+                tgt._data = tgt._data + g
+            else:
+                tgt._data = g
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._data = arr._data
+            elif not allow_extra_params:
+                raise MXNetError("unknown param %r" % name)
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))

@@ -1,0 +1,18 @@
+"""mx.gluon namespace (ref: python/mxnet/gluon/__init__.py)."""
+from .parameter import (Parameter, Constant, ParameterDict,
+                        DeferredInitializationError)
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import data
+from . import utils
+from . import model_zoo
+from . import contrib
+from .utils import split_and_load, split_data, clip_global_norm
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
+           "SymbolBlock", "Trainer", "nn", "rnn", "loss", "data", "utils",
+           "model_zoo", "contrib", "split_and_load", "split_data",
+           "clip_global_norm", "DeferredInitializationError"]

@@ -1,0 +1,518 @@
+"""Gluon Block / HybridBlock.
+
+TPU-native re-design of ref: python/mxnet/gluon/block.py (Block,
+HybridBlock, SymbolBlock) + src/imperative/cached_op.{h,cc} (CachedOp).
+
+The north-star mapping (SURVEY §3.2): `hybridize()` no longer builds an
+nnvm graph + CachedOp — it wraps the block's forward in **one jitted XLA
+executable**:
+
+  - first call per (shapes, dtypes, training-mode): trace `hybrid_forward`
+    with jax tracers flowing through the same NDArray stubs → XLA HLO →
+    compiled executable (≙ CachedOp's nnvm passes + bulked engine segments,
+    with XLA fusion playing the bulking role);
+  - steady state: ONE dispatch per forward (≙ `static_alloc+static_shape`
+    whole-segment push);
+  - under `autograd.record()`, the tape stores the jax.vjp pullback of the
+    jitted function, so `backward()` is one compiled transpose executable
+    (≙ CachedOp::Backward).
+
+Mutable layer state (BatchNorm running stats) uses an explicit
+state-update channel: during tracing the new stats become extra outputs
+and are written back after execution — the functional analogue of the
+reference kernels mutating aux arrays in place.
+"""
+from __future__ import annotations
+
+import contextlib
+import re
+import threading
+from collections import OrderedDict
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray, apply_fn
+from .. import autograd as _ag
+from .. import random as _rnd
+from .parameter import (Parameter, ParameterDict,
+                        DeferredInitializationError)
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "nameless_scope"]
+
+
+# ---------------------------------------------------------------------------
+# name scoping (ref: block.py _BlockScope + name_manager.py NameManager)
+# ---------------------------------------------------------------------------
+
+class _NameCounter(threading.local):
+    def __init__(self):
+        self.counts = {}
+        self.prefix_stack = []
+
+
+_NAMES = _NameCounter()
+
+
+def _gen_prefix(hint):
+    n = _NAMES.counts.get(hint, 0)
+    _NAMES.counts[hint] = n + 1
+    return "%s%d_" % (hint, n)
+
+
+@contextlib.contextmanager
+def nameless_scope():
+    counts = _NAMES.counts
+    _NAMES.counts = {}
+    try:
+        yield
+    finally:
+        _NAMES.counts = counts
+
+
+# ---------------------------------------------------------------------------
+# state-update channel (BatchNorm running stats etc.)
+# ---------------------------------------------------------------------------
+
+class _StateChannel(threading.local):
+    def __init__(self):
+        self.active = None      # None or list of (param, new_jax_value)
+
+
+_STATE = _StateChannel()
+
+
+def record_state_update(param, new_value_nd):
+    """Called by layers whose op updates auxiliary state (running stats).
+    Imperatively: writes through immediately. Under a cached-op trace:
+    queued as an extra executable output, written back post-call."""
+    if _STATE.active is not None:
+        _STATE.active.append((param, new_value_nd._data))
+        return
+    for ctx, arr in param._data.items():
+        arr._data = new_value_nd._data
+        break
+
+
+# ---------------------------------------------------------------------------
+# Block
+# ---------------------------------------------------------------------------
+
+class Block:
+    """ref: gluon.Block — composable, imperative-first layer."""
+
+    def __init__(self, prefix=None, params=None):
+        hint = re.sub(r"(?<!^)(?=[A-Z])", "", self.__class__.__name__).lower()
+        self._prefix = prefix if prefix is not None else _gen_prefix(hint)
+        self._params = ParameterDict(self._prefix, shared=params)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    # -- scoping (API compat: `with self.name_scope():`) ------------------
+    @contextlib.contextmanager
+    def name_scope(self):
+        _NAMES.prefix_stack.append(self._prefix)
+        try:
+            yield
+        finally:
+            _NAMES.prefix_stack.pop()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._prefix.rstrip("_")
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None) -> ParameterDict:
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self._params)
+        else:
+            pattern = re.compile(select)
+            ret.update(OrderedDict((k, v) for k, v in self._params.items()
+                                   if pattern.match(k)))
+        for child in self._children.values():
+            ret.update(child.collect_params(select))
+        return ret
+
+    # -- child / param registration (ref: Block.__setattr__) --------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+                self._params._params.setdefault(value.name, value)
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    # -- lifecycle ---------------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for param in self._params.values():
+            param.cast(dtype)
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    # -- persistence (ref: save_parameters/load_parameters) ----------------
+    def save_parameters(self, filename, deduplicate=False):
+        params = self._collect_params_with_prefix()
+        from .. import ndarray as nd
+        nd.save(filename, {k: v.data() for k, v in params.items()
+                           if v._data is not None})
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        from .. import ndarray as nd
+        loaded = nd.load(filename, ctx=ctx)
+        params = self._collect_params_with_prefix()
+        if not allow_missing:
+            for name in params:
+                if name not in loaded and params[name]._data is not None:
+                    raise MXNetError("parameter %s missing in file" % name)
+        for name, data in loaded.items():
+            if name not in params:
+                if not ignore_extra:
+                    raise MXNetError("parameter %s not in block" % name)
+                continue
+            params[name]._load_and_set(data, ctx)
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + k: v for k, v in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    # -- call --------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        raise NotImplementedError("Block.summary lands with the docs slice")
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)" if self._children else "{name}()"
+        modstr = "\n".join("  (%s): %s" % (k, _indent(repr(v)))
+                           for k, v in self._children.items())
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+
+def _indent(s):
+    return s.replace("\n", "\n  ")
+
+
+# ---------------------------------------------------------------------------
+# HybridBlock + cached-op machinery
+# ---------------------------------------------------------------------------
+
+class _CachedGraph:
+    """The CachedOp equivalent: jitted pure function of
+    (param leaves, input leaves, rng key bits) → (out leaves, state leaves).
+
+    ref: src/imperative/cached_op.cc CachedOp — here nnvm passes + memory
+    planning + bulking are all jax.jit/XLA; the jit cache keyed by input
+    avals replaces the bucketing executors' shared-memory rebinds.
+    """
+
+    def __init__(self, block, flags):
+        import jax
+        self.block = block
+        self.flags = flags
+        self.param_names = None     # ordered param names (stable)
+        self.params = None          # ordered Parameter objects
+        self.state_params = None    # params receiving state updates
+        self.out_treedef = None
+        self._jitted = {}           # training_flag -> jitted fn
+        self._jax = jax
+
+    def _collect_params(self):
+        pd = self.block.collect_params()
+        self.param_names = list(pd.keys())
+        self.params = [pd[n] for n in self.param_names]
+
+    def _make_pure(self, training):
+        import jax
+        block = self.block
+
+        def pure(pvals, ivals, key_bits):
+            holder = _rnd.KeyHolder(jax.random.wrap_key_data(key_bits))
+            # temporarily rebind param data to tracer-backed arrays; restore
+            # after tracing (leaking tracers into Parameters would poison
+            # later imperative use)
+            saved = []
+            for p, v in zip(self.params, pvals):
+                ctx0 = next(iter(p._data))
+                saved.append((p, ctx0, p._data[ctx0]))
+                p._data[ctx0] = NDArray(v, ctx=ctx0)
+            states = []
+            prev_state, _STATE.active = _STATE.active, states
+            prev_rec = _ag.set_recording(False)
+            prev_train = _ag.set_training(training)
+            _rnd.push_trace_key(holder)
+            try:
+                nd_in = [NDArray(v) for v in ivals]
+                out = block.forward(*nd_in)
+            finally:
+                _rnd.pop_trace_key()
+                _ag.set_training(prev_train)
+                _ag.set_recording(prev_rec)
+                _STATE.active = prev_state
+                for p, ctx0, orig in saved:
+                    p._data[ctx0] = orig
+            out_flat, treedef = _flatten_out(out)
+            if self.out_treedef is None:
+                self.out_treedef = treedef
+            sp = [p for p, _ in states]
+            if self.state_params is None:
+                self.state_params = sp
+            return (tuple(o._data for o in out_flat),
+                    tuple(v for _, v in states))
+        return pure
+
+    def __call__(self, args):
+        import jax
+        if self.param_names is None:
+            self._collect_params()
+        training = _ag.is_training()
+        ctx = args[0].context if args and isinstance(args[0], NDArray) \
+            else current_context()
+
+        if training not in self._jitted:
+            self._jitted[training] = jax.jit(self._make_pure(training))
+        fn = self._jitted[training]
+
+        param_nds = [p.data(ctx) for p in self.params]
+        key_bits = jax.random.key_data(_rnd.split_key(ctx))
+        key_nd = NDArray(key_bits, ctx=ctx)
+
+        # flatten for apply_fn: it records vjp over NDArray positions
+        flat_inputs = list(param_nds) + list(args) + [key_nd]
+        np_, ni_ = len(param_nds), len(args)
+
+        def fn_flat(*leaves):
+            pv = leaves[:np_]
+            iv = leaves[np_:np_ + ni_]
+            kb = leaves[-1]
+            outs, states = fn(pv, iv, kb)
+            return tuple(outs) + tuple(states)
+
+        result = apply_fn(fn_flat, flat_inputs, {},
+                          name=self.block.name + "_cachedop", ctx=ctx)
+        if not isinstance(result, tuple):
+            result = (result,)
+        n_states = len(self.state_params or ())
+        outs = result[:len(result) - n_states]
+        states = result[len(result) - n_states:]
+        for p, s in zip(self.state_params or (), states):
+            for c in list(p._data.keys()):
+                p._data[c]._data = s._data
+                break
+        return _unflatten_out(list(outs), self.out_treedef)
+
+
+def _flatten_out(out):
+    """Flatten nested tuple/list of NDArray into (leaves, treedef)."""
+    if isinstance(out, NDArray):
+        return [out], None
+    if isinstance(out, (tuple, list)):
+        leaves, defs = [], []
+        for o in out:
+            sub, d = _flatten_out(o)
+            defs.append((len(sub), d))
+            leaves.extend(sub)
+        return leaves, (type(out), defs)
+    raise MXNetError("hybrid_forward must return NDArray or (nested) "
+                     "tuple/list, got %r" % type(out))
+
+
+def _unflatten_out(leaves, treedef):
+    if treedef is None:
+        return leaves[0]
+    typ, defs = treedef
+    out, i = [], 0
+    for n, d in defs:
+        sub = leaves[i:i + n]
+        out.append(_unflatten_out(sub, d))
+        i += n
+    return typ(out)
+
+
+class HybridBlock(Block):
+    """ref: gluon.HybridBlock — dual imperative/traced execution."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_graph = None
+        self._flags = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  inline_limit=2, forward_bulk_size=None,
+                  backward_bulk_size=None):
+        """static_alloc/static_shape accepted for API parity; XLA buffer
+        assignment + donation already provide them (SURVEY §7.0)."""
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc,
+                           static_shape=static_shape)
+        self._cached_graph = None
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape)
+
+    def infer_shape(self, *args):
+        """Layer-specific deferred-shape hook (ref: HybridBlock's symbolic
+        _deferred_infer_shape; here each parametrised layer sets its own
+        param shapes from input shapes)."""
+        for child in self._children.values():
+            if isinstance(child, HybridBlock):
+                pass   # children infer when called
+
+    def _finish_deferred(self, *args):
+        try:
+            self.infer_shape(*args)
+        except NotImplementedError:
+            raise
+        for p in self._reg_params.values():
+            if p._deferred_init:
+                p._finish_deferred_init()
+
+    def cast(self, dtype):
+        self._cached_graph = None
+        super().cast(dtype)
+
+    def __call__(self, *args, **kwargs):
+        # _STATE.active is not None ⇔ some ancestor cached-op is tracing:
+        # children must trace inline (ref: CachedOp inlines the whole
+        # subgraph; nested CachedOps are not re-entered)
+        if self._active and not kwargs and _STATE.active is None:
+            if self._cached_graph is None:
+                # let any deferred params materialise with one imperative
+                # pass before tracing (ref: CachedOp created after first
+                # forward's shape inference)
+                try:
+                    pd = self.collect_params()
+                    deferred = any(p._deferred_init for p in pd.values())
+                except Exception:
+                    deferred = False
+                if deferred:
+                    with _ag.pause():
+                        Block.__call__(self, *args)
+                self._cached_graph = _CachedGraph(self, self._flags)
+            return self._cached_graph(list(args))
+        return Block.__call__(self, *args, **kwargs)
+
+    def forward(self, x, *args):
+        """Gathers this block's params and calls hybrid_forward with the
+        `F` namespace (always the ndarray stubs here — tracing happens at
+        the jax level, so one code path serves both modes)."""
+        from .. import ndarray as F
+        try:
+            params = {k: p.data(x.context if isinstance(x, NDArray) else None)
+                      for k, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._finish_deferred(x, *args)
+            params = {k: p.data(x.context if isinstance(x, NDArray) else None)
+                      for k, p in self._reg_params.items()}
+        return self.hybrid_forward(F, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """ref: HybridBlock.export → model-symbol.json + params.  Here the
+        graph artifact is the StableHLO of the cached executable plus the
+        params file (SURVEY §5.4 TPU equiv)."""
+        import jax
+        params = self._collect_params_with_prefix()
+        from .. import ndarray as nd
+        nd.save("%s-%04d.params" % (path, epoch),
+                {k: v.data() for k, v in params.items()
+                 if v._data is not None})
+        if self._cached_graph is not None and self._cached_graph._jitted:
+            fn = next(iter(self._cached_graph._jitted.values()))
+            try:
+                lowered = getattr(fn, "lower", None)
+                if lowered:
+                    pass   # shapes needed; serialised HLO export is a
+                           # follow-up once Symbol json lands
+            except Exception:
+                pass
+        return "%s-symbol.json" % path
+
+
+class SymbolBlock(HybridBlock):
+    """ref: gluon.SymbolBlock — wrap a Symbol graph as a Block."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="symbolblock_", params=params)
+        self._outputs = outputs
+        self._inputs = inputs
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from ..symbol import load as sym_load
+        sym = sym_load(symbol_file)
+        from ..symbol import var
+        inputs = [var(n) for n in (input_names if isinstance(
+            input_names, (list, tuple)) else [input_names])]
+        block = SymbolBlock(sym, inputs)
+        if param_file:
+            block.load_parameters(param_file, ctx=ctx,
+                                  allow_missing=False, ignore_extra=True)
+        return block
+
+    def forward(self, *args):
+        from ..symbol import _eval_symbol
+        feed = {str(i): a for i, a in zip(self._inputs, args)}
+        feed = {i.name: a for i, a in zip(self._inputs, args)}
+        pd = self.collect_params()
+        for name, p in pd.items():
+            if p._data is not None:
+                feed[name] = p.data()
+        return _eval_symbol(self._outputs, feed)

@@ -1,0 +1,4 @@
+"""mx.gluon.contrib namespace (ref: python/mxnet/gluon/contrib/).
+
+Populated as contrib features land (estimator, contrib.nn, contrib.rnn).
+"""

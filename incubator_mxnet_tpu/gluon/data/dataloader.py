@@ -1,0 +1,141 @@
+"""DataLoader (ref: python/mxnet/gluon/data/dataloader.py).
+
+The reference used multiprocessing workers + `cpu_shared` NDArray IPC.
+Here workers produce **numpy** batches over pickle/shm (host memory is the
+cpu_shared analogue — PJRT uploads from host buffers directly); the main
+process wraps them as NDArrays, keeping the device upload on the main
+thread next to dispatch (TPU transfers are engine-ordered already).
+"""
+from __future__ import annotations
+
+import multiprocessing as _mp
+
+import numpy as _np
+
+from ...ndarray.ndarray import NDArray
+from ... import ndarray as nd
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def _asnumpy(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (ref: default_batchify_fn)."""
+    if isinstance(data[0], tuple):
+        return tuple(default_batchify_fn([d[i] for d in data])
+                     for i in range(len(data[0])))
+    arrs = [_asnumpy(d) for d in data]
+    return nd.array(_np.stack(arrs))
+
+
+default_mp_batchify_fn = default_batchify_fn
+
+
+_worker_dataset = None
+
+
+def _worker_init(dataset):
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_fn(samples):
+    """Runs in worker process: fetch + batchify to numpy (picklable)."""
+    global _worker_dataset
+    batch = [_worker_dataset[i] for i in samples]
+    if isinstance(batch[0], tuple):
+        out = tuple(_np.stack([_asnumpy(b[i]) for b in batch])
+                    for i in range(len(batch[0])))
+    else:
+        out = _np.stack([_asnumpy(b) for b in batch])
+    return out
+
+
+def _np_to_nd(out):
+    if isinstance(out, tuple):
+        return tuple(nd.array(o) for o in out)
+    return nd.array(out)
+
+
+class DataLoader:
+    """ref: gluon.data.DataLoader — batching + shuffling + prefetching."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0, pin_memory=False,
+                 pin_device_id=0, prefetch=None, thread_pool=False,
+                 timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._num_workers = max(0, num_workers)
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when batch_sampler "
+                                 "is not given")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must be False with sampler given")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                        last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None or
+                last_batch is not None):
+            raise ValueError("batch_size/shuffle/sampler/last_batch must "
+                             "not be given with batch_sampler")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._prefetch = max(0, prefetch or 2 * self._num_workers)
+        self._pool = None
+        if self._num_workers > 0:
+            ctx = _mp.get_context("fork")
+            self._pool = ctx.Pool(self._num_workers,
+                                  initializer=_worker_init,
+                                  initargs=(self._dataset,))
+
+    def __iter__(self):
+        if self._pool is not None:
+            return self._mp_iter()
+        return self._serial_iter()
+
+    def _serial_iter(self):
+        for batch_idx in self._batch_sampler:
+            yield self._batchify_fn([self._dataset[i] for i in batch_idx])
+
+    def _mp_iter(self):
+        # sliding window of async results (double-buffer prefetch, the
+        # dmlc::ThreadedIter analogue)
+        import collections
+        queue = collections.deque()
+        it = iter(self._batch_sampler)
+
+        def enqueue():
+            try:
+                idx = next(it)
+            except StopIteration:
+                return False
+            queue.append(self._pool.apply_async(_worker_fn, (idx,)))
+            return True
+
+        for _ in range(self._prefetch or 2):
+            if not enqueue():
+                break
+        while queue:
+            res = queue.popleft()
+            out = res.get(self._timeout)
+            enqueue()
+            yield _np_to_nd(out)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()

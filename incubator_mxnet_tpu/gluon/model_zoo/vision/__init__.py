@@ -1,0 +1,22 @@
+"""mx.gluon.model_zoo.vision (ref: python/mxnet/gluon/model_zoo/vision/).
+
+Model families arrive incrementally; resnet (north-star) first. `get_model`
+mirrors the reference registry interface.
+"""
+from .resnet import *        # noqa: F401,F403
+from . import resnet as _resnet_mod
+
+_models = {}
+for _name in _resnet_mod.__all__:
+    _obj = getattr(_resnet_mod, _name)
+    if callable(_obj) and _name.startswith("resnet"):
+        _models[_name] = _obj
+
+
+def get_model(name, **kwargs):
+    """ref: model_zoo.vision.get_model."""
+    name = name.lower()
+    if name not in _models:
+        raise ValueError("model %r not in registry (%s)" %
+                         (name, sorted(_models)))
+    return _models[name](**kwargs)

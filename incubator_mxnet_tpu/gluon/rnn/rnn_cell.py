@@ -1,0 +1,336 @@
+"""Gluon RNN cells (ref: python/mxnet/gluon/rnn/rnn_cell.py)."""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ...base import MXNetError
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell", "HybridRecurrentCell"]
+
+
+class RecurrentCell(HybridBlock):
+    """ref: rnn_cell.RecurrentCell — begin_state/unroll contract."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as nd
+        func = func or nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            info = dict(info)
+            shape = info.pop("shape")
+            info.pop("__layout__", None)
+            kw = {k: v for k, v in kwargs.items() if v is not None}
+            states.append(func(shape, **info, **kw))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as nd
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        if isinstance(inputs, (list, tuple)):
+            seq = list(inputs)
+            batch = seq[0].shape[0]
+        else:
+            seq = [inputs.slice_axis(axis, i, i + 1).squeeze(axis=axis)
+                   for i in range(length)]
+            batch = inputs.shape[batch_axis]
+        states = begin_state if begin_state is not None else \
+            self.begin_state(batch, ctx=seq[0].context
+                             if hasattr(seq[0], "context") else None)
+        outputs = []
+        for i in range(length):
+            out, states = self(seq[i], states)
+            outputs.append(out)
+        if merge_outputs or merge_outputs is None:
+            outputs = nd.stack(*outputs, axis=axis)
+            if valid_length is not None:
+                outputs = nd.SequenceMask(
+                    outputs.swapaxes(0, 1) if axis == 1 else outputs,
+                    valid_length, use_sequence_length=True)
+                if axis == 1:
+                    outputs = outputs.swapaxes(0, 1)
+        return outputs, states
+
+    def __call__(self, inputs, states=None, **kwargs):
+        self._counter += 1
+        if states is None:
+            return super().__call__(inputs, **kwargs)
+        return super().__call__(inputs, states, **kwargs)
+
+
+HybridRecurrentCell = RecurrentCell
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(hidden_size, hidden_size),
+            init=h2h_weight_initializer)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(hidden_size,), init=i2h_bias_initializer)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(hidden_size,), init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        output = F.Activation(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class LSTMCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, hidden_size),
+            init=h2h_weight_initializer)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,),
+            init=i2h_bias_initializer)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,),
+            init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        in_gate, forget_gate, in_trans, out_gate = F.split(
+            gates, num_outputs=4, axis=-1)
+        in_gate = F.sigmoid(in_gate)
+        forget_gate = F.sigmoid(forget_gate)
+        in_trans = F.tanh(in_trans)
+        out_gate = F.sigmoid(out_gate)
+        next_c = forget_gate * states[1] + in_gate * in_trans
+        next_h = out_gate * F.tanh(next_c)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(3 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(3 * hidden_size, hidden_size),
+            init=h2h_weight_initializer)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(3 * hidden_size,),
+            init=i2h_bias_initializer)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(3 * hidden_size,),
+            init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        i2h_r, i2h_z, i2h_n = F.split(i2h, num_outputs=3, axis=-1)
+        h2h_r, h2h_z, h2h_n = F.split(h2h, num_outputs=3, axis=-1)
+        reset = F.sigmoid(i2h_r + h2h_r)
+        update = F.sigmoid(i2h_z + h2h_z)
+        next_h_tmp = F.tanh(i2h_n + reset * h2h_n)
+        next_h = (1.0 - update) * next_h_tmp + update * states[0]
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return sum((c.state_info(batch_size)
+                    for c in self._children.values()), [])
+
+    def __len__(self):
+        return len(self._children)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        pos = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[pos:pos + n]
+            pos += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+    def forward(self, *args, **kwargs):
+        raise MXNetError("SequentialRNNCell is called step-wise")
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def hybrid_forward(self, F, inputs, states):
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ZoneoutCell(RecurrentCell):
+    def __init__(self, base_cell, zoneout_outputs=0., zoneout_states=0.,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self.register_child(base_cell, "base_cell")
+        self._zoneout_outputs = zoneout_outputs
+        self._zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def __call__(self, inputs, states):
+        from ... import ndarray as F
+        next_output, next_states = self.base_cell(inputs, states)
+        if self._zoneout_outputs > 0.:
+            prev = self._prev_output
+            if prev is None:
+                prev = F.zeros_like(next_output)
+            mask = F.Dropout(F.ones_like(next_output),
+                             p=self._zoneout_outputs)
+            next_output = F.where(mask, next_output, prev)
+            self._prev_output = next_output
+        if self._zoneout_states > 0.:
+            out_states = []
+            for ns, s in zip(next_states, states):
+                mask = F.Dropout(F.ones_like(ns), p=self._zoneout_states)
+                out_states.append(F.where(mask, ns, s))
+            next_states = out_states
+        return next_output, next_states
+
+
+class ResidualCell(RecurrentCell):
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self.register_child(base_cell, "base_cell")
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def __call__(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + \
+            self.r_cell.state_info(batch_size)
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot be stepped — use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as nd
+        axis = layout.find("T")
+        if not isinstance(inputs, (list, tuple)):
+            seq = [inputs.slice_axis(axis, i, i + 1).squeeze(axis=axis)
+                   for i in range(length)]
+        else:
+            seq = list(inputs)
+        batch = seq[0].shape[0]
+        states = begin_state if begin_state is not None else \
+            self.begin_state(batch, ctx=seq[0].context)
+        nl = len(self.l_cell.state_info())
+        l_states, r_states = states[:nl], states[nl:]
+        l_out = []
+        for i in range(length):
+            o, l_states = self.l_cell(seq[i], l_states)
+            l_out.append(o)
+        r_out = []
+        for i in reversed(range(length)):
+            o, r_states = self.r_cell(seq[i], r_states)
+            r_out.append(o)
+        r_out = r_out[::-1]
+        outs = [nd.concat(l, r, dim=-1) for l, r in zip(l_out, r_out)]
+        if merge_outputs or merge_outputs is None:
+            outs = nd.stack(*outs, axis=axis)
+        return outs, l_states + r_states

@@ -1,0 +1,115 @@
+"""Gluon fused RNN layers (ref: python/mxnet/gluon/rnn/rnn_layer.py —
+RNN/LSTM/GRU backed by the fused `RNN` op; here a lax.scan executable)."""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ...base import MXNetError
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, mode, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError("layout must be TNC or NTC, got %s" % layout)
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._mode = mode
+        from ...ops.rnn import rnn_param_size
+        psize = rnn_param_size(mode, num_layers, input_size, hidden_size,
+                               bidirectional) if input_size else 0
+        self.parameters = self.params.get(
+            "parameters", shape=(psize,) if psize else (0,),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        if self._mode == "lstm":
+            return [{"shape": (self._num_layers * self._dir, batch_size,
+                               self._hidden_size)},
+                    {"shape": (self._num_layers * self._dir, batch_size,
+                               self._hidden_size)}]
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size)}]
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        from ... import ndarray as nd
+        func = func or nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            kw = dict(kwargs)
+            if ctx is not None:
+                kw["ctx"] = ctx
+            states.append(func(info["shape"], **kw))
+        return states
+
+    def infer_shape(self, x, *args):
+        from ...ops.rnn import rnn_param_size
+        in_sz = x.shape[-1]
+        self._input_size = in_sz
+        self.parameters.shape = (rnn_param_size(
+            self._mode, self._num_layers, in_sz, self._hidden_size,
+            self._dir == 2),)
+
+    def hybrid_forward(self, F, inputs, states=None, parameters=None):
+        if parameters is None:      # states omitted
+            parameters = states
+            states = None
+        batch = inputs.shape[self._layout.find("N")]
+        explicit_states = states is not None
+        if states is None:
+            states = self.begin_state(
+                batch, ctx=inputs.context if hasattr(inputs, "context")
+                else None)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        x = inputs
+        if self._layout == "NTC":
+            x = F.swapaxes(x, 0, 1)
+        out = F.RNN(x, parameters, *states, state_size=self._hidden_size,
+                    num_layers=self._num_layers,
+                    bidirectional=self._dir == 2, mode=self._mode,
+                    p=self._dropout, state_outputs=True)
+        if self._mode == "lstm":
+            y, h, c = out
+            new_states = [h, c]
+        else:
+            y, h = out
+            new_states = [h]
+        if self._layout == "NTC":
+            y = F.swapaxes(y, 0, 1)
+        if explicit_states:
+            return y, new_states
+        return y
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="tanh",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 input_size=0, **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, mode, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    """ref: gluon.rnn.LSTM — the GNMT/Sockeye workhorse."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "lstm", **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "gru", **kwargs)

@@ -1,0 +1,93 @@
+"""Gluon utilities (ref: python/mxnet/gluon/utils.py — split_and_load,
+split_data, clip_global_norm, download helpers)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray.ndarray import NDArray
+from .. import ndarray as nd
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """ref: utils.split_data — slice a batch along batch_axis."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            "data size %d cannot be evenly split into %d slices"
+            % (size, num_slice))
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """ref: utils.split_and_load — the data-parallel batch scatter.  On a
+    sharded mesh prefer parallel.shard_batch (one sharded array); this is
+    the per-device-copies parity API."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """ref: utils.clip_global_norm."""
+    import jax.numpy as jnp
+    if not arrays:
+        raise MXNetError("arrays must be non-empty")
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(a._data.astype(jnp.float32)))
+                         for a in arrays))
+    total_f = float(total)
+    if check_isfinite and not _np.isfinite(total_f):
+        import warnings
+        warnings.warn("nan or inf found in gradients")
+        return total_f
+    scale = max_norm / (total_f + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._data = a._data * scale
+    return total_f
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1 << 20)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Model/dataset download (ref: utils.download).  This build targets
+    air-gapped TPU pods: network fetch is attempted but a clear error is
+    raised when egress is unavailable."""
+    import os
+    import urllib.request
+    fname = path if path and not os.path.isdir(path) else \
+        os.path.join(path or ".", url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite and \
+            (not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    try:
+        urllib.request.urlretrieve(url, fname)
+    except Exception as e:
+        raise MXNetError(
+            "download of %s failed (%s) — this environment has no egress; "
+            "place the file at %s manually" % (url, e, fname))
+    return fname

@@ -1,0 +1,6 @@
+"""`mx.init` alias namespace (ref: python/mxnet/initializer.py is exposed
+as both mx.initializer and mx.init)."""
+from .initializer import *          # noqa: F401,F403
+from .initializer import (Initializer, Zero, One, Constant, Uniform, Normal,
+                          Orthogonal, Xavier, MSRAPrelu, Bilinear, LSTMBias,
+                          Mixed, InitDesc, create, register)

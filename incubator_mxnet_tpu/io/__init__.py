@@ -1,0 +1,12 @@
+"""mx.io namespace (ref: python/mxnet/io/)."""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,
+                 LibSVMIter, ImageRecordIter, MNISTIter, ResizeIter,
+                 PrefetchingIter)
+from . import recordio
+from .recordio import (MXRecordIO, MXIndexedRecordIO, IRHeader, pack,
+                       unpack, pack_img, unpack_img)
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "LibSVMIter", "ImageRecordIter", "MNISTIter", "ResizeIter",
+           "PrefetchingIter", "recordio", "MXRecordIO", "MXIndexedRecordIO",
+           "IRHeader", "pack", "unpack", "pack_img", "unpack_img"]

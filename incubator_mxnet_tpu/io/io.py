@@ -1,0 +1,458 @@
+"""Data iterators (ref: python/mxnet/io/io.py + src/io/*.cc).
+
+DataBatch/DataDesc/DataIter API preserved.  NDArrayIter covers in-memory
+data; CSVIter/LibSVMIter read text formats; ImageRecordIter re-creates the
+reference's threaded RecordIO → decode → augment → batch → prefetch
+pipeline (src/io/iter_image_recordio_2.cc) with a Python thread pool over
+the recordio reader (C++ acceleration slots in behind the same class).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import threading
+from collections import namedtuple
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import ndarray as nd
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "LibSVMIter", "ImageRecordIter", "MNISTIter", "ResizeIter",
+           "PrefetchingIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data if isinstance(data, (list, tuple)) else [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """ref: io.DataIter."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+class NDArrayIter(DataIter):
+    """ref: io.NDArrayIter — in-memory arrays with shuffle/pad."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0] if self.data else 0
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._order = _np.arange(self.num_data)
+        if shuffle:
+            _np.random.shuffle(self._order)
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:],
+                         v.dtype) for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:],
+                         v.dtype) for k, v in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            _np.random.shuffle(self._order)
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _take(self, arrays):
+        out = []
+        for _, v in arrays:
+            idx = self._order[self.cursor:self.cursor + self.batch_size]
+            chunk = v[idx]
+            if len(idx) < self.batch_size and \
+                    self.last_batch_handle == "pad":
+                wrap = self._order[:self.batch_size - len(idx)]
+                chunk = _np.concatenate([chunk, v[wrap]])
+            out.append(nd.array(chunk))
+        return out
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        return []
+    if isinstance(data, (NDArray, _np.ndarray)):
+        data = [(default_name, data)]
+    elif isinstance(data, dict):
+        data = list(data.items())
+    elif isinstance(data, (list, tuple)):
+        data = [("%s_%d" % (default_name, i) if len(data) > 1
+                 else default_name, d) for i, d in enumerate(data)]
+    out = []
+    for k, v in data:
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, _np.asarray(v)))
+    return out
+
+
+class CSVIter(DataIter):
+    """ref: src/io/iter_csv.cc."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 dtype="float32"):
+        super().__init__(batch_size)
+        data = _np.loadtxt(data_csv, delimiter=",",
+                           dtype=dtype).reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype=dtype)
+            label = label.reshape((-1,) + tuple(label_shape))
+        else:
+            label = _np.zeros((data.shape[0],) + tuple(label_shape),
+                              dtype=dtype)
+        self._inner = NDArrayIter(data, label, batch_size,
+                                  last_batch_handle="pad"
+                                  if round_batch else "discard")
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class LibSVMIter(DataIter):
+    """ref: src/io/iter_libsvm.cc — sparse libsvm text (Wide&Deep). Rows
+    come back as CSR (ndarray.sparse.CSRNDArray)."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=None, batch_size=1, round_batch=True):
+        super().__init__(batch_size)
+        self._shape = tuple(data_shape)
+        self._labels, self._indptr, self._indices, self._values = \
+            self._parse(data_libsvm)
+        self.num_data = len(self._labels)
+        self.cursor = -batch_size
+
+    @staticmethod
+    def _parse(path):
+        labels, indptr, indices, values = [], [0], [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.strip().split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for kv in parts[1:]:
+                    k, v = kv.split(":")
+                    indices.append(int(k))
+                    values.append(float(v))
+                indptr.append(len(indices))
+        return (_np.asarray(labels, _np.float32),
+                _np.asarray(indptr, _np.int64),
+                _np.asarray(indices, _np.int64),
+                _np.asarray(values, _np.float32))
+
+    def reset(self):
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def getdata(self):
+        from ..ndarray.sparse import CSRNDArray
+        lo = self.cursor
+        hi = min(self.cursor + self.batch_size, self.num_data)
+        indptr = self._indptr[lo:hi + 1] - self._indptr[lo]
+        sl = slice(self._indptr[lo], self._indptr[hi])
+        n = hi - lo
+        if n < self.batch_size:    # pad with empty rows
+            indptr = _np.concatenate(
+                [indptr, _np.full(self.batch_size - n, indptr[-1])])
+        return [CSRNDArray(self._values[sl], self._indices[sl], indptr,
+                           (self.batch_size,) + self._shape)]
+
+    def getlabel(self):
+        lo = self.cursor
+        hi = min(self.cursor + self.batch_size, self.num_data)
+        lab = self._labels[lo:hi]
+        if len(lab) < self.batch_size:
+            lab = _np.concatenate(
+                [lab, _np.zeros(self.batch_size - len(lab), _np.float32)])
+        return [nd.array(lab)]
+
+
+class ImageRecordIter(DataIter):
+    """ref: src/io/iter_image_recordio_2.cc ImageRecordIOParser2.
+
+    Threaded pipeline: reader (recordio) → pool of decode+augment workers
+    → batcher → double-buffered prefetch, mirroring the reference's
+    structure; decode via PIL/RAWI (see recordio._decode_img).
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, rand_crop=False,
+                 rand_mirror=False, preprocess_threads=4, prefetch_buffer=2,
+                 round_batch=True, seed=0, resize=-1, data_name="data",
+                 label_name="softmax_label", dtype="float32", **kwargs):
+        super().__init__(batch_size)
+        from .recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
+        self._unpack_img = unpack_img
+        self.data_shape = tuple(data_shape)           # (C, H, W)
+        self.label_width = label_width
+        self._shuffle = shuffle
+        self._rand_crop = rand_crop
+        self._rand_mirror = rand_mirror
+        self._resize = resize
+        self._dtype = dtype
+        self._mean = _np.array([mean_r, mean_g, mean_b],
+                               dtype=_np.float32).reshape(3, 1, 1)
+        self._std = _np.array([std_r, std_g, std_b],
+                              dtype=_np.float32).reshape(3, 1, 1)
+        self._rng = _np.random.RandomState(seed)
+        idx_path = path_imgrec[:path_imgrec.rfind(".")] + ".idx"
+        if os.path.exists(idx_path):
+            self._rec = MXIndexedRecordIO(idx_path, path_imgrec, "r")
+            self._keys = list(self._rec.keys)
+        else:
+            self._rec = MXRecordIO(path_imgrec, "r")
+            self._keys = None
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=preprocess_threads)
+        self._prefetch = max(1, prefetch_buffer)
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        if self._keys is not None:
+            self._order = list(self._keys)
+            if self._shuffle:
+                self._rng.shuffle(self._order)
+            self._pos = 0
+        else:
+            self._rec.reset()
+        self._pending = []
+        self._fill()
+
+    def _read_record(self):
+        if self._keys is not None:
+            if self._pos >= len(self._order):
+                return None
+            rec = self._rec.read_idx(self._order[self._pos])
+            self._pos += 1
+            return rec
+        return self._rec.read()
+
+    def _process(self, raw):
+        header, img = self._unpack_img(raw)     # HWC uint8
+        c, h, w = self.data_shape
+        if self._resize > 0:
+            from ..gluon.data.vision.transforms import _resize_np
+            short = min(img.shape[:2])
+            scale = self._resize / short
+            img = _resize_np(img, (int(round(img.shape[1] * scale)),
+                                   int(round(img.shape[0] * scale))))
+        H, W = img.shape[:2]
+        if self._rand_crop and H > h and W > w:
+            y0 = self._rng.randint(0, H - h + 1)
+            x0 = self._rng.randint(0, W - w + 1)
+        else:
+            y0, x0 = max(0, (H - h) // 2), max(0, (W - w) // 2)
+        if H < h or W < w:
+            from ..gluon.data.vision.transforms import _resize_np
+            img = _resize_np(img, (w, h))
+            y0 = x0 = 0
+        img = img[y0:y0 + h, x0:x0 + w]
+        if self._rand_mirror and self._rng.rand() < 0.5:
+            img = img[:, ::-1]
+        chw = _np.ascontiguousarray(
+            _np.asarray(img, dtype=_np.float32).transpose(2, 0, 1))
+        chw = (chw - self._mean) / self._std
+        label = header.label if hasattr(header.label, "__len__") else \
+            _np.float32(header.label)
+        return chw.astype(self._dtype), label
+
+    def _fill(self):
+        while len(self._pending) < self._prefetch:
+            raws = []
+            with self._lock:
+                for _ in range(self.batch_size):
+                    r = self._read_record()
+                    if r is None:
+                        break
+                    raws.append(r)
+            if not raws:
+                break
+            futs = [self._pool.submit(self._process, r) for r in raws]
+            self._pending.append(futs)
+
+    def next(self):
+        if not self._pending:
+            raise StopIteration
+        futs = self._pending.pop(0)
+        self._fill()
+        results = [f.result() for f in futs]
+        pad = self.batch_size - len(results)
+        data = _np.stack([r[0] for r in results])
+        label = _np.stack([r[1] for r in results])
+        if pad:
+            data = _np.concatenate([data, _np.repeat(
+                data[-1:], pad, axis=0)])
+            label = _np.concatenate([label, _np.repeat(
+                label[-1:], pad, axis=0)])
+        return DataBatch([nd.array(data)], [nd.array(label)], pad=pad)
+
+
+class MNISTIter(NDArrayIter):
+    """ref: src/io/iter_mnist.cc — reads idx-ubyte files."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=False,
+                 flat=False, **kwargs):
+        import gzip
+        import struct as _struct
+        opener = gzip.open if image.endswith(".gz") else open
+        with opener(label, "rb") as f:
+            _struct.unpack(">II", f.read(8))
+            lab = _np.frombuffer(f.read(), dtype=_np.uint8).astype(
+                _np.float32)
+        with opener(image, "rb") as f:
+            _, _, rows, cols = _struct.unpack(">IIII", f.read(16))
+            img = _np.frombuffer(f.read(), dtype=_np.uint8).reshape(
+                len(lab), rows, cols).astype(_np.float32) / 255.0
+        img = img.reshape(len(lab), -1) if flat else \
+            img[:, None, :, :]
+        super().__init__(img, lab, batch_size, shuffle)
+
+
+class ResizeIter(DataIter):
+    """ref: io.ResizeIter — wraps an iter to a fixed epoch size."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def next(self):
+        if self.cur >= self.size:
+            raise StopIteration
+        try:
+            batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            batch = self.data_iter.next()
+        self.cur += 1
+        return batch
+
+
+class PrefetchingIter(DataIter):
+    """ref: io.PrefetchingIter — background-thread double buffering."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        self._future = None
+        self._prime()
+
+    def _prime(self):
+        def fetch():
+            try:
+                return [it.next() for it in self.iters]
+            except StopIteration:
+                return None
+        self._future = self._pool.submit(fetch)
+
+    def reset(self):
+        if self._future is not None:
+            self._future.result()
+        for it in self.iters:
+            it.reset()
+        self._prime()
+
+    def next(self):
+        got = self._future.result()
+        if got is None:
+            raise StopIteration
+        self._prime()
+        if len(got) == 1:
+            return got[0]
+        return DataBatch(sum([b.data for b in got], []),
+                         sum([b.label for b in got], []))

@@ -1,0 +1,239 @@
+"""RecordIO (ref: 3rdparty/dmlc-core/include/dmlc/recordio.h,
+src/recordio.cc; python/mxnet/recordio.py).
+
+Byte-compatible implementation of the dmlc RecordIO framing so .rec files
+written by reference tooling (tools/im2rec.py) read unchanged:
+
+  each record:  u32 magic (0xced7230a)
+                u32 lrecord = (cflag << 29) | length
+                payload bytes, zero-padded to 4-byte boundary
+  cflag: 0 = whole record, 1/2/3 = begin/middle/end of a split record.
+
+IRHeader packs (flag, label, id, id2) little-endian as the reference's
+image-record header (mx.recordio.IRHeader).
+
+A C++ accelerated reader (src/recordio.cc here) backs the threaded
+ImageRecordIter; this module is the always-available pure-python path.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import struct
+
+import numpy as _np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IndexedRecordIO", "IRHeader",
+           "pack", "unpack", "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+_CFLAG_BITS = 29
+_LEN_MASK = (1 << _CFLAG_BITS) - 1
+
+
+class MXRecordIO:
+    """ref: mx.recordio.MXRecordIO — sequential read/write."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("flag must be 'r' or 'w'")
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.handle.close()
+            self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["handle"] = None
+        d["is_open"] = False
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        length = len(buf)
+        self.handle.write(struct.pack("<II", _MAGIC, length & _LEN_MASK))
+        self.handle.write(buf)
+        pad = (-length) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def tell(self):
+        return self.handle.tell()
+
+    def read(self):
+        assert not self.writable
+        header = self.handle.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise IOError("invalid RecordIO magic at offset %d"
+                          % (self.handle.tell() - 8))
+        cflag = lrec >> _CFLAG_BITS
+        length = lrec & _LEN_MASK
+        buf = self.handle.read(length)
+        self.handle.read((-length) % 4)
+        if cflag == 0:
+            return buf
+        # split record: keep reading continuation chunks
+        parts = [buf]
+        while cflag not in (0, 3):
+            header = self.handle.read(8)
+            magic, lrec = struct.unpack("<II", header)
+            cflag = lrec >> _CFLAG_BITS
+            length = lrec & _LEN_MASK
+            parts.append(self.handle.read(length))
+            self.handle.read((-length) % 4)
+        return b"".join(parts)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """ref: mx.recordio.MXIndexedRecordIO — .idx 'key\\toffset' sidecar."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.flag == "r" and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+        elif self.flag == "w":
+            self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        if self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+        super().close()
+
+    def seek(self, idx):
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IndexedRecordIO = MXIndexedRecordIO
+
+IRHeader = collections.namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """ref: mx.recordio.pack — IRHeader + payload. Multi-label goes as a
+    float vector after the header (flag = label count)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        hdr = struct.pack(_IR_FORMAT, 0, float(header.label), header.id,
+                          header.id2)
+    else:
+        label = _np.asarray(header.label, dtype=_np.float32)
+        hdr = struct.pack(_IR_FORMAT, label.size, 0.0, header.id,
+                          header.id2) + label.tobytes()
+    return hdr + s
+
+
+def unpack(s):
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        label = _np.frombuffer(s[:flag * 4], dtype=_np.float32)
+        s = s[flag * 4:]
+    header = IRHeader(flag, label, id_, id2)
+    return header, s
+
+
+def _encode_img(img, fmt=".jpg", quality=95):
+    try:
+        from PIL import Image
+        import io as _io
+        if hasattr(img, "asnumpy"):
+            img = img.asnumpy()
+        im = Image.fromarray(_np.asarray(img).astype(_np.uint8))
+        buf = _io.BytesIO()
+        im.save(buf, format="JPEG" if fmt in (".jpg", ".jpeg") else "PNG",
+                quality=quality)
+        return buf.getvalue()
+    except ImportError:
+        # raw fallback: shape-prefixed uncompressed (decoder detects magic)
+        a = _np.asarray(img).astype(_np.uint8)
+        return b"RAWI" + struct.pack("<III", *(
+            a.shape if a.ndim == 3 else a.shape + (1,))) + a.tobytes()
+
+
+def _decode_img(buf, flag=1):
+    if buf[:4] == b"RAWI":
+        h, w, c = struct.unpack("<III", buf[4:16])
+        return _np.frombuffer(buf[16:], dtype=_np.uint8).reshape(h, w, c)
+    try:
+        from PIL import Image
+        import io as _io
+        im = Image.open(_io.BytesIO(buf))
+        if flag == 0:
+            im = im.convert("L")
+            return _np.asarray(im)[:, :, None]
+        im = im.convert("RGB")
+        return _np.asarray(im)
+    except ImportError:
+        raise IOError("cannot decode image: PIL unavailable and payload "
+                      "is not RAWI-framed")
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """ref: mx.recordio.pack_img."""
+    return pack(header, _encode_img(img, img_fmt, quality))
+
+
+def unpack_img(s, iscolor=1):
+    header, buf = unpack(s)
+    return header, _decode_img(buf, flag=iscolor)

@@ -1,0 +1,4 @@
+"""mx.kvstore namespace (ref: python/mxnet/kvstore/)."""
+from .kvstore import KVStore, create
+
+__all__ = ["KVStore", "create"]

@@ -1,0 +1,191 @@
+"""KVStore — key/value parameter store facade.
+
+TPU-native re-design of the reference KVStore
+(ref: include/mxnet/kvstore.h, src/kvstore/kvstore_local.h /
+kvstore_nccl.h / kvstore_dist.h).
+
+Semantics preserved: Init/Push/Pull/PushPull/Broadcast, optional
+server-side optimizer (`set_optimizer` → update runs "in the store"),
+`row_sparse_pull`, gradient-compression config.  Realisation differs by
+design (SURVEY §5.8): on TPU the reduce is an XLA collective (or a local
+add when arrays live on one chip), not NCCL rings or ps-lite RPC —
+`gluon.Trainer` code is unchanged.
+
+Types accepted for `create(name)`:
+  local/device/nccl — in-process reduction over per-device copies; on a
+      multi-chip mesh the reduce lowers to an ICI all-reduce.
+  dist_sync/dist_async/dist_sync_device — multi-host (jax.distributed)
+      data-parallel; in a single-process run they behave as `local` with
+      num_workers=1 (the multi-process path arrives with the DCN slice).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..optimizer import Optimizer, get_updater
+
+__all__ = ["KVStore", "create"]
+
+
+def _is_list(x):
+    return isinstance(x, (list, tuple))
+
+
+class KVStore:
+    """ref: kvstore.py KVStore (python facade over the C KVStore)."""
+
+    def __init__(self, kv_type: str = "local"):
+        self.type = kv_type
+        self._store: Dict = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = {}
+
+    # ------------------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return jax.process_index() if self.type.startswith("dist") else 0
+
+    @property
+    def num_workers(self) -> int:
+        return jax.process_count() if self.type.startswith("dist") else 1
+
+    # ------------------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                continue
+            vv = v[0] if _is_list(v) else v
+            self._store[k] = vv.copy() if isinstance(vv, NDArray) else \
+                NDArray(vv)
+
+    broadcast = init
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("key %r not initialised" % (k,))
+            agg = self._reduce(v)
+            if self._updater is not None:
+                # server-side optimizer (ref: kvstore_dist_server.h
+                # DataHandleEx → updater(key, grad, weight))
+                self._updater(self._int_key(k), agg, self._store[k])
+            else:
+                self._store[k]._data = self._store[k]._data + agg._data
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r not initialised" % (k,))
+            src = self._store[k]
+            for dst in (o if _is_list(o) else [o]):
+                dst._data = jax.device_put(src._data,
+                                           dst.context.jax_device)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused allreduce (ref: KVStoreNCCL::PushPull — grouped
+        ncclAllReduce ≙ one XLA all-reduce / local tree add)."""
+        keys, values = self._normalize(key, value)
+        if out is None:
+            out = value
+        _, outs = self._normalize(key, out)
+        for k, v, o in zip(keys, values, outs):
+            agg = self._reduce(v)
+            for dst in (o if _is_list(o) else [o]):
+                dst._data = jax.device_put(agg._data, dst.context.jax_device)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in `row_ids` (ref: sparse kvstore pull for
+        row_sparse embeddings)."""
+        keys, outs = self._normalize(key, out)
+        _, rids = self._normalize(key, row_ids)
+        for k, o, r in zip(keys, outs, rids):
+            src = self._store[k]
+            rows = (r if not _is_list(r) else r[0])._data.astype(jnp.int32)
+            vals = jnp.take(src._data, rows, axis=0)
+            for dst in (o if _is_list(o) else [o]):
+                dst._data = jax.device_put(
+                    jnp.zeros(src.shape, src._data.dtype)
+                    .at[rows].set(vals), dst.context.jax_device)
+
+    # ------------------------------------------------------------------
+    def set_optimizer(self, optimizer: Optimizer):
+        self._optimizer = optimizer
+        self._updater = get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        """ref: gradient_compression.h 2-bit quantisation. Recorded; the
+        DCN payload-compression path lands with multi-host support."""
+        self._compression = dict(compression_params)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("optimizer not set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("optimizer not set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def _barrier(self):
+        pass
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _normalize(key, value):
+        if _is_list(key):
+            return list(key), list(value)
+        return [key], [value]
+
+    @staticmethod
+    def _int_key(k):
+        try:
+            return int(k)
+        except (TypeError, ValueError):
+            return k
+
+    @staticmethod
+    def _reduce(v) -> NDArray:
+        """Sum a list of per-device arrays.  Single-host: adds go through
+        XLA on whichever chip holds the first copy; multi-chip meshes use
+        in-executable psum via the parallel/ module instead."""
+        if not _is_list(v):
+            return v
+        if len(v) == 1:
+            return v[0]
+        dev = v[0]._data.sharding.device_set if hasattr(
+            v[0]._data, "sharding") else None
+        acc = v[0]._data
+        for x in v[1:]:
+            xd = x._data
+            if dev is not None and hasattr(xd, "sharding") and \
+                    xd.sharding.device_set != dev:
+                xd = jax.device_put(xd, list(dev)[0])
+            acc = acc + xd
+        return NDArray(acc, ctx=v[0].context)
+
+
+_TYPES = ("local", "device", "nccl", "dist_sync", "dist_async",
+          "dist_sync_device", "dist_async_device", "horovod", "p3store_dist")
+
+
+def create(name: str = "local") -> KVStore:
+    """ref: KVStore::Create."""
+    if name not in _TYPES:
+        raise MXNetError("unknown kvstore type %r" % name)
+    return KVStore(name)

@@ -1,0 +1,4 @@
+"""mx.module namespace (ref: python/mxnet/module/) — legacy symbolic API."""
+from .module import Module, BucketingModule, BaseModule
+
+__all__ = ["Module", "BucketingModule", "BaseModule"]

@@ -1,0 +1,405 @@
+"""Module API (legacy symbolic training interface).
+
+TPU-native re-design of ref: python/mxnet/module/{base_module,module,
+bucketing_module}.py.  A Module binds a Symbol into an Executor (one
+jitted forward + one vjp executable) and drives fit/forward/backward/
+update.  BucketingModule keeps one Module per bucket key; the reference's
+shared_buffer memory-sharing trick is subsumed by the jit cache + XLA
+buffer assignment (SURVEY §5.7).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import cpu, Context
+from ..ndarray.ndarray import NDArray
+from .. import ndarray as nd
+from .. import metric as metric_mod
+from .. import optimizer as opt_mod
+from ..initializer import Uniform
+
+__all__ = ["BaseModule", "Module", "BucketingModule"]
+
+
+class BaseModule:
+    def __init__(self, logger=logging):
+        self.logger = logger
+        self.binded = False
+        self.for_training = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    # -- high-level train/eval loops (ref: base_module.py fit/score) -------
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=Uniform(0.01), arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None, sparse_row_id_fn=None):
+        assert num_epoch is not None, "please specify num_epoch"
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if validation_metric is None:
+            validation_metric = eval_metric
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+
+        for epoch in range(begin_epoch, num_epoch):
+            eval_metric.reset()
+            nbatch = 0
+            train_data.reset()
+            for data_batch in train_data:
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                if batch_end_callback is not None:
+                    for cb in _as_list(batch_end_callback):
+                        cb(_BatchEndParam(epoch, nbatch, eval_metric))
+                nbatch += 1
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            if epoch_end_callback is not None:
+                arg_params, aux_params = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_params, aux_params)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
+
+    def score(self, eval_data, eval_metric, num_batch=None, reset=True):
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        eval_metric.reset()
+        for i, eval_batch in enumerate(eval_data):
+            if num_batch is not None and i == num_batch:
+                break
+            self.forward(eval_batch, is_train=False)
+            self.update_metric(eval_metric, eval_batch.label)
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, reset=True):
+        if reset:
+            eval_data.reset()
+        outputs = []
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outputs.append([o.copy() for o in self.get_outputs()])
+        if not outputs:
+            return []
+        num_out = len(outputs[0])
+        cat = []
+        for j in range(num_out):
+            parts = [o[j] for o in outputs]
+            cat.append(nd.concat(*parts, dim=0)
+                       if len(parts) > 1 else parts[0])
+        return cat if num_out > 1 else cat[0]
+
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self.get_outputs())
+
+    # -- abstract ----------------------------------------------------------
+    def bind(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def init_params(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class _BatchEndParam:
+    def __init__(self, epoch, nbatch, eval_metric):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = None
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class Module(BaseModule):
+    """ref: module.Module — single-symbol module."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        ctx = context or cpu()
+        self._context = ctx if isinstance(ctx, Context) else ctx[0]
+        self._fixed_param_names = set(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._data_shapes = None
+        self._label_shapes = None
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    # ------------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        shapes = {}
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+        for desc in data_shapes:
+            name, shape = desc[0], desc[1]
+            shapes[name] = shape
+        if label_shapes:
+            for desc in label_shapes:
+                shapes[desc[0]] = desc[1]
+        args = {}
+        arg_shapes, _, _ = self._symbol.infer_shape(**shapes)
+        for name, shape in zip(self._symbol.list_arguments(), arg_shapes):
+            args[name] = nd.zeros(shape, ctx=self._context)
+        args_grad = None
+        if for_training:
+            args_grad = {n: nd.zeros(args[n].shape, ctx=self._context)
+                         for n in self._param_names
+                         if n not in self._fixed_param_names}
+        self._exec = self._symbol.bind(self._context, args, args_grad,
+                                       grad_req)
+        self.binded = True
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        assert self.binded
+        if self.params_initialized and not force_init:
+            return
+        from ..initializer import InitDesc, create
+        initializer = create(initializer) if initializer is not None \
+            and not callable(initializer) else initializer
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arr._data = arg_params[name]._data
+            elif initializer is not None:
+                initializer(InitDesc(name), arr)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **dict(optimizer_params))
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed[name] = arr
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        assert self.binded
+        self._exec.backward(out_grads)
+
+    def update(self):
+        assert self.optimizer_initialized
+        for i, name in enumerate(self._param_names):
+            if name in self._fixed_param_names:
+                continue
+            if name not in self._exec.grad_dict:
+                continue
+            self._updater(i, self._exec.grad_dict[name],
+                          self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def get_params(self):
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        return arg, {}
+
+    def set_params(self, arg_params, aux_params=None, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._symbol.save("%s-symbol.json" % prefix)
+        arg_params, _ = self.get_params()
+        nd.save("%s-%04d.params" % (prefix, epoch),
+                {("arg:%s" % k): v for k, v in arg_params.items()})
+
+    @staticmethod
+    def load_checkpoint(prefix, epoch):
+        from ..symbol import load as sym_load
+        symbol = sym_load("%s-symbol.json" % prefix)
+        saved = nd.load("%s-%04d.params" % (prefix, epoch))
+        arg_params = {k[4:]: v for k, v in saved.items()
+                      if k.startswith("arg:")}
+        aux_params = {k[4:]: v for k, v in saved.items()
+                      if k.startswith("aux:")}
+        return symbol, arg_params, aux_params
+
+
+class BucketingModule(BaseModule):
+    """ref: module.BucketingModule — per-bucket Modules (Sockeye config).
+
+    Jit caching per shape plays the reference's shared-buffer role: each
+    bucket key compiles once; XLA reuses buffers across executables.
+    """
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger)
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets: Dict = {}
+        self._curr_module: Optional[Module] = None
+        self._curr_bucket_key = None
+        self._opt_args = None
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+    def _gen_module(self, bucket_key):
+        symbol, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(symbol, data_names, label_names, self.logger,
+                      self._context,
+                      fixed_param_names=self._fixed_param_names)
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        if bucket_key not in self._buckets:
+            module = self._gen_module(bucket_key)
+            module.bind(data_shapes, label_shapes, self.for_training)
+            if self._curr_module is not None and \
+                    self._curr_module.params_initialized:
+                arg_params, aux_params = self._curr_module.get_params()
+                module.set_params(arg_params, aux_params,
+                                  allow_missing=True)
+            self._buckets[bucket_key] = module
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        self.for_training = for_training
+        self.switch_bucket(self._default_bucket_key, data_shapes,
+                           label_shapes)
+        self.binded = True
+
+    def init_params(self, **kwargs):
+        self._curr_module.init_params(**kwargs)
+        self.params_initialized = True
+
+    def init_optimizer(self, **kwargs):
+        self._opt_args = kwargs
+        for m in self._buckets.values():
+            m.init_optimizer(**kwargs)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        data_shapes = [(n, a.shape) for n, a in
+                       zip(self._curr_module._data_names
+                           if self._curr_module else ["data"],
+                           data_batch.data)]
+        label_shapes = None
+        if data_batch.label:
+            label_shapes = [(n, a.shape) for n, a in
+                            zip(self._curr_module._label_names
+                                if self._curr_module else ["softmax_label"],
+                                data_batch.label)]
+        key = data_batch.bucket_key
+        prev = self._curr_module
+        self.switch_bucket(key, data_shapes, label_shapes)
+        if prev is not None and prev is not self._curr_module and \
+                prev.params_initialized:
+            arg_params, aux_params = prev.get_params()
+            self._curr_module.set_params(arg_params, aux_params,
+                                         allow_missing=True)
+        if not self._curr_module.params_initialized and \
+                self.params_initialized:
+            self._curr_module.init_params()
+        if self.optimizer_initialized and \
+                not self._curr_module.optimizer_initialized and \
+                self._opt_args is not None:
+            self._curr_module.init_optimizer(**self._opt_args)
+        self._curr_module.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels)

@@ -1,0 +1,192 @@
+"""`mx.nd` namespace: NDArray + generated op stubs + creation helpers.
+
+The reference generates Python op stubs at import time from the C-API op
+registry (ref: python/mxnet/ndarray/register.py).  The same pattern here:
+at import, every OpDef in the registry gets a module-level function that
+dispatches through `invoke` — one source of truth for imperative, symbol
+and Gluon layers.
+"""
+from __future__ import annotations
+
+import functools as _functools
+import json as _json
+import struct as _struct
+import sys as _sys
+import types as _types
+
+import numpy as _np
+
+from ..base import dtype_np, MXNetError
+from ..context import current_context
+from ..ops import registry as _registry
+from .ndarray import NDArray, invoke, apply_fn, array, from_jax
+
+__all__ = ["NDArray", "array", "invoke", "zeros", "ones", "full", "empty",
+           "arange", "linspace", "eye", "save", "load", "waitall",
+           "from_jax", "concat", "stack", "random"]
+
+
+# ---------------------------------------------------------------------------
+# generated op stubs (ref: _make_ndarray_function in register.py)
+# ---------------------------------------------------------------------------
+
+def _make_stub(opname):
+    od = _registry.get(opname)
+
+    @_functools.wraps(od.fn)
+    def stub(*args, **kwargs):
+        return invoke(opname, *args, **kwargs)
+    stub.__name__ = opname
+    stub.__qualname__ = opname
+    stub.__doc__ = od.doc
+    return stub
+
+
+_this = _sys.modules[__name__]
+for _opname in _registry.list_ops():
+    if not hasattr(_this, _opname):
+        setattr(_this, _opname, _make_stub(_opname))
+
+
+# ---------------------------------------------------------------------------
+# creation helpers (ref: python/mxnet/ndarray/ndarray.py zeros/ones/...)
+# ---------------------------------------------------------------------------
+
+def zeros(shape, ctx=None, dtype="float32"):
+    return invoke("_zeros", shape=_tuple(shape), dtype=dtype,
+                  ctx=ctx or current_context())
+
+
+def ones(shape, ctx=None, dtype="float32"):
+    return invoke("_ones", shape=_tuple(shape), dtype=dtype,
+                  ctx=ctx or current_context())
+
+
+def full(shape, val, ctx=None, dtype="float32"):
+    return invoke("_full", shape=_tuple(shape), value=val, dtype=dtype,
+                  ctx=ctx or current_context())
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    return invoke("_arange", start=start, stop=stop, step=step,
+                  repeat=repeat, dtype=dtype, ctx=ctx or current_context())
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32"):
+    return invoke("_linspace", start=start, stop=stop, num=num,
+                  endpoint=endpoint, dtype=dtype,
+                  ctx=ctx or current_context())
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32"):
+    return invoke("_eye", N=N, M=M, k=k, dtype=dtype,
+                  ctx=ctx or current_context())
+
+
+def _tuple(shape):
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def waitall():
+    from .. import engine
+    engine.wait_all()
+
+
+# ---------------------------------------------------------------------------
+# save / load (ref: src/ndarray/ndarray.cc NDArray::Save/Load, magic 0x112)
+# ---------------------------------------------------------------------------
+# Binary layout: magic(u64)=0x112 | version(u64)=1 | json header length +
+# header {names, dtypes, shapes} | raw little-endian buffers.  Same API
+# (list or dict of NDArray); byte-level compat with the reference format is
+# tracked as a follow-up (needs the mount populated to verify framing).
+
+_MAGIC = 0x112
+
+
+def save(fname, data):
+    if isinstance(data, NDArray):
+        names, arrays = [""], [data]
+    elif isinstance(data, dict):
+        names, arrays = list(data.keys()), list(data.values())
+    else:
+        names, arrays = [""] * len(data), list(data)
+    header = {"names": names,
+              "dtypes": [str(a.dtype) for a in arrays],
+              "shapes": [list(a.shape) for a in arrays]}
+    hb = _json.dumps(header).encode()
+    with open(fname, "wb") as f:
+        f.write(_struct.pack("<QQQ", _MAGIC, 1, len(hb)))
+        f.write(hb)
+        for a in arrays:
+            buf = _np.ascontiguousarray(a.asnumpy())
+            f.write(buf.tobytes())
+
+
+def load(fname, ctx=None):
+    with open(fname, "rb") as f:
+        magic, version, hlen = _struct.unpack("<QQQ", f.read(24))
+        if magic != _MAGIC:
+            raise MXNetError("invalid NDArray file %r" % fname)
+        header = _json.loads(f.read(hlen).decode())
+        arrays = []
+        for dt, shp in zip(header["dtypes"], header["shapes"]):
+            d = dtype_np(dt)
+            n = int(_np.prod(shp)) if shp else 1
+            buf = f.read(n * d.itemsize)
+            a = _np.frombuffer(buf, dtype=d).reshape(shp)
+            arrays.append(array(a, ctx=ctx, dtype=d))
+    names = header["names"]
+    if any(names):
+        return dict(zip(names, arrays))
+    if len(arrays) == 1 and not names[0]:
+        return arrays
+    return arrays
+
+
+# ---------------------------------------------------------------------------
+# nd.random namespace (ref: python/mxnet/ndarray/random.py)
+# ---------------------------------------------------------------------------
+
+random = _types.ModuleType(__name__ + ".random")
+
+
+def _rand_stub(public, internal, sample_internal=None):
+    def fn(*args, **kwargs):
+        arr_args = [a for a in args if isinstance(a, NDArray)] \
+            or [v for v in kwargs.values() if isinstance(v, NDArray)]
+        if sample_internal is not None and arr_args:
+            return invoke(sample_internal, *args, **kwargs)
+        return invoke(internal, *args, **kwargs)
+    fn.__name__ = public
+    return fn
+
+
+random.uniform = _rand_stub("uniform", "_random_uniform", "_sample_uniform")
+random.normal = _rand_stub("normal", "_random_normal", "_sample_normal")
+random.gamma = _rand_stub("gamma", "_random_gamma", "_sample_gamma")
+random.exponential = _rand_stub("exponential", "_random_exponential")
+random.poisson = _rand_stub("poisson", "_random_poisson")
+random.negative_binomial = _rand_stub("negative_binomial",
+                                      "_random_negative_binomial")
+random.generalized_negative_binomial = _rand_stub(
+    "generalized_negative_binomial",
+    "_random_generalized_negative_binomial")
+random.randint = _rand_stub("randint", "_random_randint")
+random.multinomial = _rand_stub("multinomial", "_sample_multinomial")
+random.shuffle = _rand_stub("shuffle", "_shuffle")
+_sys.modules[random.__name__] = random
+
+
+def uniform(low=0.0, high=1.0, shape=(), ctx=None, dtype="float32", **kw):
+    return invoke("_random_uniform", low=low, high=high, shape=_tuple(shape),
+                  dtype=dtype, ctx=ctx or current_context(), **kw)
+
+
+def normal(loc=0.0, scale=1.0, shape=(), ctx=None, dtype="float32", **kw):
+    return invoke("_random_normal", loc=loc, scale=scale,
+                  shape=_tuple(shape), dtype=dtype,
+                  ctx=ctx or current_context(), **kw)

@@ -1,0 +1,157 @@
+"""Sparse NDArray types (row_sparse / csr).
+
+TPU-native equivalent of the reference sparse storage types
+(ref: include/mxnet/ndarray.h kRowSparseStorage/kCSRStorage,
+src/operator/tensor/cast_storage-inl.h).  XLA has no native sparse
+support, so (per SURVEY §7.2) row_sparse is an (indices, values) pair and
+csr an (indptr, indices, values) triple; kernels are gather/scatter +
+segment-sum.  Full implementation lands with the Wide&Deep slice — this
+module currently provides the types, conversion, and dense bridging.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import current_context
+from .ndarray import NDArray
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "cast_storage",
+           "row_sparse_array", "csr_matrix"]
+
+
+class RowSparseNDArray:
+    """(indices, values) pair: values[i] is the dense row indices[i].
+
+    ref: RowSparse storage — used for embedding gradients and sparse
+    optimizer updates (lazy_update path)."""
+
+    stype = "row_sparse"
+
+    def __init__(self, indices, values, shape, ctx=None):
+        self.indices = indices if isinstance(indices, NDArray) \
+            else NDArray(_np.asarray(indices, dtype=_np.int64), ctx=ctx)
+        self.data = values if isinstance(values, NDArray) \
+            else NDArray(values, ctx=ctx)
+        self._shape = tuple(shape)
+        self._ctx = ctx or current_context()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def context(self):
+        return self._ctx
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            dense = jnp.zeros(self._shape, self.data._data.dtype)
+            dense = dense.at[self.indices._data].set(self.data._data)
+            return NDArray(dense, ctx=self._ctx)
+        raise MXNetError("unsupported stype %r" % stype)
+
+    def asnumpy(self):
+        return self.tostype("default").asnumpy()
+
+    def __repr__(self):
+        return "<RowSparseNDArray %s, %d stored rows>" % (
+            "x".join(map(str, self._shape)), self.indices.shape[0])
+
+
+class CSRNDArray:
+    """CSR matrix: (indptr, indices, data). ref: kCSRStorage."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        self.data = data if isinstance(data, NDArray) else NDArray(data, ctx=ctx)
+        self.indices = indices if isinstance(indices, NDArray) \
+            else NDArray(_np.asarray(indices, dtype=_np.int64), ctx=ctx)
+        self.indptr = indptr if isinstance(indptr, NDArray) \
+            else NDArray(_np.asarray(indptr, dtype=_np.int64), ctx=ctx)
+        self._shape = tuple(shape)
+        self._ctx = ctx or current_context()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def context(self):
+        return self._ctx
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            n, m = self._shape
+            indptr = self.indptr.asnumpy()
+            rows = _np.repeat(_np.arange(n), _np.diff(indptr))
+            dense = jnp.zeros(self._shape, self.data._data.dtype)
+            dense = dense.at[rows, self.indices._data].set(self.data._data)
+            return NDArray(dense, ctx=self._ctx)
+        raise MXNetError("unsupported stype %r" % stype)
+
+    def asnumpy(self):
+        return self.tostype("default").asnumpy()
+
+    def __repr__(self):
+        return "<CSRNDArray %s, nnz=%d>" % (
+            "x".join(map(str, self._shape)), self.data.shape[0])
+
+
+def cast_storage(arr, stype):
+    """ref: cast_storage op."""
+    if isinstance(arr, (RowSparseNDArray, CSRNDArray)):
+        return arr.tostype(stype)
+    if stype == "default":
+        return arr
+    a = arr.asnumpy()
+    if stype == "row_sparse":
+        nz = _np.where(_np.any(a != 0, axis=tuple(range(1, a.ndim))))[0]
+        return RowSparseNDArray(nz.astype(_np.int64), a[nz], a.shape,
+                                ctx=arr.context)
+    if stype == "csr":
+        if a.ndim != 2:
+            raise MXNetError("csr requires 2-D")
+        indptr = [0]
+        indices, data = [], []
+        for r in a:
+            nz = _np.where(r != 0)[0]
+            indices.extend(nz.tolist())
+            data.extend(r[nz].tolist())
+            indptr.append(len(indices))
+        return CSRNDArray(_np.asarray(data, a.dtype),
+                          _np.asarray(indices, _np.int64),
+                          _np.asarray(indptr, _np.int64), a.shape,
+                          ctx=arr.context)
+    raise MXNetError("unknown stype %r" % stype)
+
+
+def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
+    if isinstance(arg, tuple) and len(arg) == 2:
+        values, indices = arg
+        return RowSparseNDArray(indices, values, shape, ctx=ctx)
+    dense = NDArray(arg, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg, shape=None, ctx=None, dtype=None):
+    if isinstance(arg, tuple) and len(arg) == 3:
+        data, indices, indptr = arg
+        return CSRNDArray(data, indices, indptr, shape, ctx=ctx)
+    dense = NDArray(arg, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "csr")

@@ -1,0 +1,17 @@
+"""Operator registry + all built-in operator groups.
+
+Importing this package populates the registry (the analogue of the
+reference's static NNVM_REGISTER_OP initialisers linked into libmxnet.so).
+"""
+from . import registry
+from .registry import register, get, list_ops, alias, OpDef
+
+# op groups — import order irrelevant; each registers into the registry
+from . import tensor          # noqa: F401
+from . import nn              # noqa: F401
+from . import random          # noqa: F401
+from . import optimizer       # noqa: F401
+from . import control_flow    # noqa: F401
+from . import rnn             # noqa: F401
+
+__all__ = ["register", "get", "list_ops", "alias", "OpDef", "registry"]

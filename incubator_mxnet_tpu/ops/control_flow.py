@@ -1,0 +1,102 @@
+"""Control-flow operators.
+
+TPU-native equivalent of the reference higher-order control-flow ops
+(ref: src/operator/control_flow.cc — `_foreach`, `_while_loop`, `_cond`
+taking subgraphs).  These map directly onto `lax.scan` / `lax.while_loop`
+/ `lax.cond`, which is exactly the compiler-friendly structure XLA wants
+(SURVEY §2.2: "maps beautifully to lax.scan/while/cond").
+
+The API here is functional (callables, not Symbols): the Gluon/symbol
+layers pass traced callables in.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("foreach", ndarray_inputs=None)
+def foreach(body, data, init_states):
+    """Scan `body(x_t, states) -> (out_t, new_states)` over axis 0 of data.
+
+    `data` may be one array or a list; same for states. Returns
+    (stacked outputs, final states).
+    """
+    multi_data = isinstance(data, (list, tuple))
+    multi_state = isinstance(init_states, (list, tuple))
+    xs = tuple(data) if multi_data else (data,)
+    init = tuple(init_states) if multi_state else (init_states,)
+
+    def step(carry, x):
+        xa = x if multi_data else x[0]
+        out, new_states = body(xa, list(carry) if multi_state else carry[0])
+        ns = tuple(new_states) if multi_state else (new_states,)
+        return ns, out
+
+    final, outs = lax.scan(step, init, xs)
+    return outs, (list(final) if multi_state else final[0])
+
+
+@register("while_loop", ndarray_inputs=None)
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """ref: `_while_loop`. `func(vars) -> (step_output, new_vars)`.
+
+    The reference stacks per-step outputs up to `max_iterations` with a
+    valid-length; on TPU dynamic output length is not jittable, so outputs
+    are padded to `max_iterations` (zeros beyond the exit step) and the
+    actual iteration count is returned — the documented TPU convention
+    (pad + mask, SURVEY §7.2 dynamic shapes).
+    """
+    multi = isinstance(loop_vars, (list, tuple))
+    lv = tuple(loop_vars) if multi else (loop_vars,)
+
+    if max_iterations is None:
+        def c(state):
+            return cond(list(state) if multi else state[0])
+
+        def b(state):
+            _, new = func(list(state) if multi else state[0])
+            return tuple(new) if multi else (new,)
+        out = lax.while_loop(c, b, lv)
+        return None, (list(out) if multi else out[0])
+
+    # padded scan version with per-step outputs
+    sample_out, _ = jax.eval_shape(
+        lambda s: func(list(s) if multi else s[0]), lv)
+
+    def step(carry, _):
+        state, t, active = carry
+        pred = jnp.logical_and(active,
+                               cond(list(state) if multi else state[0]))
+
+        def run(s):
+            o, n = func(list(s) if multi else s[0])
+            return o, (tuple(n) if multi else (n,))
+
+        def skip(s):
+            z = jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape, a.dtype), sample_out)
+            return z, s
+        out, new_state = lax.cond(pred, run, skip, state)
+        return (new_state, t + jnp.asarray(pred, jnp.int32), pred), out
+
+    (final, count, _), outs = lax.scan(
+        step, (lv, jnp.zeros((), jnp.int32), jnp.asarray(True)),
+        None, length=int(max_iterations))
+    return outs, (list(final) if multi else final[0])
+
+
+@register("cond", ndarray_inputs=None)
+def cond(pred, then_func, else_func, inputs):
+    """ref: `_cond`. Both branches trace; XLA picks at runtime."""
+    multi = isinstance(inputs, (list, tuple))
+    iv = tuple(inputs) if multi else (inputs,)
+    p = pred(list(iv) if multi else iv[0]) if callable(pred) else pred
+    p = jnp.reshape(jnp.asarray(p, bool), ())
+    return lax.cond(p,
+                    lambda s: then_func(list(s) if multi else s[0]),
+                    lambda s: else_func(list(s) if multi else s[0]),
+                    iv)

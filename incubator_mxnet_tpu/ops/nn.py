@@ -1,0 +1,508 @@
+"""Neural-network operators (pure-JAX bodies).
+
+TPU-native equivalents of the reference NN op group
+(ref: src/operator/nn/{convolution,fully_connected,batch_norm,layer_norm,
+pooling,activation,dropout,softmax}* and their cuDNN fast paths under
+src/operator/nn/cudnn/).  On TPU there is no per-op kernel library to
+wrap: each body lowers to XLA (conv → MXU convolution, norms/activations
+fused by XLA), which *is* the cuDNN-equivalent fast path.  Layout is kept
+NCHW at the API for parity; XLA relayouts internally for the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+from jax import lax
+
+from .registry import register, alias
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected / Dense
+# ---------------------------------------------------------------------------
+
+
+@register("FullyConnected", ndarray_inputs=("data", "weight", "bias"))
+def fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
+                    flatten=True):
+    """ref: src/operator/nn/fully_connected-inl.h FullyConnectedOp.
+    weight is (num_hidden, in_units) as in the reference; the matmul is the
+    MXU hot path — XLA fuses the bias add."""
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = jnp.matmul(data, weight.T)
+    if not no_bias and bias is not None:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ---------------------------------------------------------------------------
+
+def _conv_dim_numbers(ndim):
+    if ndim == 3:
+        return ("NCH", "OIH", "NCH")
+    if ndim == 4:
+        return ("NCHW", "OIHW", "NCHW")
+    if ndim == 5:
+        return ("NCDHW", "OIDHW", "NCDHW")
+    raise ValueError("conv expects 3/4/5-d input")
+
+
+@register("Convolution", ndarray_inputs=("data", "weight", "bias"))
+def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                pad=(), num_filter=0, num_group=1, no_bias=False,
+                workspace=1024, cudnn_tune=None, cudnn_off=False,
+                layout=None):
+    """ref: src/operator/nn/convolution-inl.h ConvolutionOp (cuDNN path:
+    nn/cudnn/cudnn_convolution-inl.h).  Direct map to
+    lax.conv_general_dilated; `workspace`/`cudnn_*` knobs accepted and
+    ignored (XLA autotunes)."""
+    nd = data.ndim
+    k = len(kernel) if kernel else nd - 2
+    stride = tuple(stride) if stride else (1,) * k
+    dilate = tuple(dilate) if dilate else (1,) * k
+    pad = tuple(pad) if pad else (0,) * k
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    _conv_dim_numbers(nd))
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=None)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * (nd - 2))
+    return out
+
+
+@register("Deconvolution", ndarray_inputs=("data", "weight", "bias"))
+def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                  pad=(), adj=(), target_shape=(), num_filter=0, num_group=1,
+                  no_bias=True, workspace=512, cudnn_tune=None,
+                  cudnn_off=False, layout=None):
+    """ref: src/operator/nn/deconvolution-inl.h — gradient of conv w.r.t.
+    input, i.e. transposed convolution."""
+    nd = data.ndim
+    k = len(kernel) if kernel else nd - 2
+    stride = tuple(stride) if stride else (1,) * k
+    dilate = tuple(dilate) if dilate else (1,) * k
+    pad = tuple(pad) if pad else (0,) * k
+    adj = tuple(adj) if adj else (0,) * k
+    # weight layout (in_channel, out_channel/group, *kernel) as reference
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    _conv_dim_numbers(nd))
+    pads = []
+    for i in range(k):
+        kk = (weight.shape[2 + i] - 1) * dilate[i] + 1
+        pads.append((kk - 1 - pad[i], kk - 1 - pad[i] + adj[i]))
+    if num_group != 1:
+        raise NotImplementedError("grouped deconvolution")
+    w = jnp.swapaxes(weight, 0, 1)
+    w = jnp.flip(w, axis=tuple(range(2, nd)))
+    out = lax.conv_general_dilated(
+        data, w, window_strides=(1,) * k, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * (nd - 2))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+
+@register("Pooling", ndarray_inputs=("data",))
+def pooling(data, kernel=(), pool_type="max", global_pool=False,
+            cudnn_off=False, pooling_convention="valid", stride=(), pad=(),
+            p_value=2, count_include_pad=True, layout=None):
+    """ref: src/operator/nn/pooling-inl.h PoolingOp.  Reduce-window on XLA.
+    `pooling_convention='full'` (ceil) kept for parity with legacy nets."""
+    nd = data.ndim
+    k = nd - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * k
+        pad = (0,) * k
+    kernel = tuple(kernel)
+    stride = tuple(stride) if stride else (1,) * k
+    pad = tuple(pad) if pad else (0,) * k
+
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    if pooling_convention == "full":
+        # ceil-mode: pad high edge enough that ceil division is covered
+        extra = []
+        for i in range(k):
+            in_sz = data.shape[2 + i] + 2 * pad[i]
+            out_sz = -(-(in_sz - kernel[i]) // stride[i]) + 1
+            need = (out_sz - 1) * stride[i] + kernel[i] - in_sz
+            extra.append(max(0, need))
+        pads = ((0, 0), (0, 0)) + tuple(
+            (pad[i], pad[i] + extra[i]) for i in range(k))
+    else:
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+
+    # NOTE: init values must be python scalars — jax only recognises the
+    # differentiable monoid reducers (reduce_window_max/sum) for scalar
+    # identities; array inits fall back to the non-differentiable generic.
+    if pool_type == "max":
+        init = -_np.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else int(jnp.iinfo(data.dtype).min)
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        summed = lax.reduce_window(data, 0.0 if jnp.issubdtype(
+            data.dtype, jnp.floating) else 0, lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad:
+            denom = _np.prod(kernel)
+            return summed / jnp.asarray(denom, data.dtype)
+        ones = jnp.ones_like(data)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides,
+                                   pads)
+        return summed / counts
+    if pool_type == "lp":
+        powd = jnp.power(jnp.abs(data), p_value)
+        summed = lax.reduce_window(powd, 0.0, lax.add, window, strides,
+                                   pads)
+        return jnp.power(summed, 1.0 / p_value)
+    raise ValueError(pool_type)
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+
+
+@register("Activation", ndarray_inputs=("data",))
+def activation(data, act_type="relu"):
+    """ref: src/operator/nn/activation-inl.h."""
+    if act_type == "relu":
+        return jax.nn.relu(data)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(data)
+    raise ValueError(act_type)
+
+
+@register("LeakyReLU", ndarray_inputs=("data", "gamma"))
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334):
+    """ref: src/operator/leaky_relu-inl.h — leaky/prelu/elu/selu/gelu/rrelu."""
+    if act_type == "leaky":
+        return jax.nn.leaky_relu(data, slope)
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) \
+            if gamma is not None and gamma.ndim == 1 and data.ndim > 2 else gamma
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        return jax.nn.selu(data)
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":   # eval-mode deterministic slope
+        mid = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data >= 0, data, mid * data)
+    raise ValueError(act_type)
+
+
+@register("softmax", ndarray_inputs=("data",))
+def softmax(data, axis=-1, temperature=None, length=None, use_length=False,
+            dtype=None):
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    out = jax.nn.softmax(data, axis=axis)
+    if dtype is not None:
+        from ..base import dtype_np
+        out = out.astype(dtype_np(dtype))
+    return out
+
+
+@register("log_softmax", ndarray_inputs=("data",))
+def log_softmax(data, axis=-1, temperature=None, dtype=None):
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    out = jax.nn.log_softmax(data, axis=axis)
+    if dtype is not None:
+        from ..base import dtype_np
+        out = out.astype(dtype_np(dtype))
+    return out
+
+
+@register("softmin", ndarray_inputs=("data",))
+def softmin(data, axis=-1, temperature=None, dtype=None):
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    out = jax.nn.softmax(-data, axis=axis)
+    if dtype is not None:
+        from ..base import dtype_np
+        out = out.astype(dtype_np(dtype))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Normalisation
+# ---------------------------------------------------------------------------
+
+
+@register("BatchNorm",
+          ndarray_inputs=("data", "gamma", "beta", "moving_mean",
+                          "moving_var"),
+          num_outputs=3)
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False,
+               _training=True):
+    """ref: src/operator/nn/batch_norm-inl.h BatchNormOp.
+
+    Returns (out, batch_mean, batch_var). The imperative wrapper updates the
+    running stats (the reference mutates `moving_*` in-place inside the
+    kernel; here mutation lives at the NDArray layer, keeping the body pure
+    so it jits).  `fix_gamma=True` ⇒ gamma treated as 1 (reference default).
+    """
+    red = tuple(i for i in range(data.ndim) if i != axis)
+    bshape = tuple(data.shape[axis] if i == axis else 1
+                   for i in range(data.ndim))
+    if _training and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+    else:
+        mean, var = moving_mean, moving_var
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    inv = lax.rsqrt(var + eps)
+    out = (data - mean.reshape(bshape)) * (g * inv).reshape(bshape) \
+        + beta.reshape(bshape)
+    return out, mean, var
+
+
+@register("LayerNorm", ndarray_inputs=("data", "gamma", "beta"))
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    """ref: src/operator/nn/layer_norm-inl.h."""
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    bshape = tuple(data.shape[axis] if (i % data.ndim) == (axis % data.ndim)
+                   else 1 for i in range(data.ndim))
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("InstanceNorm", ndarray_inputs=("data", "gamma", "beta"))
+def instance_norm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("GroupNorm", ndarray_inputs=("data", "gamma", "beta"))
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    n, c = data.shape[:2]
+    g = num_groups
+    x = data.reshape((n, g, c // g) + data.shape[2:])
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    x = (x - mean) * lax.rsqrt(var + eps)
+    x = x.reshape(data.shape)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return x * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("LRN", ndarray_inputs=("data",))
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """ref: src/operator/nn/lrn-inl.h — local response norm across channels."""
+    sq = jnp.square(data)
+    half = nsize // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half)) + ((0, 0),) * (data.ndim - 2))
+    acc = jnp.zeros_like(data)
+    for i in range(nsize):
+        acc = acc + lax.dynamic_slice_in_dim(padded, i, data.shape[1], axis=1)
+    return data / jnp.power(knorm + alpha * acc / nsize, beta)
+
+
+# ---------------------------------------------------------------------------
+# Dropout (stateless threefry behind the stateful facade — ref:
+# src/operator/nn/dropout-inl.h; RNG design per SURVEY §7.2)
+# ---------------------------------------------------------------------------
+
+
+@register("Dropout", ndarray_inputs=("data",), needs_rng=True)
+def dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False,
+            _training=True, _rng_key=None):
+    if not _training and mode != "always":
+        return data
+    if p <= 0.0:
+        return data
+    shape = data.shape
+    if axes:
+        shape = tuple(1 if i in axes else s for i, s in enumerate(data.shape))
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(_rng_key, keep, shape).astype(data.dtype)
+    return data * mask / jnp.asarray(keep, data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Embedding
+# ---------------------------------------------------------------------------
+
+
+@register("Embedding", ndarray_inputs=("data", "weight"), nograd_argnums=(0,))
+def embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+              sparse_grad=False):
+    """ref: src/operator/tensor/indexing_op.h EmbeddingOp.  sparse_grad's
+    row_sparse gradient is realised at the autograd layer via segment-sum
+    (see ops/sparse.py)."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Losses / output ops
+# ---------------------------------------------------------------------------
+
+
+@register("SoftmaxOutput", ndarray_inputs=("data", "label"),
+          nograd_argnums=(1,))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False, preserve_shape=False,
+                   normalization="null", out_grad=False,
+                   smooth_alpha=0.0):
+    """ref: src/operator/softmax_output-inl.h.  Forward = softmax; the
+    custom backward (softmax − one_hot(label)) is registered via the
+    autograd layer's custom-grad hook in the NDArray stub."""
+    return jax.nn.softmax(data, axis=-1)
+
+
+@register("smooth_l1", ndarray_inputs=("data",))
+def smooth_l1(data, scalar=1.0):
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(data) < 1.0 / s2,
+                     0.5 * s2 * jnp.square(data),
+                     jnp.abs(data) - 0.5 / s2)
+
+
+@register("MakeLoss", ndarray_inputs=("data",))
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    return data
+
+
+@register("CTCLoss", ndarray_inputs=("data", "label"), nograd_argnums=(1,))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first"):
+    """ref: src/operator/contrib/ctc_loss-inl.h. Forward-backward in log
+    space via lax.scan over time — compiler-friendly (no host loop)."""
+    T, B, A = data.shape
+    logp = jax.nn.log_softmax(data, axis=-1)
+    L = label.shape[1]
+    blank = 0 if blank_label == "first" else A - 1
+    lab = label.astype(jnp.int32)
+    if blank_label == "last":
+        lab = lab  # labels already 0..A-2
+    # extended label seq: blank, l1, blank, l2, ... blank  (len 2L+1)
+    ext = jnp.full((B, 2 * L + 1), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    if use_label_lengths and label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        lab_len = jnp.sum((lab >= 0) & (lab != blank) if blank == 0
+                          else (lab >= 0), axis=1).astype(jnp.int32)
+        lab_len = jnp.sum(lab > 0, axis=1).astype(jnp.int32) if blank == 0 \
+            else lab_len
+    S = 2 * L + 1
+    ninf = jnp.asarray(-1e30, logp.dtype)
+
+    def emit(t_logp):   # (B, S) log prob of ext symbol at t
+        return jnp.take_along_axis(t_logp, ext, axis=1)
+
+    same = jnp.concatenate(
+        [jnp.zeros((B, 2), dtype=bool),
+         ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    a0 = jnp.full((B, S), ninf)
+    a0 = a0.at[:, 0].set(logp[0, :, blank])
+    a0 = a0.at[:, 1].set(jnp.take_along_axis(logp[0], ext[:, 1:2],
+                                             axis=1)[:, 0])
+
+    def step(alpha, t_logp):
+        shift1 = jnp.concatenate([jnp.full((B, 1), ninf), alpha[:, :-1]],
+                                 axis=1)
+        shift2 = jnp.concatenate([jnp.full((B, 2), ninf), alpha[:, :-2]],
+                                 axis=1)
+        shift2 = jnp.where(same, ninf, shift2)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2)
+        new = merged + emit(t_logp)
+        return new, None
+
+    if use_data_lengths and data_lengths is not None:
+        dl = data_lengths.astype(jnp.int32)
+
+        def stepm(carry, xs):
+            alpha, t = carry
+            t_logp = xs
+            new, _ = step(alpha, t_logp)
+            alpha = jnp.where((t < dl)[:, None], new, alpha)
+            return (alpha, t + 1), None
+        (alphaT, _), _ = lax.scan(stepm, (a0, jnp.ones((), jnp.int32)),
+                                  logp[1:])
+    else:
+        alphaT, _ = lax.scan(step, a0, logp[1:])
+    send = 2 * lab_len
+    p_end = jnp.take_along_axis(alphaT, send[:, None], axis=1)[:, 0]
+    p_end1 = jnp.take_along_axis(alphaT, jnp.maximum(send - 1, 0)[:, None],
+                                 axis=1)[:, 0]
+    return -jnp.logaddexp(p_end, p_end1)
+
+
+alias("CTCLoss", "ctc_loss")
+
+
+# ---------------------------------------------------------------------------
+# Up/Down sampling & resize
+# ---------------------------------------------------------------------------
+
+
+@register("UpSampling", ndarray_inputs=None)
+def upsampling(*data, scale=1, sample_type="nearest", num_args=1,
+               num_filter=0, multi_input_mode="concat", workspace=512):
+    """ref: src/operator/nn/upsampling-inl.h (nearest only; bilinear via
+    Deconvolution in the reference — here jax.image)."""
+    x = data[0]
+    n, c, h, w = x.shape
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+    else:
+        out = jax.image.resize(x, (n, c, h * scale, w * scale), "bilinear")
+    if len(data) > 1:
+        outs = [out]
+        for d in data[1:]:
+            s = h * scale // d.shape[2]
+            outs.append(jnp.repeat(jnp.repeat(d, s, axis=2), s, axis=3))
+        out = jnp.concatenate(outs, axis=1)
+    return out
+
+
+@register("GridGenerator", ndarray_inputs=("data",))
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    h, w = target_shape
+    if transform_type == "affine":
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        grid = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, HW)
+        theta = data.reshape(-1, 2, 3)
+        out = jnp.matmul(theta, grid)            # (N, 2, HW)
+        return out.reshape(-1, 2, h, w)
+    raise NotImplementedError(transform_type)

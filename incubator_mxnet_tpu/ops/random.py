@@ -1,0 +1,165 @@
+"""Random sampling operators.
+
+TPU-native equivalent of the reference random op group
+(ref: src/operator/random/sample_op.*, multisample_op.*, and the
+per-device PRNG Resource in src/common/random_generator.h).
+
+Design (SURVEY §7.2 "RNG semantics"): JAX threefry keys are stateless; the
+framework keeps a *stateful facade* — a per-context key in
+``incubator_mxnet_tpu.random`` that is split on every sampling call, so
+``mx.random.seed(n)`` gives the reference's reproducibility contract while
+each op body stays a pure function of an explicit `_rng_key`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, alias
+from ..base import dtype_np
+
+
+@register("_random_uniform", ndarray_inputs=(), differentiable=False,
+          needs_rng=True)
+def _random_uniform(low=0.0, high=1.0, shape=(), dtype="float32",
+                    _rng_key=None):
+    return jax.random.uniform(_rng_key, tuple(shape), dtype_np(dtype),
+                              minval=low, maxval=high)
+
+
+@register("_random_normal", ndarray_inputs=(), differentiable=False,
+          needs_rng=True)
+def _random_normal(loc=0.0, scale=1.0, shape=(), dtype="float32",
+                   _rng_key=None):
+    d = dtype_np(dtype)
+    return jax.random.normal(_rng_key, tuple(shape), d) * \
+        jnp.asarray(scale, d) + jnp.asarray(loc, d)
+
+
+@register("_random_gamma", ndarray_inputs=(), differentiable=False,
+          needs_rng=True)
+def _random_gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32",
+                  _rng_key=None):
+    d = dtype_np(dtype)
+    return jax.random.gamma(_rng_key, alpha, tuple(shape), d) * \
+        jnp.asarray(beta, d)
+
+
+@register("_random_exponential", ndarray_inputs=(), differentiable=False,
+          needs_rng=True)
+def _random_exponential(lam=1.0, shape=(), dtype="float32", _rng_key=None):
+    d = dtype_np(dtype)
+    return jax.random.exponential(_rng_key, tuple(shape), d) / \
+        jnp.asarray(lam, d)
+
+
+@register("_random_poisson", ndarray_inputs=(), differentiable=False,
+          needs_rng=True)
+def _random_poisson(lam=1.0, shape=(), dtype="float32", _rng_key=None):
+    out = jax.random.poisson(_rng_key, lam, tuple(shape))
+    return out.astype(dtype_np(dtype))
+
+
+@register("_random_randint", ndarray_inputs=(), differentiable=False,
+          needs_rng=True)
+def _random_randint(low=0, high=1, shape=(), dtype="int32", _rng_key=None):
+    return jax.random.randint(_rng_key, tuple(shape), int(low), int(high),
+                              dtype_np(dtype))
+
+
+@register("_random_negative_binomial", ndarray_inputs=(),
+          differentiable=False, needs_rng=True)
+def _random_negative_binomial(k=1, p=1.0, shape=(), dtype="float32",
+                              _rng_key=None):
+    k1, k2 = jax.random.split(_rng_key)
+    lam = jax.random.gamma(k1, float(k), tuple(shape)) * (1.0 - p) / p
+    out = jax.random.poisson(k2, lam, tuple(shape))
+    return out.astype(dtype_np(dtype))
+
+
+@register("_random_generalized_negative_binomial", ndarray_inputs=(),
+          differentiable=False, needs_rng=True)
+def _random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(),
+                                          dtype="float32", _rng_key=None):
+    k1, k2 = jax.random.split(_rng_key)
+    if alpha == 0.0:
+        out = jax.random.poisson(k1, mu, tuple(shape))
+    else:
+        r = 1.0 / alpha
+        lam = jax.random.gamma(k1, r, tuple(shape)) * (mu * alpha)
+        out = jax.random.poisson(k2, lam, tuple(shape))
+    return out.astype(dtype_np(dtype))
+
+
+# sample_* family: per-element distribution params (tensor inputs)
+
+@register("_sample_uniform", ndarray_inputs=("low", "high"),
+          differentiable=False, needs_rng=True)
+def _sample_uniform(low, high, shape=(), dtype="float32", _rng_key=None):
+    s = tuple(low.shape) + tuple(shape)
+    u = jax.random.uniform(_rng_key, s, dtype_np(dtype))
+    ext = low.reshape(low.shape + (1,) * len(shape))
+    exth = high.reshape(high.shape + (1,) * len(shape))
+    return ext + u * (exth - ext)
+
+
+@register("_sample_normal", ndarray_inputs=("mu", "sigma"),
+          differentiable=False, needs_rng=True)
+def _sample_normal(mu, sigma, shape=(), dtype="float32", _rng_key=None):
+    s = tuple(mu.shape) + tuple(shape)
+    n = jax.random.normal(_rng_key, s, dtype_np(dtype))
+    return mu.reshape(mu.shape + (1,) * len(shape)) + \
+        n * sigma.reshape(sigma.shape + (1,) * len(shape))
+
+
+@register("_sample_gamma", ndarray_inputs=("alpha", "beta"),
+          differentiable=False, needs_rng=True)
+def _sample_gamma(alpha, beta, shape=(), dtype="float32", _rng_key=None):
+    s = tuple(alpha.shape) + tuple(shape)
+    a = alpha.reshape(alpha.shape + (1,) * len(shape))
+    g = jax.random.gamma(_rng_key, jnp.broadcast_to(a, s), dtype=dtype_np(dtype))
+    return g * beta.reshape(beta.shape + (1,) * len(shape))
+
+
+@register("_sample_multinomial", ndarray_inputs=("data",),
+          differentiable=False, needs_rng=True)
+def _sample_multinomial(data, shape=(), get_prob=False, dtype="int32",
+                        _rng_key=None):
+    """ref: src/operator/random/multisample_op — categorical draws from
+    (batched) probability rows."""
+    n = int(jnp.prod(jnp.asarray(shape))) if shape else 1
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    if data.ndim == 1:
+        draws = jax.random.categorical(_rng_key, logits, shape=(n,))
+        out = draws.reshape(tuple(shape)) if shape else draws[0]
+    else:
+        draws = jax.random.categorical(_rng_key, logits[:, None, :],
+                                       axis=-1,
+                                       shape=(data.shape[0], n))
+        out = draws.reshape((data.shape[0],) + tuple(shape)) if shape \
+            else draws[:, 0]
+    out = out.astype(dtype_np(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jnp.log(jnp.maximum(data, 1e-30)),
+            out.astype(jnp.int32).reshape(data.shape[0], -1)
+            if data.ndim > 1 else out.astype(jnp.int32).reshape(-1),
+            axis=-1)
+        return out, lp.reshape(out.shape)
+    return out
+
+
+@register("_shuffle", ndarray_inputs=("data",), differentiable=False,
+          needs_rng=True)
+def _shuffle(data, _rng_key=None):
+    return jax.random.permutation(_rng_key, data, axis=0)
+
+
+@register("_sample_unique_zipfian", ndarray_inputs=(), differentiable=False,
+          needs_rng=True)
+def _sample_unique_zipfian(range_max=1, shape=(), _rng_key=None):
+    """ref: src/operator/random/unique_sample_op.cc (log-uniform candidate
+    sampler for sampled softmax). Approximate: zipfian draws w/o dedup."""
+    u = jax.random.uniform(_rng_key, tuple(shape))
+    out = jnp.exp(u * jnp.log(float(range_max) + 1.0)) - 1.0
+    return out.astype(jnp.int64)

@@ -1,0 +1,96 @@
+"""Operator registry.
+
+TPU-native re-design of the reference's operator registration model
+(ref: nnvm::Op registry + NNVM_REGISTER_OP / FCompute attrs,
+src/operator/**; python stubs generated at import in
+python/mxnet/ndarray/register.py).
+
+Here every operator is a *pure JAX function* over jax.Array leaves:
+
+    out = fn(*array_args, **params)
+
+plus metadata (number of tensor inputs, differentiability, wrapped-arg
+names).  The imperative NDArray stubs, the Symbol front-end, autograd and
+hybridize all consume the same registry — a single source of truth exactly
+like the reference's op registry, but the "FCompute kernel" is an XLA
+computation produced by tracing the pure function (fusion, tiling and
+scheduling are the compiler's job; there is no per-op hand kernel except
+Pallas ones which register here the same way).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional, Sequence
+
+__all__ = ["OpDef", "register", "get", "list_ops", "alias"]
+
+
+class OpDef:
+    """Metadata record for one operator."""
+
+    __slots__ = ("name", "fn", "ndarray_inputs", "differentiable",
+                 "num_outputs", "doc", "needs_rng", "needs_training",
+                 "nograd_argnums")
+
+    def __init__(self, name: str, fn: Callable, *,
+                 ndarray_inputs: Optional[Sequence[str]] = None,
+                 differentiable: bool = True,
+                 num_outputs: int = 1,
+                 needs_rng: bool = False,
+                 nograd_argnums: Sequence[int] = ()):
+        import inspect
+        self.name = name
+        self.fn = fn
+        self.ndarray_inputs = tuple(ndarray_inputs) if ndarray_inputs else None
+        self.differentiable = differentiable
+        self.num_outputs = num_outputs
+        try:
+            params = inspect.signature(fn).parameters
+        except (TypeError, ValueError):
+            params = {}
+        self.needs_rng = needs_rng or "_rng_key" in params
+        self.needs_training = "_training" in params
+        self.nograd_argnums = tuple(nograd_argnums)
+        self.doc = fn.__doc__
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register(name: Optional[str] = None, **meta):
+    """Decorator: register a pure-jax operator function.
+
+    Usage::
+
+        @register("broadcast_add")
+        def broadcast_add(lhs, rhs):
+            return jnp.add(lhs, rhs)
+    """
+    def deco(fn):
+        opname = name or fn.__name__
+        if opname in _REGISTRY:
+            raise ValueError("operator %r already registered" % opname)
+        _REGISTRY[opname] = OpDef(opname, fn, **meta)
+        return fn
+    return deco
+
+
+def alias(existing: str, *names: str):
+    """Register extra names for an existing op (ref: nnvm op aliases,
+    e.g. `elemwise_add` vs `_plus`)."""
+    od = _REGISTRY[existing]
+    for n in names:
+        if n in _REGISTRY:
+            raise ValueError("operator %r already registered" % n)
+        _REGISTRY[n] = od
+
+
+def get(name: str) -> OpDef:
+    return _REGISTRY[name]
+
+
+def list_ops():
+    return sorted(_REGISTRY.keys())

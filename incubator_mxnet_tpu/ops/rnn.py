@@ -1,0 +1,179 @@
+"""Fused RNN operator.
+
+TPU-native equivalent of the reference fused `RNN` op
+(ref: src/operator/rnn.cc, rnn-inl.h; cuDNN path nn/cudnn/cudnn_rnn-inl.h).
+
+Semantics preserved: one op runs a whole (multi-layer, optionally
+bidirectional) LSTM/GRU/vanilla-RNN over the padded sequence, taking the
+cuDNN-style *flat parameter vector*.  Realisation: `lax.scan` over time
+per layer — the scan body is a dense gate matmul (MXU) + elementwise
+(VPU), which XLA pipelines; layers/directions unrolled at trace time.
+
+Weight packing order (documented contract, mirrors the cuDNN packing the
+reference used): for each layer, for each direction: W_x then W_h for
+every gate (gate order LSTM=[i,f,g,o], GRU=[r,z,n]); after ALL weights,
+the biases in the same order (b_x then b_h).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(mode, num_layers, input_size, state_size,
+                   bidirectional=False, projection_size=None):
+    """Total flat-parameter length (ref: rnn-inl.h GetRnnParamSize)."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * d
+        size += d * g * (state_size * in_sz + state_size * state_size
+                         + 2 * state_size)
+    return size
+
+
+def _unpack(params, mode, num_layers, input_size, state_size, bidirectional):
+    """Split the flat vector into per-(layer, dir) weight/bias arrays."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    ws, bs = [], []
+    off = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * d
+        layer_ws, layer_bs = [], []
+        for direction in range(d):
+            wx = params[off:off + g * state_size * in_sz].reshape(
+                g * state_size, in_sz)
+            off += g * state_size * in_sz
+            wh = params[off:off + g * state_size * state_size].reshape(
+                g * state_size, state_size)
+            off += g * state_size * state_size
+            layer_ws.append((wx, wh))
+        ws.append(layer_ws)
+    for layer in range(num_layers):
+        layer_bs = []
+        for direction in range(d):
+            bx = params[off:off + g * state_size]
+            off += g * state_size
+            bh = params[off:off + g * state_size]
+            off += g * state_size
+            layer_bs.append((bx, bh))
+        bs.append(layer_bs)
+    return ws, bs
+
+
+def _cell_step(mode, state_size):
+    if mode == "lstm":
+        def step(carry, gates):
+            h, c = carry
+            i, f, gg, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            gg = jnp.tanh(gg)
+            o = jax.nn.sigmoid(o)
+            new_c = f * c + i * gg
+            new_h = o * jnp.tanh(new_c)
+            return (new_h, new_c)
+        return step
+    if mode == "gru":
+        # gru handled specially (gates depend on r·(Wh h)); see _run_layer
+        return None
+    act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+
+    def step(carry, gates):
+        (h,) = carry
+        return (act(gates),)
+    return step
+
+
+def _run_layer(x, h0, c0, wx, wh, bx, bh, mode, reverse=False):
+    """x: (T, B, I). Returns (outputs (T,B,H), hT, cT)."""
+    state_size = wh.shape[1]
+    xg = jnp.einsum("tbi,gi->tbg", x, wx) + bx     # (T, B, G*H) — MXU
+    if reverse:
+        xg = jnp.flip(xg, axis=0)
+
+    if mode == "gru":
+        def step(carry, xg_t):
+            (h,) = carry
+            hg = jnp.matmul(h, wh.T) + bh           # (B, 3H)
+            xr, xz, xn = jnp.split(xg_t, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            new_h = (1 - z) * n + z * h
+            return (new_h,), new_h
+        (hT,), ys = lax.scan(step, (h0,), xg)
+        cT = None
+    elif mode == "lstm":
+        cell = _cell_step(mode, state_size)
+
+        def step(carry, xg_t):
+            h, c = carry
+            gates = xg_t + jnp.matmul(h, wh.T) + bh
+            new = cell((h, c), gates)
+            return new, new[0]
+        (hT, cT), ys = lax.scan(step, (h0, c0), xg)
+    else:
+        cell = _cell_step(mode, state_size)
+
+        def step(carry, xg_t):
+            (h,) = carry
+            gates = xg_t + jnp.matmul(h, wh.T) + bh
+            new = cell((h,), gates)
+            return new, new[0]
+        (hT,), ys = lax.scan(step, (h0,), xg)
+        cT = None
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, hT, cT
+
+
+@register("RNN", ndarray_inputs=("data", "parameters", "state", "state_cell"),
+          num_outputs=-1, needs_rng=True)
+def rnn(data, parameters, state, state_cell=None, state_size=0,
+        num_layers=1, bidirectional=False, mode="lstm", p=0.0,
+        state_outputs=True, projection_size=None, use_sequence_length=False,
+        sequence_length=None, lstm_state_clip_min=None,
+        lstm_state_clip_max=None, _training=True, _rng_key=None):
+    """data: (T, B, I) (TNC layout, as the reference's default `rnn` call
+    from gluon.rnn_layer).  state: (L*D, B, H); lstm also state_cell."""
+    T, B, I = data.shape
+    d = 2 if bidirectional else 1
+    ws, bs = _unpack(parameters, mode, num_layers, I, state_size,
+                     bidirectional)
+    hs_out, cs_out = [], []
+    x = data
+    key = _rng_key
+    for layer in range(num_layers):
+        outs = []
+        for direction in range(d):
+            idx = layer * d + direction
+            wx, wh = ws[layer][direction]
+            bx, bh = bs[layer][direction]
+            h0 = state[idx]
+            c0 = state_cell[idx] if state_cell is not None else None
+            ys, hT, cT = _run_layer(x, h0, c0, wx, wh, bx, bh, mode,
+                                    reverse=(direction == 1))
+            outs.append(ys)
+            hs_out.append(hT)
+            if cT is not None:
+                cs_out.append(cT)
+        x = outs[0] if d == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0.0 and _training and layer < num_layers - 1:
+            key, sub = jax.random.split(key)
+            mask = jax.random.bernoulli(sub, 1.0 - p, x.shape)
+            x = jnp.where(mask, x / (1.0 - p), 0.0).astype(x.dtype)
+    outputs = [x]
+    if state_outputs:
+        outputs.append(jnp.stack(hs_out, axis=0))
+        if mode == "lstm":
+            outputs.append(jnp.stack(cs_out, axis=0))
+    return tuple(outputs) if len(outputs) > 1 else outputs[0]

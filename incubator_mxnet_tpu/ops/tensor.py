@@ -1,0 +1,827 @@
+"""Tensor/math operators (pure-JAX bodies).
+
+TPU-native equivalents of the reference tensor op groups
+(ref: src/operator/tensor/elemwise_binary_{op,broadcast_op}*,
+elemwise_unary_op*, broadcast_reduce_op*, matrix_op*, indexing_op*,
+ordering_op*, init_op*, dot-inl.h).
+
+Design notes:
+- Every body is a pure function over jax.Array; XLA fuses elementwise
+  chains into surrounding matmuls automatically, which replaces the
+  reference's mshadow expression templates and `mxnet_op::Kernel::Launch`.
+- MXNet distinguishes `elemwise_*` (same-shape) from `broadcast_*`
+  (numpy broadcasting). jnp broadcasts everywhere, so the two families
+  share bodies; both names are registered for API parity.
+- Reduce ops keep MXNet's `axis=None/int/tuple`, `keepdims`, `exclude`
+  parameter surface.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    """MXNet reduce-axis semantics: None = all axes; exclude inverts."""
+    if axis is None:
+        ax = tuple(range(ndim))
+        return ax if not exclude else ()
+    if isinstance(axis, int):
+        axis = (axis,)
+    ax = tuple(a % ndim for a in axis)
+    if exclude:
+        ax = tuple(a for a in range(ndim) if a not in ax)
+    return ax
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise / broadcast family
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide, "mod": jnp.mod, "power": jnp.power,
+    "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+    "equal": lambda a, b: (a == b).astype(jnp.result_type(a, b)),
+    "not_equal": lambda a, b: (a != b).astype(jnp.result_type(a, b)),
+    "greater": lambda a, b: (a > b).astype(jnp.result_type(a, b)),
+    "greater_equal": lambda a, b: (a >= b).astype(jnp.result_type(a, b)),
+    "lesser": lambda a, b: (a < b).astype(jnp.result_type(a, b)),
+    "lesser_equal": lambda a, b: (a <= b).astype(jnp.result_type(a, b)),
+    "logical_and": lambda a, b: jnp.logical_and(a, b).astype(jnp.result_type(a, b)),
+    "logical_or": lambda a, b: jnp.logical_or(a, b).astype(jnp.result_type(a, b)),
+    "logical_xor": lambda a, b: jnp.logical_xor(a, b).astype(jnp.result_type(a, b)),
+}
+
+for _name, _jf in _BINARY.items():
+    def _make(jf):
+        def body(lhs, rhs):
+            return jf(lhs, rhs)
+        return body
+    _b = _make(_jf)
+    _b.__name__ = "broadcast_" + _name
+    register("broadcast_" + _name, ndarray_inputs=("lhs", "rhs"))(_b)
+
+alias("broadcast_add", "elemwise_add", "_plus", "_add")
+alias("broadcast_sub", "elemwise_sub", "_minus", "_sub")
+alias("broadcast_mul", "elemwise_mul", "_mul")
+alias("broadcast_div", "elemwise_div", "_div")
+alias("broadcast_mod", "_mod")
+alias("broadcast_power", "_power", "pow")
+alias("broadcast_maximum", "_maximum")
+alias("broadcast_minimum", "_minimum")
+alias("broadcast_hypot", "_hypot")
+alias("broadcast_equal", "_equal")
+alias("broadcast_not_equal", "_not_equal")
+alias("broadcast_greater", "_greater")
+alias("broadcast_greater_equal", "_greater_equal")
+alias("broadcast_lesser", "_lesser")
+alias("broadcast_lesser_equal", "_lesser_equal")
+
+
+# scalar variants (ref: *_scalar ops — kept because the NDArray operator
+# overloads lower to them)
+@register("_plus_scalar", ndarray_inputs=("data",))
+def _plus_scalar(data, scalar=0.0):
+    return data + jnp.asarray(scalar, dtype=data.dtype)
+
+
+@register("_minus_scalar", ndarray_inputs=("data",))
+def _minus_scalar(data, scalar=0.0):
+    return data - jnp.asarray(scalar, dtype=data.dtype)
+
+
+@register("_rminus_scalar", ndarray_inputs=("data",))
+def _rminus_scalar(data, scalar=0.0):
+    return jnp.asarray(scalar, dtype=data.dtype) - data
+
+
+@register("_mul_scalar", ndarray_inputs=("data",))
+def _mul_scalar(data, scalar=1.0):
+    return data * jnp.asarray(scalar, dtype=data.dtype)
+
+
+@register("_div_scalar", ndarray_inputs=("data",))
+def _div_scalar(data, scalar=1.0):
+    return data / jnp.asarray(scalar, dtype=data.dtype)
+
+
+@register("_rdiv_scalar", ndarray_inputs=("data",))
+def _rdiv_scalar(data, scalar=1.0):
+    return jnp.asarray(scalar, dtype=data.dtype) / data
+
+
+@register("_power_scalar", ndarray_inputs=("data",))
+def _power_scalar(data, scalar=1.0):
+    return jnp.power(data, jnp.asarray(scalar, dtype=data.dtype))
+
+
+@register("_rpower_scalar", ndarray_inputs=("data",))
+def _rpower_scalar(data, scalar=1.0):
+    return jnp.power(jnp.asarray(scalar, dtype=data.dtype), data)
+
+
+@register("_mod_scalar", ndarray_inputs=("data",))
+def _mod_scalar(data, scalar=1.0):
+    return jnp.mod(data, jnp.asarray(scalar, dtype=data.dtype))
+
+
+@register("_rmod_scalar", ndarray_inputs=("data",))
+def _rmod_scalar(data, scalar=1.0):
+    return jnp.mod(jnp.asarray(scalar, dtype=data.dtype), data)
+
+
+@register("_maximum_scalar", ndarray_inputs=("data",))
+def _maximum_scalar(data, scalar=0.0):
+    return jnp.maximum(data, jnp.asarray(scalar, dtype=data.dtype))
+
+
+@register("_minimum_scalar", ndarray_inputs=("data",))
+def _minimum_scalar(data, scalar=0.0):
+    return jnp.minimum(data, jnp.asarray(scalar, dtype=data.dtype))
+
+
+for _cmp, _fn in [("_equal_scalar", lambda d, s: (d == s)),
+                  ("_not_equal_scalar", lambda d, s: (d != s)),
+                  ("_greater_scalar", lambda d, s: (d > s)),
+                  ("_greater_equal_scalar", lambda d, s: (d >= s)),
+                  ("_lesser_scalar", lambda d, s: (d < s)),
+                  ("_lesser_equal_scalar", lambda d, s: (d <= s))]:
+    def _mk(fn):
+        def body(data, scalar=0.0):
+            return fn(data, scalar).astype(data.dtype)
+        return body
+    _f = _mk(_fn)
+    _f.__name__ = _cmp
+    register(_cmp, ndarray_inputs=("data",), differentiable=False)(_f)
+
+
+# ---------------------------------------------------------------------------
+# unary elementwise family
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "rint": jnp.rint,
+    "ceil": jnp.ceil, "floor": jnp.floor, "trunc": jnp.trunc,
+    "fix": jnp.trunc, "square": jnp.square, "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x), "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x), "exp": jnp.exp,
+    "expm1": jnp.expm1, "log": jnp.log, "log10": jnp.log10,
+    "log2": jnp.log2, "log1p": jnp.log1p, "sin": jnp.sin,
+    "cos": jnp.cos, "tan": jnp.tan, "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "reciprocal": lambda x: 1.0 / x,
+    "negative": jnp.negative,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+}
+
+for _name, _jf in _UNARY.items():
+    def _mku(jf):
+        def body(data):
+            return jf(data)
+        return body
+    _u = _mku(_jf)
+    _u.__name__ = _name
+    register(_name, ndarray_inputs=("data",))(_u)
+
+
+@register("logical_not", ndarray_inputs=("data",), differentiable=False)
+def logical_not(data):
+    return jnp.logical_not(data).astype(data.dtype)
+
+
+@register("round", ndarray_inputs=("data",), differentiable=False)
+def round_(data):
+    return jnp.round(data)
+
+
+@register("BlockGrad", ndarray_inputs=("data",))
+def block_grad(data):
+    """ref: src/operator/tensor/elemwise_unary_op_basic.cc BlockGrad —
+    identity forward, zero gradient (== jax.lax.stop_gradient)."""
+    return jax.lax.stop_gradient(data)
+
+
+alias("BlockGrad", "stop_gradient")
+
+
+@register("identity", ndarray_inputs=("data",))
+def identity(data):
+    return data
+
+
+alias("identity", "_copy")
+
+
+@register("cast", ndarray_inputs=("data",))
+def cast(data, dtype="float32"):
+    from ..base import dtype_np
+    return data.astype(dtype_np(dtype))
+
+
+alias("cast", "Cast")
+
+
+@register("clip", ndarray_inputs=("data",))
+def clip(data, a_min=0.0, a_max=1.0):
+    return jnp.clip(data, a_min, a_max)
+
+
+# ---------------------------------------------------------------------------
+# init ops
+# ---------------------------------------------------------------------------
+
+
+@register("zeros_like", ndarray_inputs=("data",))
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like", ndarray_inputs=("data",))
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("_zeros", ndarray_inputs=(), differentiable=False)
+def _zeros(shape=(), dtype="float32"):
+    from ..base import dtype_np
+    return jnp.zeros(shape, dtype=dtype_np(dtype))
+
+
+@register("_ones", ndarray_inputs=(), differentiable=False)
+def _ones(shape=(), dtype="float32"):
+    from ..base import dtype_np
+    return jnp.ones(shape, dtype=dtype_np(dtype))
+
+
+@register("_full", ndarray_inputs=(), differentiable=False)
+def _full(shape=(), value=0.0, dtype="float32"):
+    from ..base import dtype_np
+    return jnp.full(shape, value, dtype=dtype_np(dtype))
+
+
+@register("_arange", ndarray_inputs=(), differentiable=False)
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    from ..base import dtype_np
+    out = jnp.arange(start, stop, step, dtype=dtype_np(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_linspace", ndarray_inputs=(), differentiable=False)
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32"):
+    from ..base import dtype_np
+    return jnp.linspace(start, stop, int(num), endpoint=endpoint,
+                        dtype=dtype_np(dtype))
+
+
+@register("_eye", ndarray_inputs=(), differentiable=False)
+def _eye(N=1, M=0, k=0, dtype="float32"):
+    from ..base import dtype_np
+    M = int(M) or None
+    return jnp.eye(int(N), M, int(k), dtype=dtype_np(dtype))
+
+
+@register("arange_like", ndarray_inputs=("data",), differentiable=False)
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    if axis is None:
+        n = data.size
+        shape = data.shape
+    else:
+        n = data.shape[axis]
+        shape = (n,)
+    out = jnp.arange(start, start + step * n, step, dtype=data.dtype)[:n]
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def _reduce(jf):
+    def body(data, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis, data.ndim, exclude)
+        if ax == () and axis is not None:
+            return data
+        return jf(data, axis=ax if ax else None, keepdims=keepdims)
+    return body
+
+
+for _name, _jf in [("sum", jnp.sum), ("mean", jnp.mean), ("prod", jnp.prod),
+                   ("nansum", jnp.nansum), ("nanprod", jnp.nanprod),
+                   ("max", jnp.max), ("min", jnp.min)]:
+    _r = _reduce(_jf)
+    _r.__name__ = _name
+    register(_name, ndarray_inputs=("data",))(_r)
+
+alias("sum", "sum_axis")
+alias("max", "max_axis")
+alias("min", "min_axis")
+
+
+@register("norm", ndarray_inputs=("data",))
+def norm(data, ord=2, axis=None, keepdims=False, out_dtype=None):
+    ax = None if axis is None else (axis if isinstance(axis, tuple) else (axis,))
+    if ord == 2:
+        sq = jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims)
+        out = jnp.sqrt(sq)
+    elif ord == 1:
+        out = jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
+    else:
+        raise ValueError("norm only supports ord=1|2 (ref parity)")
+    if out_dtype is not None:
+        from ..base import dtype_np
+        out = out.astype(dtype_np(out_dtype))
+    return out
+
+
+@register("argmax", ndarray_inputs=("data",), differentiable=False)
+def argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)   # MXNet returns float indices
+
+
+@register("argmin", ndarray_inputs=("data",), differentiable=False)
+def argmin(data, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel", ndarray_inputs=("data",), differentiable=False)
+def argmax_channel(data):
+    return jnp.argmax(data, axis=-1).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+
+@register("reshape", ndarray_inputs=("data",))
+def reshape(data, shape=None, reverse=False):
+    """Supports MXNet's magic values 0 (copy dim), -1 (infer), -2 (copy
+    rest), -3 (merge two), -4 (split) — ref: matrix_op-inl.h ReshapeShape."""
+    shape = tuple(shape)
+    if not any(s in (0, -2, -3, -4) for s in shape):
+        return jnp.reshape(data, shape)
+    src = list(data.shape[::-1] if reverse else data.shape)
+    out = []
+    i = 0
+    it = iter(range(len(shape)))
+    shape_l = list(shape[::-1] if reverse else shape)
+    k = 0
+    while k < len(shape_l):
+        s = shape_l[k]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            a, b = shape_l[k + 1], shape_l[k + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; k += 2
+        else:
+            out.append(s); i += 1
+        k += 1
+    if reverse:
+        out = out[::-1]
+    return jnp.reshape(data, tuple(out))
+
+
+alias("reshape", "Reshape")
+
+
+@register("reshape_like", ndarray_inputs=("lhs", "rhs"))
+def reshape_like(lhs, rhs):
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register("shape_array", ndarray_inputs=("data",), differentiable=False)
+def shape_array(data):
+    return jnp.asarray(data.shape, dtype=jnp.int64)
+
+
+@register("size_array", ndarray_inputs=("data",), differentiable=False)
+def size_array(data):
+    return jnp.asarray([data.size], dtype=jnp.int64)
+
+
+@register("Flatten", ndarray_inputs=("data",))
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+alias("Flatten", "flatten")
+
+
+@register("expand_dims", ndarray_inputs=("data",))
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze", ndarray_inputs=("data",))
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis=axis)
+
+
+@register("transpose", ndarray_inputs=("data",))
+def transpose(data, axes=None):
+    if axes is not None and len(axes) == 0:
+        axes = None
+    return jnp.transpose(data, axes=axes)
+
+
+@register("swapaxes", ndarray_inputs=("data",))
+def swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+alias("swapaxes", "SwapAxis")
+
+
+@register("flip", ndarray_inputs=("data",))
+def flip(data, axis=0):
+    return jnp.flip(data, axis=axis)
+
+
+alias("flip", "reverse")
+
+
+@register("tile", ndarray_inputs=("data",))
+def tile(data, reps=()):
+    return jnp.tile(data, tuple(reps))
+
+
+@register("repeat", ndarray_inputs=("data",))
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("broadcast_to", ndarray_inputs=("data",))
+def broadcast_to(data, shape=()):
+    shape = tuple(int(data.shape[i]) if s == 0 else int(s)
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(data, shape)
+
+
+@register("broadcast_like", ndarray_inputs=("lhs", "rhs"))
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    shape = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        shape[la] = rhs.shape[ra]
+    return jnp.broadcast_to(lhs, tuple(shape))
+
+
+@register("broadcast_axis", ndarray_inputs=("data",))
+def broadcast_axis(data, axis=(), size=()):
+    if isinstance(axis, int):
+        axis = (axis,)
+        size = (size,)
+    shape = list(data.shape)
+    for a, s in zip(axis, size):
+        shape[a] = s
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+alias("broadcast_axis", "broadcast_axes")
+
+
+@register("concat", ndarray_inputs=None)
+def concat(*data, dim=1):
+    return jnp.concatenate(data, axis=dim)
+
+
+alias("concat", "Concat")
+
+
+@register("stack", ndarray_inputs=None)
+def stack(*data, axis=0):
+    return jnp.stack(data, axis=axis)
+
+
+@register("split", ndarray_inputs=("data",), num_outputs=-1)
+def split(data, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, int(num_outputs), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+alias("split", "SliceChannel")
+
+
+@register("slice", ndarray_inputs=("data",))
+def slice_(data, begin=(), end=(), step=()):
+    idx = []
+    step = tuple(step) if step else (None,) * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(builtins_slice(b, e, s))
+    return data[tuple(idx)]
+
+
+def builtins_slice(b, e, s):
+    return slice(b, e, s)
+
+
+@register("slice_axis", ndarray_inputs=("data",))
+def slice_axis(data, axis=0, begin=0, end=None):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like", ndarray_inputs=("data", "shape_like"))
+def slice_like(data, shape_like, axes=()):
+    idx = [slice(None)] * data.ndim
+    axes = axes or range(min(data.ndim, shape_like.ndim))
+    for a in axes:
+        idx[a] = slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register("pad", ndarray_inputs=("data",))
+def pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1])
+          for i in range(len(pad_width) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(data, pw, mode=jmode, constant_values=constant_value)
+    return jnp.pad(data, pw, mode=jmode)
+
+
+alias("pad", "Pad")
+
+
+@register("depth_to_space", ndarray_inputs=("data",))
+def depth_to_space(data, block_size=1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth", ndarray_inputs=("data",))
+def space_to_depth(data, block_size=1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+# ---------------------------------------------------------------------------
+# indexing / gather / scatter
+# ---------------------------------------------------------------------------
+
+
+@register("take", ndarray_inputs=("a", "indices"), nograd_argnums=(1,))
+def take(a, indices, axis=0, mode="clip"):
+    jmode = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=jmode)
+
+
+@register("pick", ndarray_inputs=("data", "index"), nograd_argnums=(1,))
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("gather_nd", ndarray_inputs=("data", "indices"), nograd_argnums=(1,))
+def gather_nd(data, indices):
+    """ref: tensor/indexing_op.h GatherNDForward. indices shape (M, ...)"""
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd", ndarray_inputs=("data", "indices"), nograd_argnums=(1,))
+def scatter_nd(data, indices, shape=()):
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].set(data)
+
+
+@register("_scatter_set_nd", ndarray_inputs=("lhs", "rhs", "indices"),
+          nograd_argnums=(2,))
+def _scatter_set_nd(lhs, rhs, indices, shape=()):
+    idx = tuple(indices.astype(jnp.int32))
+    return lhs.at[idx].set(rhs)
+
+
+@register("one_hot", ndarray_inputs=("indices",), differentiable=False)
+def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..base import dtype_np
+    d = dtype_np(dtype)
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), int(depth), dtype=d)
+    return oh * jnp.asarray(on_value, d) + (1 - oh) * jnp.asarray(off_value, d)
+
+
+@register("where", ndarray_inputs=("condition", "x", "y"), nograd_argnums=(0,))
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("boolean_mask", ndarray_inputs=("data", "index"), differentiable=False)
+def boolean_mask(data, index, axis=0):
+    """Dynamic-shape op: on TPU we return *padded* results + valid count is
+    not expressible under jit; imperative-only (ref: contrib/boolean_mask.cc).
+    """
+    mask = _np.asarray(index).astype(bool)
+    return jnp.compress(mask, data, axis=axis)
+
+
+@register("diag", ndarray_inputs=("data",))
+def diag(data, k=0, axis1=0, axis2=1):
+    if data.ndim == 1:
+        return jnp.diag(data, k=k)
+    return jnp.diagonal(data, offset=k, axis1=axis1, axis2=axis2)
+
+
+# ---------------------------------------------------------------------------
+# linear algebra
+# ---------------------------------------------------------------------------
+
+
+@register("dot", ndarray_inputs=("lhs", "rhs"))
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """ref: src/operator/tensor/dot-inl.h. 2-D (and N-D trailing-contraction)
+    matmul; on TPU this is THE MXU op — keep inputs bf16/fp32 and let XLA
+    tile. Sparse (csr) variants live in ops/sparse.py."""
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    if a.ndim > 2 or b.ndim > 2:
+        # MXNet dot contracts last axis of a with first of b
+        return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+    return jnp.dot(a, b)
+
+
+@register("batch_dot", ndarray_inputs=("lhs", "rhs"))
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao", ndarray_inputs=None)
+def khatri_rao(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(
+            out.shape[0] * m.shape[0], *out.shape[1:])
+    return out
+
+
+@register("L2Normalization", ndarray_inputs=("data",))
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        ax = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    elif mode == "spatial":
+        ax = tuple(range(2, data.ndim))
+    else:
+        raise ValueError(mode)
+    nrm = jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=True) + eps)
+    return data / nrm
+
+
+# ---------------------------------------------------------------------------
+# ordering
+# ---------------------------------------------------------------------------
+
+
+@register("sort", ndarray_inputs=("data",))
+def sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort", ndarray_inputs=("data",), differentiable=False)
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    from ..base import dtype_np
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(dtype_np(dtype))
+
+
+@register("topk", ndarray_inputs=("data",), differentiable=False)
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+         dtype="float32"):
+    from ..base import dtype_np
+    k = int(k)
+    d = jnp.moveaxis(data, axis, -1)
+    neg = not is_ascend
+    vals, idxs = jax.lax.top_k(d if neg else -d, k)
+    if not neg:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idxs = jnp.moveaxis(idxs, -1, axis).astype(dtype_np(dtype))
+    if ret_typ == "indices":
+        return idxs
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return (vals, idxs)
+    if ret_typ == "mask":
+        ii = jnp.moveaxis(idxs, axis, -1).astype(jnp.int32)
+        zeros = jnp.zeros(d.shape, dtype=data.dtype)
+        mask = jnp.moveaxis(
+            jnp.put_along_axis(zeros, ii, jnp.ones((), data.dtype),
+                               axis=-1, inplace=False), -1, axis)
+        return mask
+    raise ValueError(ret_typ)
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (ref: src/operator/sequence_*.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("SequenceMask", ndarray_inputs=("data", "sequence_length"),
+          nograd_argnums=(1,))
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    # (T, B) layout when axis=0, (B, T) when axis=1
+    if axis == 0:
+        mask = steps[:, None] < sequence_length[None, :].astype(steps.dtype)
+    else:
+        mask = steps[None, :] < sequence_length[:, None].astype(steps.dtype)
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceLast", ndarray_inputs=("data", "sequence_length"),
+          nograd_argnums=(1,))
+def sequence_last(data, sequence_length=None, use_sequence_length=False,
+                  axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    d = jnp.moveaxis(data, axis, 0)          # (T, B, ...)
+    out = jnp.take_along_axis(
+        d, last.reshape((1, -1) + (1,) * (d.ndim - 2)), axis=0)
+    return jnp.squeeze(out, axis=0)
+
+
+@register("SequenceReverse", ndarray_inputs=("data", "sequence_length"),
+          nograd_argnums=(1,))
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                     axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    steps = jnp.arange(T)[:, None]
+    L = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(steps < L, L - 1 - steps, steps)    # (T, B)
+    d = data
+    out = jnp.take_along_axis(
+        d, src.reshape(src.shape + (1,) * (d.ndim - 2)), axis=0)
+    return out

@@ -1,0 +1,601 @@
+"""Optimizers.
+
+TPU-native re-design of the reference optimizer layer
+(ref: python/mxnet/optimizer/optimizer.py — Optimizer registry, SGD/Adam/
+... classes picking fused native update ops from src/operator/optimizer_op.cc).
+
+The key design point is carried over: **the update is an op, not Python
+arithmetic**.  Each `update()` call dispatches one jit-compiled XLA
+computation per parameter with donated input buffers, so weight + state
+are updated in place at the XLA level.  Scalars (lr/wd/…) are passed as
+traced 0-d arrays so lr schedules don't trigger recompilation.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import warnings
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+# grad buffers are donated alongside weight/state (one donate list keeps
+# the jit cache simple); XLA can't reuse them — silence that advisory
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ops import registry as _registry
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta",
+           "RMSProp", "Ftrl", "Signum", "SignSGD", "LAMB", "Adamax",
+           "Nadam", "SGLD", "Test", "register", "create", "get_updater",
+           "Updater"]
+
+
+# ---------------------------------------------------------------------------
+# jitted fused-update cache
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _jit_update(opname: str, static_kv: tuple):
+    fn = _registry.get(opname).fn
+
+    def f(arrs, scalars):
+        return fn(*arrs, **scalars, **dict(static_kv))
+    return jax.jit(f, donate_argnums=0)
+
+
+def _fused(opname, arrays, scalars, static):
+    """Run a fused update op: donates `arrays`' buffers, returns new ones."""
+    jf = _jit_update(opname, tuple(sorted(static.items())))
+    data = tuple(a._data for a in arrays)
+    scal = {k: jnp.asarray(v, jnp.float32) for k, v in scalars.items()}
+    return jf(data, scal)
+
+
+# ---------------------------------------------------------------------------
+# base class + registry
+# ---------------------------------------------------------------------------
+
+_OPT_REGISTRY = {}
+
+
+def register(klass):
+    _OPT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    key = name.lower()
+    if key not in _OPT_REGISTRY:
+        raise MXNetError("unknown optimizer %r" % name)
+    return _OPT_REGISTRY[key](**kwargs)
+
+
+class Optimizer:
+    """ref: mx.optimizer.Optimizer."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01,
+                 lr_scheduler=None, sym=None, begin_num_update=0,
+                 multi_precision=False, param_dict=None,
+                 aggregate_num=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+        self.param_idx2name = dict(param_idx2name or {})
+        self.param_dict = dict(param_dict or {})
+        self.idx2name = self.param_idx2name
+
+    create_optimizer = staticmethod(create)
+
+    # -- learning rate ----------------------------------------------------
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("lr_scheduler is set; cannot set lr directly")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            self._index_update_count.setdefault(idx, self.begin_num_update)
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx],
+                                  self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.learning_rate
+        if index in self.param_dict:
+            p = self.param_dict[index]
+            lr *= getattr(p, "lr_mult", 1.0)
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            p = self.param_dict[index]
+            wd *= getattr(p, "wd_mult", 1.0)
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    # -- subclass interface ----------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == _np.float16:
+            w32 = NDArray(weight._data.astype(jnp.float32), ctx=weight.context)
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == _np.float16:
+            inner_state, w32 = state
+            g32 = NDArray(grad._data.astype(jnp.float32), ctx=grad.context)
+            self.update(index, w32, g32, inner_state)
+            weight._data = w32._data.astype(weight._data.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    def __repr__(self):
+        return "%s(lr=%s)" % (self.__class__.__name__, self.lr)
+
+    def __getstate__(self):
+        # param_dict holds live Parameters (and through them the Trainer);
+        # optimizer state files only need the hyper-state
+        state = self.__dict__.copy()
+        state["param_dict"] = {}
+        return state
+
+
+# ---------------------------------------------------------------------------
+# concrete optimizers (fused-op backed)
+# ---------------------------------------------------------------------------
+
+@register
+class SGD(Optimizer):
+    """ref: optimizer.SGD → sgd_update / sgd_mom_update fused ops."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype),
+                       ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        scal = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad)
+        static = dict(clip_gradient=self.clip_gradient
+                      if self.clip_gradient is not None else -1.0)
+        if state is None:
+            weight._data = _fused("sgd_update", (weight, grad), scal, static)
+        else:
+            scal["momentum"] = self.momentum
+            new_w, new_m = _fused("sgd_mom_update", (weight, grad, state),
+                                  scal, static)
+            weight._data, state._data = new_w, new_m
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype),
+                       ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        scal = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                    momentum=self.momentum)
+        static = dict(clip_gradient=self.clip_gradient
+                      if self.clip_gradient is not None else -1.0)
+        if state is None:
+            weight._data = _fused("sgd_update", (weight, grad),
+                                  dict(lr=lr, wd=wd,
+                                       rescale_grad=self.rescale_grad),
+                                  static)
+        else:
+            new_w, new_m = _fused("nag_mom_update", (weight, grad, state),
+                                  scal, static)
+            weight._data, state._data = new_w, new_m
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z, ctx=weight.context),
+                NDArray(z, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        mean, var = state
+        scal = dict(lr=lr, wd=self._get_wd(index),
+                    rescale_grad=self.rescale_grad,
+                    beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
+        static = dict(clip_gradient=self.clip_gradient
+                      if self.clip_gradient is not None else -1.0)
+        new_w, new_m, new_v = _fused("adam_update",
+                                     (weight, grad, mean, var), scal, static)
+        weight._data, mean._data, var._data = new_w, new_m, new_v
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype),
+                       ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        scal = dict(lr=self._get_lr(index), wd=self._get_wd(index),
+                    rescale_grad=self.rescale_grad,
+                    epsilon=self.float_stable_eps)
+        static = dict(clip_gradient=self.clip_gradient
+                      if self.clip_gradient is not None else -1.0)
+        new_w, new_h = _fused("adagrad_update", (weight, grad, state),
+                              scal, static)
+        weight._data, state._data = new_w, new_h
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z, ctx=weight.context), NDArray(z, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight._data
+        new_acc_g = self.rho * acc_g._data + (1 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_delta._data + self.epsilon) / \
+            jnp.sqrt(new_acc_g + self.epsilon) * g
+        new_acc_delta = self.rho * acc_delta._data + \
+            (1 - self.rho) * jnp.square(delta)
+        weight._data = weight._data - delta
+        acc_g._data, acc_delta._data = new_acc_g, new_acc_delta
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        if self.centered:
+            return (NDArray(z, ctx=weight.context),
+                    NDArray(z, ctx=weight.context),
+                    NDArray(z, ctx=weight.context))
+        return (NDArray(z, ctx=weight.context),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        scal = dict(lr=self._get_lr(index), wd=self._get_wd(index),
+                    rescale_grad=self.rescale_grad, gamma1=self.gamma1,
+                    epsilon=self.epsilon)
+        static = dict(
+            clip_gradient=self.clip_gradient
+            if self.clip_gradient is not None else -1.0,
+            clip_weights=self.clip_weights
+            if self.clip_weights is not None else -1.0)
+        if self.centered:
+            n, g, delta = state
+            scal["gamma2"] = self.gamma2
+            new = _fused("rmspropalex_update",
+                         (weight, grad, n, g, delta), scal, static)
+            weight._data, n._data, g._data, delta._data = new
+        else:
+            (n,) = state
+            new_w, new_n = _fused("rmsprop_update", (weight, grad, n),
+                                  scal, static)
+            weight._data, n._data = new_w, new_n
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z, ctx=weight.context), NDArray(z, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        zed, n = state
+        scal = dict(lr=self._get_lr(index), wd=self._get_wd(index),
+                    rescale_grad=self.rescale_grad, lamda1=self.lamda1,
+                    beta=self.beta)
+        static = dict(clip_gradient=self.clip_gradient
+                      if self.clip_gradient is not None else -1.0)
+        new_w, new_z, new_n = _fused("ftrl_update", (weight, grad, zed, n),
+                                     scal, static)
+        weight._data, zed._data, n._data = new_w, new_z, new_n
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype),
+                       ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        scal = dict(lr=self._get_lr(index), wd=self._get_wd(index),
+                    rescale_grad=self.rescale_grad)
+        static = dict(clip_gradient=self.clip_gradient
+                      if self.clip_gradient is not None else -1.0)
+        if state is None:
+            weight._data = _fused("signsgd_update", (weight, grad),
+                                  scal, static)
+        else:
+            scal.update(momentum=self.momentum, wd_lh=self.wd_lh)
+            new_w, new_m = _fused("signum_update", (weight, grad, state),
+                                  scal, static)
+            weight._data, state._data = new_w, new_m
+
+
+@register
+class SignSGD(Signum):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("momentum", 0.0)
+        super().__init__(**kwargs)
+
+
+@register
+class LAMB(Optimizer):
+    """ref: lamb_update_phase1/2 (layer-adaptive large-batch optimizer)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z, ctx=weight.context), NDArray(z, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        scal = dict(wd=self._get_wd(index), rescale_grad=self.rescale_grad,
+                    beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
+        static = dict(t=t, bias_correction=self.bias_correction,
+                      clip_gradient=self.clip_gradient
+                      if self.clip_gradient is not None else -1.0)
+        g, new_m, new_v = _fused("lamb_update_phase1",
+                                 (weight, grad, mean, var), scal, static)
+        mean._data, var._data = new_m, new_v
+        r1 = jnp.linalg.norm(weight._data)
+        r2 = jnp.linalg.norm(g)
+        w_nd = weight
+        scal2 = dict(lr=self._get_lr(index))
+        static2 = dict(
+            lower_bound=self.lower_bound
+            if self.lower_bound is not None else -1.0,
+            upper_bound=self.upper_bound
+            if self.upper_bound is not None else -1.0)
+        jf = _jit_update("lamb_update_phase2", tuple(sorted(static2.items())))
+        new_w = jf((w_nd._data, g, r1, r2),
+                   {k: jnp.asarray(v, jnp.float32)
+                    for k, v in scal2.items()})
+        weight._data = new_w
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z, ctx=weight.context), NDArray(z, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index) / (1.0 - self.beta1 ** t)
+        m, u = state
+        g = grad._data * self.rescale_grad + \
+            self._get_wd(index) * weight._data
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        new_m = self.beta1 * m._data + (1 - self.beta1) * g
+        new_u = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
+        weight._data = weight._data - lr * new_m / (new_u + 1e-8)
+        m._data, u._data = new_m, new_u
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (NDArray(z, ctx=weight.context), NDArray(z, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        m, v = state
+        g = grad._data * self.rescale_grad + wd * weight._data
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 **
+                                     ((t + 1) * self.schedule_decay))
+        self.m_schedule *= momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        g_prime = g / (1.0 - self.m_schedule)
+        new_m = self.beta1 * m._data + (1.0 - self.beta1) * g
+        new_v = self.beta2 * v._data + (1.0 - self.beta2) * jnp.square(g)
+        m_prime = new_m / (1.0 - m_schedule_next)
+        v_prime = new_v / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        weight._data = weight._data - lr * m_bar / \
+            (jnp.sqrt(v_prime) + self.epsilon)
+        m._data, v._data = new_m, new_v
+
+
+@register
+class SGLD(Optimizer):
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad._data * self.rescale_grad + wd * weight._data
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        from .. import random as rnd
+        key = rnd.split_key(weight.context)
+        noise = jax.random.normal(key, weight.shape, weight._data.dtype) * \
+            math.sqrt(lr)
+        weight._data = weight._data - lr / 2 * g + noise
+
+
+@register
+class Test(Optimizer):
+    """ref: optimizer.Test — plain sgd used by unit tests."""
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight._data.dtype),
+                       ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight._data = weight._data - self.lr * grad._data * self.rescale_grad
+
+
+# ---------------------------------------------------------------------------
+# Updater (kvstore server-side optimizer hook, ref: get_updater)
+# ---------------------------------------------------------------------------
+
+class Updater:
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict = {}
+        self.states_synced: Dict = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+        return pickle.dumps((self.states, self.optimizer)
+                            if dump_optimizer else self.states)
+
+    def set_states(self, states):
+        import pickle
+        obj = pickle.loads(states)
+        if isinstance(obj, tuple):
+            self.states, self.optimizer = obj
+        else:
+            self.states = obj
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

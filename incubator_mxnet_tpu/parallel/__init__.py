@@ -1,0 +1,15 @@
+"""mx.parallel — TPU-native scaling (mesh, shardings, one-executable
+training steps).  This package is the TPU-first replacement for the
+reference's kvstore/NCCL/ps-lite stack (SURVEY §2.3, §5.8); the KVStore
+facade remains for API parity while this is the performance path.
+"""
+from .mesh import make_mesh, Mesh, NamedSharding, P, replicated, \
+    batch_sharded, default_dp_mesh
+from .functional import functionalize, extract_params, load_params
+from .trainer import (ShardedTrainer, softmax_ce_loss, sgd_momentum_tree,
+                      adam_tree)
+
+__all__ = ["make_mesh", "Mesh", "NamedSharding", "P", "replicated",
+           "batch_sharded", "default_dp_mesh", "functionalize",
+           "extract_params", "load_params", "ShardedTrainer",
+           "softmax_ce_loss", "sgd_momentum_tree", "adam_tree"]

@@ -1,0 +1,91 @@
+"""Functional bridge: stateful Gluon block → pure jax function.
+
+This is the seam between the imperative/Gluon surface (reference parity)
+and the pjit/mesh world (TPU-native scaling).  `functionalize(block)`
+returns a pure function over an explicit param dict — the same trick the
+cached-op machinery uses, exposed so sharded training steps, multi-chip
+dryruns and benchmarks can jit/pjit whole train steps with shardings.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+
+from ..ndarray.ndarray import NDArray
+from .. import autograd as _ag
+from .. import random as _rnd
+from ..gluon.block import _STATE
+
+__all__ = ["functionalize", "extract_params", "load_params"]
+
+
+def extract_params(block) -> Dict[str, "jax.Array"]:
+    """Pull the block's parameters out as a flat {name: jax.Array} dict."""
+    pd = block.collect_params()
+    out = {}
+    for name, p in pd.items():
+        if p._data is None and p._deferred_init:
+            p._finish_deferred_init()
+        out[name] = p.data()._data
+    return out
+
+
+def load_params(block, params: Dict[str, "jax.Array"]):
+    """Write a param dict back into the block (post-training sync)."""
+    pd = block.collect_params()
+    for name, val in params.items():
+        p = pd[name]
+        for ctx in list(p._data.keys()):
+            p._data[ctx]._data = val
+            break
+
+
+def functionalize(block, training: bool = False) -> Callable:
+    """Return pure(params_dict, *inputs, rng_bits=None) →
+    (outputs, new_state_dict).
+
+    `new_state_dict` carries BatchNorm-style running-stat updates (empty
+    when training=False or the net has none).  The callable is traceable:
+    wrap in jax.jit / pjit with shardings freely.
+    """
+    pd = block.collect_params()
+    names = list(pd.keys())
+    params = [pd[n] for n in names]
+
+    def pure(pvals: Dict[str, "jax.Array"], *ivals, rng_bits=None):
+        saved = []
+        for p in params:
+            ctx0 = next(iter(p._data))
+            saved.append((p, ctx0, p._data[ctx0]))
+            p._data[ctx0] = NDArray(pvals[p.name], ctx=ctx0)
+        states = []
+        prev_state, _STATE.active = _STATE.active, states
+        prev_rec = _ag.set_recording(False)
+        prev_train = _ag.set_training(training)
+        holder = None
+        if rng_bits is not None:
+            holder = _rnd.KeyHolder(jax.random.wrap_key_data(rng_bits))
+            _rnd.push_trace_key(holder)
+        try:
+            from ..gluon.block import Block
+            nd_in = [NDArray(v) if not isinstance(v, NDArray) else v
+                     for v in ivals]
+            # bypass any hybridize cache: trace the plain forward
+            out = Block.__call__(block, *nd_in)
+        finally:
+            if holder is not None:
+                _rnd.pop_trace_key()
+            _ag.set_training(prev_train)
+            _ag.set_recording(prev_rec)
+            _STATE.active = prev_state
+            for p, ctx0, orig in saved:
+                p._data[ctx0] = orig
+        if isinstance(out, (tuple, list)):
+            out_j = type(out)(o._data for o in out)
+        else:
+            out_j = out._data
+        state_dict = {p.name: v for p, v in states}
+        return out_j, state_dict
+
+    return pure

@@ -1,0 +1,48 @@
+"""Device mesh helpers.
+
+TPU-native replacement for the reference's device-topology machinery
+(ref: src/kvstore/gpu_topology.h link-weight trees; ps-lite node groups):
+on TPU the topology is the ICI mesh and XLA owns collective routing —
+the framework's job is just to pick mesh axes and shardings
+(jax.sharding.Mesh / NamedSharding / PartitionSpec).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as _np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "Mesh", "NamedSharding", "P", "replicated",
+           "batch_sharded", "default_dp_mesh"]
+
+
+def make_mesh(shape: Sequence[int] = None,
+              axis_names: Sequence[str] = ("data",),
+              devices=None) -> Mesh:
+    """Build a Mesh over available devices.
+
+    make_mesh() → 1-d 'data' mesh over all devices;
+    make_mesh((4, 2), ('data', 'model')) → dp×tp grid.
+    """
+    devices = devices if devices is not None else jax.devices()
+    if shape is None:
+        shape = (len(devices),)
+    arr = _np.asarray(devices[:int(_np.prod(shape))]).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def default_dp_mesh() -> Mesh:
+    return make_mesh()
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = "data",
+                  batch_dim: int = 0) -> NamedSharding:
+    spec = [None] * (batch_dim + 1)
+    spec[batch_dim] = axis
+    return NamedSharding(mesh, P(*spec))

@@ -1,0 +1,171 @@
+"""Profiler (ref: src/profiler/profiler.{h,cc}, python/mxnet/profiler.py).
+
+Same user surface: set_config / set_state('run'|'stop') / pause / resume /
+dump / dumps(aggregate), custom scopes (Task/Frame/Marker).  Mechanism:
+the engine dispatch hook records one event per imperative op (the analogue
+of ThreadedEngine::ExecuteOprBlock's begin/end stamps); dump() writes
+chrome://tracing JSON.  For inside-executable visibility use
+`jax.profiler` (XPlane) — `start_jax_trace`/`stop_jax_trace` wrap it.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+
+from . import engine
+
+__all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
+           "Task", "Frame", "Marker", "scope", "start_jax_trace",
+           "stop_jax_trace"]
+
+_CONFIG = {"filename": "profile.json", "profile_all": False,
+           "profile_imperative": True, "aggregate_stats": True}
+_STATE = {"running": False, "paused": False}
+_EVENTS = []
+_LOCK = threading.Lock()
+_T0 = time.perf_counter()
+
+
+def _listener(name, ctx, elapsed):
+    if not _STATE["running"] or _STATE["paused"]:
+        return
+    now = time.perf_counter()
+    with _LOCK:
+        _EVENTS.append({
+            "name": name, "cat": "operator",
+            "ph": "X",
+            "ts": (now - elapsed - _T0) * 1e6,
+            "dur": elapsed * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() % 100000,
+            "args": {"ctx": repr(ctx)},
+        })
+
+
+def set_config(**kwargs):
+    _CONFIG.update(kwargs)
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        if not _STATE["running"]:
+            engine.add_dispatch_listener(_listener)
+        _STATE["running"] = True
+        _STATE["paused"] = False
+    else:
+        _STATE["running"] = False
+        engine.remove_dispatch_listener(_listener)
+
+
+def pause(profile_process="worker"):
+    _STATE["paused"] = True
+
+
+def resume(profile_process="worker"):
+    _STATE["paused"] = False
+
+
+def dump(finished=True, profile_process="worker"):
+    engine.wait_all()
+    with _LOCK:
+        events = list(_EVENTS)
+    with open(_CONFIG["filename"], "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return _CONFIG["filename"]
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Aggregate per-op stats table (ref: AggregateStats::DumpTable)."""
+    with _LOCK:
+        events = list(_EVENTS)
+        if reset:
+            _EVENTS.clear()
+    agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
+    for e in events:
+        rec = agg[e["name"]]
+        rec[0] += 1
+        rec[1] += e["dur"]
+        rec[2] = min(rec[2], e["dur"])
+        rec[3] = max(rec[3], e["dur"])
+    rows = sorted(agg.items(),
+                  key=lambda kv: kv[1][1] if sort_by == "total" else kv[1][0],
+                  reverse=not ascending)
+    lines = ["%-40s %8s %12s %12s %12s %12s" %
+             ("Name", "Calls", "Total(us)", "Avg(us)", "Min(us)", "Max(us)")]
+    for name, (n, total, mn, mx) in rows:
+        lines.append("%-40s %8d %12.1f %12.1f %12.1f %12.1f"
+                     % (name[:40], n, total, total / n, mn, mx))
+    return "\n".join(lines)
+
+
+class _Scope:
+    def __init__(self, name, cat):
+        self.name = name
+        self.cat = cat
+        self._t = None
+
+    def start(self):
+        self._t = time.perf_counter()
+
+    def stop(self):
+        if self._t is None:
+            return
+        now = time.perf_counter()
+        with _LOCK:
+            _EVENTS.append({
+                "name": self.name, "cat": self.cat, "ph": "X",
+                "ts": (self._t - _T0) * 1e6,
+                "dur": (now - self._t) * 1e6,
+                "pid": os.getpid(),
+                "tid": threading.get_ident() % 100000,
+            })
+        self._t = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Task(_Scope):
+    def __init__(self, name, domain=None):
+        super().__init__(name, "task")
+
+
+class Frame(_Scope):
+    def __init__(self, name, domain=None):
+        super().__init__(name, "frame")
+
+
+class Marker:
+    def __init__(self, name, domain=None):
+        self.name = name
+
+    def mark(self, scope="process"):
+        with _LOCK:
+            _EVENTS.append({
+                "name": self.name, "cat": "marker", "ph": "i",
+                "ts": (time.perf_counter() - _T0) * 1e6,
+                "pid": os.getpid(), "s": "p",
+                "tid": threading.get_ident() % 100000,
+            })
+
+
+scope = _Scope
+
+
+def start_jax_trace(logdir="/tmp/jax-trace"):
+    """XLA-level tracing (XPlane/TensorBoard) — inside-executable timeline
+    the op-level chrome trace cannot see."""
+    import jax
+    jax.profiler.start_trace(logdir)
+
+
+def stop_jax_trace():
+    import jax
+    jax.profiler.stop_trace()

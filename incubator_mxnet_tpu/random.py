@@ -1,0 +1,92 @@
+"""Stateful RNG facade over JAX threefry keys.
+
+ref: python/mxnet/random.py (mx.random.seed) + per-device PRNG Resource
+(src/common/random_generator.h).  Each Context holds a key; every sampling
+op splits it (so results differ call-to-call) while `seed(n)` restores the
+reference's reproducibility contract.  Op bodies stay pure functions of an
+explicit key — the stateful part lives only here, outside any jit.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import jax
+
+from .context import Context, current_context
+
+__all__ = ["seed", "split_key", "current_key"]
+
+_LOCK = threading.Lock()
+_KEYS: Dict[Context, "jax.Array"] = {}
+_BASE_SEED = 0
+
+
+def seed(seed_state: int, ctx="all"):
+    """mx.random.seed — reseed one context or all (ref semantics)."""
+    global _BASE_SEED
+    with _LOCK:
+        if ctx == "all":
+            _BASE_SEED = int(seed_state)
+            _KEYS.clear()
+        else:
+            _KEYS[ctx] = jax.random.key(int(seed_state))
+
+
+def _ctx_key(ctx: Context):
+    if ctx not in _KEYS:
+        # derive deterministic per-context key from base seed + device id
+        _KEYS[ctx] = jax.random.fold_in(
+            jax.random.key(_BASE_SEED), hash((ctx.device_type,
+                                              ctx.device_id)) & 0x7FFFFFFF)
+    return _KEYS[ctx]
+
+
+class _TraceRng(threading.local):
+    """While a hybridized block is being traced, sampling ops must draw
+    from a *traced* key input (a host-side key would bake the random bits
+    into the executable as constants). The cached-op machinery pushes a
+    key holder here for the duration of the trace."""
+
+    def __init__(self):
+        self.stack = []
+
+
+_TRACE_STATE = _TraceRng()
+
+
+class KeyHolder:
+    __slots__ = ("key",)
+
+    def __init__(self, key):
+        self.key = key
+
+    def next(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+
+def push_trace_key(holder: KeyHolder):
+    _TRACE_STATE.stack.append(holder)
+
+
+def pop_trace_key():
+    return _TRACE_STATE.stack.pop()
+
+
+def split_key(ctx: Context = None):
+    """Split the context's key; returns a fresh subkey for one op call."""
+    if _TRACE_STATE.stack:
+        return _TRACE_STATE.stack[-1].next()
+    ctx = ctx or current_context()
+    with _LOCK:
+        key = _ctx_key(ctx)
+        new, sub = jax.random.split(key)
+        _KEYS[ctx] = new
+        return sub
+
+
+def current_key(ctx: Context = None):
+    ctx = ctx or current_context()
+    with _LOCK:
+        return _ctx_key(ctx)

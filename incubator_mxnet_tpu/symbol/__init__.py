@@ -1,0 +1,40 @@
+"""mx.sym namespace: Symbol + generated op stubs (same registry as nd)."""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops import registry as _registry
+from .symbol import (Symbol, var, Variable, Group, load, load_json,
+                     _eval_symbol, _apply)
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
+
+
+def _make_stub(opname):
+    def stub(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        sym_args = [a for a in args if isinstance(a, Symbol)]
+        attrs = {k: v for k, v in kwargs.items()
+                 if not isinstance(v, Symbol)}
+        sym_args += [v for v in kwargs.values() if isinstance(v, Symbol)]
+        return _apply(opname, sym_args, attrs, name=name)
+    stub.__name__ = opname
+    od = _registry.get(opname)
+    stub.__doc__ = od.doc
+    return stub
+
+
+_this = _sys.modules[__name__]
+for _opname in _registry.list_ops():
+    if not hasattr(_this, _opname):
+        setattr(_this, _opname, _make_stub(_opname))
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    return _apply("_zeros", [], {"shape": shape, "dtype": dtype},
+                  name=kwargs.get("name"))
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return _apply("_ones", [], {"shape": shape, "dtype": dtype},
+                  name=kwargs.get("name"))

@@ -1,0 +1,353 @@
+"""Symbol front-end — lazy operator graph.
+
+TPU-native re-design of ref: python/mxnet/symbol/symbol.py + nnvm graph
+(3rdparty/tvm/nnvm).  A Symbol is a node in a pure-python DAG over the
+SAME operator registry as mx.nd; binding a Symbol produces an Executor
+whose forward/backward is one jitted XLA computation (the GraphExecutor's
+nnvm passes — InferShape/InferType/PlanMemory/bulking — all collapse into
+jax.jit, SURVEY §3.4).
+
+Graphs serialise to JSON (`tojson`/`load`) with nodes/heads arrays shaped
+like the reference's symbol.json so tooling expectations carry over.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from ..ops import registry as _registry
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "_eval_symbol"]
+
+
+class Symbol:
+    """One graph node (op application or variable), possibly multi-output."""
+
+    __slots__ = ("op", "inputs", "attrs", "name", "num_outputs",
+                 "_out_index", "__weakref__")
+
+    def __init__(self, op: Optional[str], inputs, attrs, name,
+                 num_outputs=1, out_index=None):
+        self.op = op                      # None => variable
+        self.inputs = list(inputs)        # list[Symbol]
+        self.attrs = dict(attrs)
+        self.name = name
+        self.num_outputs = num_outputs
+        self._out_index = out_index       # not None => view of one output
+
+    # ------------------------------------------------------------------
+    @property
+    def outputs(self):
+        if self.op == "_group":
+            return list(self.inputs)
+        if self.num_outputs == 1:
+            return [self]
+        return [Symbol(self.op, self.inputs, self.attrs, self.name,
+                       self.num_outputs, out_index=i)
+                for i in range(self.num_outputs)]
+
+    def __getitem__(self, index):
+        outs = self.outputs
+        if isinstance(index, str):
+            names = self.list_outputs()
+            index = names.index(index)
+        return outs[index]
+
+    def __len__(self):
+        return len(self.outputs)
+
+    def __iter__(self):
+        return iter(self.outputs)
+
+    # -- graph walks -------------------------------------------------------
+    def _topo(self):
+        order, seen = [], set()
+        stack = [(self, False)]
+        while stack:
+            node, done = stack.pop()
+            base = node
+            if done:
+                order.append(base)
+                continue
+            if id(base) in seen:
+                continue
+            seen.add(id(base))
+            stack.append((base, True))
+            for inp in base.inputs:
+                if id(inp) not in seen:
+                    stack.append((inp, False))
+        return order
+
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in self._topo() if n.op is None]
+
+    def list_outputs(self) -> List[str]:
+        if self.op == "_group":
+            return [o.list_outputs()[0] for o in self.inputs]
+        if self.num_outputs == 1 or self._out_index is not None:
+            suffix = "" if self._out_index is None else str(self._out_index)
+            return ["%s_output%s" % (self.name, suffix)]
+        return ["%s_output%d" % (self.name, i)
+                for i in range(self.num_outputs)]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def get_internals(self):
+        nodes = [n for n in self._topo() if n.op is not None or True]
+        return Group([n for n in nodes])
+
+    def attr(self, key):
+        return self.attrs.get(key)
+
+    def attr_dict(self):
+        return {self.name: {k: str(v) for k, v in self.attrs.items()}}
+
+    # -- evaluation --------------------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        outs = _eval_symbol(self, kwargs)
+        return outs if isinstance(outs, list) else [outs]
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req)
+
+    def simple_bind(self, ctx, grad_req="write", shapes=None, **kwargs):
+        from ..executor import Executor
+        from .. import ndarray as nd
+        shapes = shapes or kwargs
+        args = {}
+        arg_shapes, _, _ = self.infer_shape(**shapes)
+        for name, shape in zip(self.list_arguments(), arg_shapes):
+            args[name] = nd.zeros(shape, ctx=ctx)
+        args_grad = None
+        if grad_req != "null":
+            args_grad = {name: nd.zeros(a.shape, ctx=ctx)
+                         for name, a in args.items()}
+        return Executor(self, ctx, args, args_grad, grad_req)
+
+    # -- shape/type inference ---------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """Via jax.eval_shape over the graph (XLA's abstract eval replaces
+        the nnvm InferShape pass)."""
+        import jax
+        import numpy as _np
+        arg_names = self.list_arguments()
+        shapes: Dict[str, tuple] = {}
+        if args:
+            for n, s in zip(arg_names, args):
+                if s is not None:
+                    shapes[n] = tuple(s)
+        shapes.update({k: tuple(v) for k, v in kwargs.items()
+                       if v is not None})
+        missing = [n for n in arg_names if n not in shapes]
+        if missing:
+            raise MXNetError("infer_shape: missing shapes for %s" % missing)
+        specs = {n: jax.ShapeDtypeStruct(shapes[n], _np.float32)
+                 for n in arg_names}
+
+        def f(feed):
+            return _eval_symbol(self, feed, raw=True)
+        out = jax.eval_shape(f, specs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return ([shapes[n] for n in arg_names],
+                [tuple(o.shape) for o in outs], [])
+
+    def infer_type(self, *args, **kwargs):
+        import numpy as _np
+        arg_names = self.list_arguments()
+        return ([_np.float32] * len(arg_names),
+                [_np.float32] * len(self.list_outputs()), [])
+
+    # -- serialisation -----------------------------------------------------
+    def tojson(self):
+        """symbol.json-shaped serialisation (nodes/arg_nodes/heads)."""
+        nodes = self._topo()
+        index = {id(n): i for i, n in enumerate(nodes)}
+        out_nodes = []
+        for n in nodes:
+            out_nodes.append({
+                "op": n.op or "null",
+                "name": n.name,
+                "attrs": {k: str(v) for k, v in n.attrs.items()},
+                "inputs": [[index[id(i)], i._out_index or 0, 0]
+                           for i in n.inputs],
+            })
+        heads = [[index[id(self)], self._out_index or 0, 0]] \
+            if self.op != "_group" else \
+            [[index[id(o)], o._out_index or 0, 0] for o in self.inputs]
+        return json.dumps({
+            "nodes": out_nodes,
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.op is None],
+            "node_row_ptr": list(range(len(nodes) + 1)),
+            "heads": heads,
+            "attrs": {"mxnet_version": ["str", "tpu-0.1.0"]},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- operators ---------------------------------------------------------
+    def _binary(self, other, opname, scalar_op, reverse=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _apply(opname, [a, b], {})
+        return _apply(scalar_op, [self], {"scalar": other})
+
+    def __add__(self, o):
+        return self._binary(o, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return _apply("_rminus_scalar", [self], {"scalar": o})
+
+    def __mul__(self, o):
+        return self._binary(o, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        return _apply("_rdiv_scalar", [self], {"scalar": o})
+
+    def __pow__(self, o):
+        return self._binary(o, "broadcast_power", "_power_scalar")
+
+    def __neg__(self):
+        return _apply("negative", [self], {})
+
+    def __repr__(self):
+        return "<Symbol %s>" % self.name
+
+    def __call__(self, *args, **kwargs):
+        raise MXNetError("symbol composition via __call__ is not supported "
+                         "in the TPU build; apply ops functionally")
+
+
+_COUNTER = {}
+
+
+def _auto_name(op):
+    n = _COUNTER.get(op, 0)
+    _COUNTER[op] = n + 1
+    return "%s%d" % (op.lower().lstrip("_"), n)
+
+
+def _apply(opname, inputs, attrs, name=None):
+    od = _registry.get(opname)
+    n_out = od.num_outputs
+    return Symbol(opname, inputs, attrs, name or _auto_name(opname),
+                  num_outputs=n_out if n_out > 0 else 1)
+
+
+def var(name, attr=None, shape=None, dtype=None, init=None, **kwargs):
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = shape
+    if dtype is not None:
+        attrs["__dtype__"] = str(dtype)
+    return Symbol(None, [], attrs, name)
+
+
+Variable = var
+
+
+def Group(symbols):
+    return Symbol("_group", list(symbols), {}, "group", len(symbols))
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes = []
+    for spec in data["nodes"]:
+        attrs = {k: _parse_attr(v) for k, v in
+                 (spec.get("attrs") or {}).items()}
+        if spec["op"] == "null":
+            nodes.append(var(spec["name"], attr=attrs))
+        else:
+            inputs = [nodes[i] if o == 0 else nodes[i].outputs[o]
+                      for i, o, _ in spec["inputs"]]
+            nodes.append(_apply(spec["op"], inputs, attrs,
+                                name=spec["name"]))
+    heads = data["heads"]
+    if len(heads) == 1:
+        i, o, _ = heads[0]
+        node = nodes[i]
+        return node if o == 0 else node.outputs[o]
+    return Group([nodes[i] if o == 0 else nodes[i].outputs[o]
+                  for i, o, _ in heads])
+
+
+def _parse_attr(v):
+    import ast
+    if not isinstance(v, str):
+        return v
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def _eval_symbol(sym, feed, raw=False):
+    """Evaluate a Symbol graph given {var_name: array-or-NDArray}.
+
+    raw=True: operate on jax arrays (used under jit/eval_shape).
+    Otherwise NDArray in/out (imperative path).
+    """
+    from ..ndarray.ndarray import NDArray, invoke
+
+    def unwrap(x):
+        return x._data if isinstance(x, NDArray) else x
+
+    cache: Dict[int, object] = {}
+    order = sym._topo()
+    for node in order:
+        if node.op is None:
+            if node.name not in feed:
+                raise MXNetError("missing input %r" % node.name)
+            cache[id(node)] = feed[node.name]
+        elif node.op == "_group":
+            continue
+        else:
+            ins = []
+            for i in node.inputs:
+                v = cache[id(i)]
+                if i._out_index is not None and isinstance(v, tuple):
+                    v = v[i._out_index]
+                ins.append(v)
+            attrs = dict(node.attrs)
+            if raw:
+                od = _registry.get(node.op)
+                ins = [unwrap(x) for x in ins]
+                out = od.fn(*ins, **attrs)
+            else:
+                out = invoke(node.op, *ins, **attrs)
+            cache[id(node)] = out
+
+    def fetch(node):
+        v = cache[id(node)]
+        if node._out_index is not None and isinstance(v, tuple):
+            return v[node._out_index]
+        return v
+
+    if sym.op == "_group":
+        return [fetch(o) for o in sym.inputs]
+    out = fetch(sym)
+    if isinstance(out, tuple) and sym._out_index is None:
+        return list(out)
+    return out

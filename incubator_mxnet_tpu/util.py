@@ -1,0 +1,27 @@
+"""Misc utilities (ref: python/mxnet/util.py — np-shape/np-array flags)."""
+from __future__ import annotations
+
+_NP_ARRAY = False
+
+
+def is_np_array() -> bool:
+    return _NP_ARRAY
+
+
+def set_np(shape=True, array=True):
+    global _NP_ARRAY
+    _NP_ARRAY = bool(array)
+
+
+def reset_np():
+    global _NP_ARRAY
+    _NP_ARRAY = False
+
+
+def use_np(func):
+    return func
+
+
+def makedirs(d):
+    import os
+    os.makedirs(d, exist_ok=True)

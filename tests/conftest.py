@@ -1,0 +1,30 @@
+"""Test config: run the whole corpus on a virtual 8-device CPU mesh.
+
+Mirrors the reference strategy (SURVEY §4): one op-test corpus, re-run
+per backend; distributed tests fake multi-chip as 8 virtual host devices
+(the analogue of multi-node-as-multi-process ps-lite tests).
+Set MXNET_TEST_DEVICE=tpu to run the corpus against a real chip.
+"""
+import os
+
+# must happen before jax import anywhere
+if os.environ.get("MXNET_TEST_DEVICE", "cpu") == "cpu":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            flags + " --xla_force_host_platform_device_count=8"
+
+import numpy as _np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything(request):
+    """Reproducible-but-varied seeds (ref: @with_seed() in
+    tests/python/unittest/common.py)."""
+    seed = abs(hash(request.node.nodeid)) % (2 ** 31)
+    _np.random.seed(seed)
+    import incubator_mxnet_tpu as mx
+    mx.random.seed(seed)
+    yield

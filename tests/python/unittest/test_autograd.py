@@ -1,0 +1,158 @@
+"""Autograd (ref: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd as ag
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_simple_grad():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain_rule():
+    x = nd.array(np.random.randn(3, 4).astype("float32"))
+    x.attach_grad()
+    with ag.record():
+        y = nd.exp(nd.sin(x)).sum()
+    y.backward()
+    expected = np.exp(np.sin(x.asnumpy())) * np.cos(x.asnumpy())
+    assert_almost_equal(x.grad, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_multi_input_grad():
+    a = nd.array(np.random.randn(3).astype("float32"))
+    b = nd.array(np.random.randn(3).astype("float32"))
+    a.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        y = (a * b + a).sum()
+    y.backward()
+    assert_almost_equal(a.grad, b.asnumpy() + 1)
+    assert_almost_equal(b.grad, a.asnumpy())
+
+
+def test_reused_input():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x
+    y.backward()
+    assert_almost_equal(x.grad, [12.0])
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+    y.backward(nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, [20.0, 200.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with ag.record():
+            y = (x * x).sum()
+        y.backward(retain_graph=False)
+    assert_almost_equal(x.grad, 6 * x.asnumpy())
+
+
+def test_detach_and_stop_gradient():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, [6.0])   # only d(z)/dx via direct term
+    with ag.record():
+        w = nd.BlockGrad(x * 2) * x
+    w.backward()
+    assert_almost_equal(x.grad, [6.0])
+
+
+def test_retain_graph():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+    y.backward(retain_graph=True)
+    assert_almost_equal(x.grad, [4.0])
+    y.backward()
+    assert_almost_equal(x.grad, [4.0])
+    with pytest.raises(mx.MXNetError):
+        y.backward()
+
+
+def test_autograd_grad_api():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x ** 2).sum()
+    (gx,) = ag.grad([y], [x])
+    assert_almost_equal(gx, 2 * x.asnumpy())
+
+
+def test_is_recording_training():
+    assert not ag.is_recording()
+    with ag.record():
+        assert ag.is_recording()
+        assert ag.is_training()
+        with ag.pause():
+            assert not ag.is_recording()
+    with ag.record(train_mode=False):
+        assert not ag.is_training()
+    with ag.train_mode():
+        assert ag.is_training()
+
+
+def test_no_grad_for_untracked():
+    x = nd.array([1.0])
+    with ag.record():
+        y = x * 2      # x not tracked
+    assert y._tape_node is None
+    with pytest.raises(mx.MXNetError):
+        y.backward()
+
+
+def test_grad_through_multi_output_op():
+    x = nd.array(np.random.randn(2, 6).astype("float32"))
+    x.attach_grad()
+    with ag.record():
+        parts = nd.split(x, 3, axis=1)
+        y = parts[0].sum() + (parts[2] * 2).sum()
+    y.backward()
+    g = x.grad.asnumpy()
+    assert_almost_equal(g[:, 0:2], np.ones((2, 2)))
+    assert_almost_equal(g[:, 2:4], np.zeros((2, 2)))
+    assert_almost_equal(g[:, 4:6], 2 * np.ones((2, 2)))
+
+
+def test_getitem_grad():
+    x = nd.array(np.arange(6, dtype="float32").reshape(2, 3))
+    x.attach_grad()
+    with ag.record():
+        y = x[0].sum() * 3
+    y.backward()
+    expected = np.zeros((2, 3), "float32")
+    expected[0] = 3
+    assert_almost_equal(x.grad, expected)
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 2.0])
+    g = nd.zeros((2,))
+    ag.mark_variables([x], [g])
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())

@@ -1,0 +1,262 @@
+"""Gluon blocks (ref: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd as ag, gluon
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    p.initialize(ctx=mx.cpu())
+    assert p.data().shape == (3, 4)
+    assert p.list_ctx() == [mx.cpu()]
+    assert p.grad().shape == (3, 4)
+    p.zero_grad()
+    assert float(p.grad().norm().asscalar()) == 0
+
+
+def test_parameter_deferred_init():
+    p = gluon.Parameter("weight", shape=(3, 0), allow_deferred_init=True)
+    p.initialize(ctx=mx.cpu())
+    with pytest.raises(gluon.DeferredInitializationError):
+        p.data()
+    p.shape = (3, 7)
+    p._finish_deferred_init()
+    assert p.data().shape == (3, 7)
+
+
+def test_dense_shapes_and_flatten():
+    net = nn.Dense(8, in_units=4)
+    net.initialize()
+    assert net(nd.ones((2, 4))).shape == (2, 8)
+    # deferred in_units
+    net2 = nn.Dense(8)
+    net2.initialize()
+    assert net2(nd.ones((2, 5))).shape == (2, 8)
+    assert net2.weight.shape == (8, 5)
+    # flatten=False keeps leading dims
+    net3 = nn.Dense(8, flatten=False)
+    net3.initialize()
+    assert net3(nd.ones((2, 3, 5))).shape == (2, 3, 8)
+    # flatten=True collapses
+    net4 = nn.Dense(8)
+    net4.initialize()
+    assert net4(nd.ones((2, 3, 5))).shape == (2, 8)
+
+
+def test_sequential_and_children():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    out = net(nd.ones((2, 8)))
+    assert out.shape == (2, 4)
+    assert len(net) == 2
+    params = net.collect_params()
+    assert len(params) == 4
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = nd.array(np.random.randn(3, 8).astype("float32"))
+    imp = net(x).asnumpy()
+    net.hybridize()
+    hyb = net(x).asnumpy()
+    assert_almost_equal(imp, hyb, rtol=1e-5, atol=1e-5)
+    hyb2 = net(x).asnumpy()     # steady-state cached call
+    assert_almost_equal(hyb, hyb2)
+
+
+def test_hybridize_backward_matches_imperative():
+    x = nd.array(np.random.randn(4, 6).astype("float32"))
+
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(5, activation="tanh"), nn.Dense(2))
+        return net
+    net1 = build()
+    net1.initialize()
+    net1(x)          # materialise deferred shapes before copying
+    net2 = build()
+    net2.initialize()
+    net2(x)
+    for (k1, p1), (k2, p2) in zip(net1.collect_params().items(),
+                                  net2.collect_params().items()):
+        p2.set_data(p1.data())
+    net2.hybridize()
+    with ag.record():
+        l1 = (net1(x) ** 2).sum()
+    l1.backward()
+    with ag.record():
+        l2 = (net2(x) ** 2).sum()
+    l2.backward()
+    g1 = [p.grad().asnumpy() for p in net1.collect_params().values()
+          if p.grad_req != "null"]
+    g2 = [p.grad().asnumpy() for p in net2.collect_params().values()
+          if p.grad_req != "null"]
+    for a, b in zip(g1, g2):
+        assert_almost_equal(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_layers():
+    x = nd.array(np.random.randn(2, 3, 8, 8).astype("float32"))
+    conv = nn.Conv2D(6, 3, padding=1)
+    conv.initialize()
+    assert conv(x).shape == (2, 6, 8, 8)
+    convs = nn.Conv2D(6, 3, strides=2)
+    convs.initialize()
+    assert convs(x).shape == (2, 6, 3, 3)
+    deconv = nn.Conv2DTranspose(4, 2, strides=2)
+    deconv.initialize()
+    assert deconv(x).shape == (2, 4, 16, 16)
+    c1 = nn.Conv1D(4, 3)
+    c1.initialize()
+    assert c1(nd.ones((2, 3, 10))).shape == (2, 4, 8)
+
+
+def test_pool_layers():
+    x = nd.array(np.random.randn(2, 3, 8, 8).astype("float32"))
+    assert nn.MaxPool2D()(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D((2, 2), strides=1)(x).shape == (2, 3, 7, 7)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    assert nn.GlobalMaxPool2D()(x).shape == (2, 3, 1, 1)
+
+
+def test_norm_layers():
+    x = nd.array(np.random.randn(4, 6, 5, 5).astype("float32"))
+    bn = nn.BatchNorm()
+    bn.initialize()
+    out = bn(x)
+    assert out.shape == x.shape
+    ln = nn.LayerNorm()
+    ln.initialize()
+    assert ln(nd.ones((2, 5))).shape == (2, 5)
+    inorm = nn.InstanceNorm()
+    inorm.initialize()
+    assert inorm(x).shape == x.shape
+    gn = nn.GroupNorm(num_groups=3)
+    gn.initialize()
+    assert gn(x).shape == x.shape
+
+
+def test_embedding_block():
+    emb = nn.Embedding(20, 8)
+    emb.initialize()
+    out = emb(nd.array([1, 3, 5], dtype="int32"))
+    assert out.shape == (3, 8)
+
+
+def test_block_save_load(tmp_path):
+    fname = str(tmp_path / "net.params")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(6, in_units=4), nn.Dense(2, in_units=6))
+    net.initialize()
+    x = nd.ones((1, 4))
+    ref = net(x).asnumpy()
+    net.save_parameters(fname)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(6, in_units=4), nn.Dense(2, in_units=6))
+    net2.load_parameters(fname)
+    assert_almost_equal(net2(x), ref)
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    x = nd.array([[1.0, 2.0]])
+    w_before = net.weight.data().asnumpy().copy()
+    with ag.record():
+        y = net(x).sum()
+    y.backward()
+    trainer.step(1)
+    expected = w_before - 0.5 * np.array([[1.0, 2.0]])
+    assert_almost_equal(net.weight.data(), expected, rtol=1e-5)
+
+
+def test_trainer_save_load_states(tmp_path):
+    fname = str(tmp_path / "trainer.states")
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = nd.ones((1, 3))
+    with ag.record():
+        net(x).sum().backward()
+    trainer.step(1)
+    trainer.save_states(fname)
+    trainer.load_states(fname)
+
+
+def test_loss_blocks():
+    pred = nd.array(np.random.randn(4, 5).astype("float32"))
+    label = nd.array([0, 1, 2, 3])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (4,)
+    p = pred.asnumpy()
+    e = np.exp(p - p.max(-1, keepdims=True))
+    sm = e / e.sum(-1, keepdims=True)
+    expect = -np.log(sm[np.arange(4), [0, 1, 2, 3]])
+    assert_almost_equal(l, expect, rtol=1e-4, atol=1e-5)
+    l2 = gluon.loss.L2Loss()(pred, nd.zeros((4, 5)))
+    assert_almost_equal(l2, (p ** 2).mean(-1) / 2, rtol=1e-4, atol=1e-5)
+    l1 = gluon.loss.L1Loss()(pred, nd.zeros((4, 5)))
+    assert_almost_equal(l1, np.abs(p).mean(-1), rtol=1e-4, atol=1e-5)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    new_total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert new_total == pytest.approx(1.0, rel=1e-3)
+
+
+def test_split_and_load():
+    data = nd.array(np.arange(12).reshape(6, 2))
+    parts = gluon.utils.split_and_load(data, [mx.cpu(), mx.cpu()])
+    assert len(parts) == 2 and parts[0].shape == (3, 2)
+
+
+def test_rnn_cells_unroll():
+    cell = gluon.rnn.LSTMCell(8, input_size=4)
+    cell.initialize()
+    seq = nd.array(np.random.randn(2, 5, 4).astype("float32"))  # NTC
+    outputs, states = cell.unroll(5, seq, layout="NTC")
+    assert outputs.shape == (2, 5, 8)
+    assert states[0].shape == (2, 8)
+    gru = gluon.rnn.GRUCell(8, input_size=4)
+    gru.initialize()
+    outputs, _ = gru.unroll(5, seq, layout="NTC")
+    assert outputs.shape == (2, 5, 8)
+
+
+def test_rnn_layer_training():
+    lstm = gluon.rnn.LSTM(8, num_layers=1)
+    lstm.initialize()
+    seq = nd.array(np.random.randn(6, 2, 4).astype("float32"))
+    with ag.record():
+        out = lstm(seq)
+        out.sum().backward()
+    assert out.shape == (6, 2, 8)
+    assert float(lstm.parameters.grad().norm().asscalar()) > 0
+
+
+def test_resnet_smoke():
+    from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+    net = resnet18_v1(classes=10)
+    net.initialize()
+    x = nd.array(np.random.randn(1, 3, 32, 32).astype("float32"))
+    out = net(x)
+    assert out.shape == (1, 10)
+
+
+def test_constant_parameter():
+    const = gluon.Constant("const_test_w", [[1.0, 2.0]])
+    const.initialize()
+    assert const.data().shape == (1, 2)
+    assert const.grad_req == "null"

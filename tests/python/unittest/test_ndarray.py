@@ -1,0 +1,252 @@
+"""NDArray basics (ref: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal, same
+
+
+def test_array_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    assert a.context == mx.cpu()
+    b = nd.array(np.arange(6).reshape(2, 3).astype("int32"))
+    assert b.dtype == np.int32
+    assert same(b, np.arange(6).reshape(2, 3))
+
+
+def test_zeros_ones_full():
+    assert same(nd.zeros((2, 3)), np.zeros((2, 3)))
+    assert same(nd.ones((2, 3)), np.ones((2, 3)))
+    assert same(nd.full((2,), 7), np.full((2,), 7.0))
+    assert same(nd.eye(3), np.eye(3))
+    assert same(nd.arange(0, 10, 2), np.arange(0, 10, 2))
+
+
+def test_elementwise_arith():
+    a_np = np.random.randn(3, 4).astype("float32")
+    b_np = np.random.randn(3, 4).astype("float32")
+    a, b = nd.array(a_np), nd.array(b_np)
+    assert_almost_equal(a + b, a_np + b_np)
+    assert_almost_equal(a - b, a_np - b_np)
+    assert_almost_equal(a * b, a_np * b_np)
+    assert_almost_equal(a / b, a_np / b_np)
+    assert_almost_equal(a + 2, a_np + 2)
+    assert_almost_equal(2 - a, 2 - a_np)
+    assert_almost_equal(a * 0.5, a_np * 0.5)
+    assert_almost_equal(1.0 / (a + 10), 1.0 / (a_np + 10))
+    assert_almost_equal(-a, -a_np)
+    assert_almost_equal(abs(a), np.abs(a_np))
+    assert_almost_equal((a + 10) ** 2, (a_np + 10) ** 2)
+
+
+def test_inplace_ops():
+    a_np = np.random.randn(3, 4).astype("float32")
+    a = nd.array(a_np)
+    a += 1
+    assert_almost_equal(a, a_np + 1)
+    a *= 2
+    assert_almost_equal(a, (a_np + 1) * 2)
+
+
+def test_comparison():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    assert same(a == b, [0, 1, 0])
+    assert same(a != b, [1, 0, 1])
+    assert same(a > b, [0, 0, 1])
+    assert same(a >= b, [0, 1, 1])
+    assert same(a < b, [1, 0, 0])
+    assert same(a <= b, [1, 1, 0])
+
+
+def test_reshape_transpose():
+    a_np = np.arange(24).astype("float32").reshape(2, 3, 4)
+    a = nd.array(a_np)
+    assert same(a.reshape(6, 4), a_np.reshape(6, 4))
+    assert same(a.reshape((-1, 4)), a_np.reshape(-1, 4))
+    assert same(a.reshape((0, -1)), a_np.reshape(2, 12))    # magic 0
+    assert same(a.T, a_np.T)
+    assert same(a.transpose((2, 0, 1)), a_np.transpose(2, 0, 1))
+    assert same(a.swapaxes(0, 1), a_np.swapaxes(0, 1))
+    assert same(a.flatten(), a_np.reshape(2, -1))
+    assert same(a.expand_dims(1), a_np[:, None])
+    assert same(nd.squeeze(a.expand_dims(0), axis=0), a_np)
+
+
+def test_reshape_magic():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((2, -4, 3, 1, 4)).shape == (2, 3, 1, 4)
+    assert a.reshape((0, 0, -1)).shape == (2, 3, 4)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+
+
+def test_indexing():
+    a_np = np.arange(24).astype("float32").reshape(4, 6)
+    a = nd.array(a_np)
+    assert same(a[1], a_np[1])
+    assert same(a[1:3], a_np[1:3])
+    assert same(a[:, 2:4], a_np[:, 2:4])
+    assert float(a[2, 3].asscalar()) == a_np[2, 3]
+    idx = nd.array(np.array([0, 2]), dtype="int32")
+    assert same(a[idx], a_np[[0, 2]])
+
+
+def test_setitem():
+    a = nd.zeros((3, 4))
+    a[1] = 5.0
+    expected = np.zeros((3, 4), "float32")
+    expected[1] = 5
+    assert same(a, expected)
+    a[0, 2] = 3.0
+    expected[0, 2] = 3
+    assert same(a, expected)
+    a[2] = nd.ones((4,))
+    expected[2] = 1
+    assert same(a, expected)
+
+
+def test_reduce():
+    a_np = np.random.rand(3, 4, 5).astype("float32")
+    a = nd.array(a_np)
+    assert_almost_equal(a.sum(), a_np.sum())
+    assert_almost_equal(a.sum(axis=1), a_np.sum(1))
+    assert_almost_equal(a.mean(axis=(0, 2)), a_np.mean((0, 2)))
+    assert_almost_equal(nd.sum(a, axis=1, exclude=True),
+                        a_np.sum((0, 2)))
+    assert_almost_equal(a.max(axis=0), a_np.max(0))
+    assert_almost_equal(a.min(axis=-1, keepdims=True),
+                        a_np.min(-1, keepdims=True))
+    assert_almost_equal(a.norm(), np.sqrt((a_np ** 2).sum()), rtol=1e-4)
+    assert same(a.argmax(axis=2), a_np.argmax(2))
+    assert same(a.argmin(axis=0), a_np.argmin(0))
+
+
+def test_dot():
+    a_np = np.random.randn(4, 5).astype("float32")
+    b_np = np.random.randn(5, 6).astype("float32")
+    assert_almost_equal(nd.dot(nd.array(a_np), nd.array(b_np)),
+                        a_np @ b_np, rtol=1e-4, atol=1e-4)
+    assert_almost_equal(
+        nd.dot(nd.array(a_np), nd.array(b_np.T), transpose_b=True),
+        a_np @ b_np, rtol=1e-4, atol=1e-4)
+    x = np.random.randn(3, 4, 5).astype("float32")
+    y = np.random.randn(3, 5, 2).astype("float32")
+    assert_almost_equal(nd.batch_dot(nd.array(x), nd.array(y)),
+                        np.matmul(x, y), rtol=1e-4, atol=1e-4)
+
+
+def test_concat_stack_split():
+    a_np = np.random.randn(2, 3).astype("float32")
+    b_np = np.random.randn(2, 3).astype("float32")
+    a, b = nd.array(a_np), nd.array(b_np)
+    assert same(nd.concat(a, b, dim=0), np.concatenate([a_np, b_np], 0))
+    assert same(nd.concat(a, b, dim=1), np.concatenate([a_np, b_np], 1))
+    assert same(nd.stack(a, b, axis=0), np.stack([a_np, b_np]))
+    parts = nd.split(nd.array(np.arange(12).reshape(2, 6)), 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+
+
+def test_take_pick_gather():
+    a_np = np.random.randn(5, 4).astype("float32")
+    a = nd.array(a_np)
+    idx = nd.array([0, 2], dtype="int32")
+    assert same(nd.take(a, idx), a_np[[0, 2]])
+    pick_idx = nd.array([0, 1, 2, 3, 0], dtype="int32")
+    assert same(nd.pick(a, pick_idx, axis=1),
+                a_np[np.arange(5), [0, 1, 2, 3, 0]])
+    indices = nd.array(np.array([[1, 3], [0, 2]]), dtype="int32")
+    assert same(nd.gather_nd(a, indices), a_np[[1, 3], [0, 2]])
+
+
+def test_where_clip_onehot():
+    a_np = np.random.randn(3, 4).astype("float32")
+    a = nd.array(a_np)
+    assert_almost_equal(a.clip(-0.5, 0.5), np.clip(a_np, -0.5, 0.5))
+    cond = nd.array((a_np > 0).astype("float32"))
+    assert same(nd.where(cond, a, -a),
+                np.where(a_np > 0, a_np, -a_np))
+    oh = nd.one_hot(nd.array([0, 2, 1], dtype="int32"), 3)
+    assert same(oh, np.eye(3)[[0, 2, 1]])
+
+
+def test_ordering():
+    a_np = np.random.randn(4, 8).astype("float32")
+    a = nd.array(a_np)
+    assert same(nd.sort(a, axis=1), np.sort(a_np, 1))
+    assert same(nd.argsort(a, axis=1), np.argsort(a_np, 1, kind="stable"))
+    vals = nd.topk(a, k=3, axis=1, ret_typ="value")
+    expect = -np.sort(-a_np, axis=1)[:, :3]
+    assert_almost_equal(vals, expect)
+
+
+def test_copy_context():
+    a = nd.array([1.0, 2.0])
+    b = a.copy()
+    b += 1
+    assert same(a, [1, 2])
+    c = a.as_in_context(mx.cpu())
+    assert c.context == mx.cpu()
+    out = nd.zeros((2,))
+    a.copyto(out)
+    assert same(out, [1, 2])
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays.bin")
+    d = {"w": nd.array(np.random.randn(3, 4).astype("float32")),
+         "b": nd.array(np.random.randn(4).astype("float32"))}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert same(loaded["w"], d["w"].asnumpy())
+    lst = [nd.array([1.0]), nd.array([2.0, 3.0])]
+    nd.save(fname, lst)
+    loaded = nd.load(fname)
+    assert same(loaded[0], [1]) and same(loaded[1], [2, 3])
+
+
+def test_dtype_cast():
+    a = nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.astype("bfloat16")
+    assert c.dtype.name == "bfloat16"
+    assert_almost_equal(c.astype("float32"), [1.5, 2.5])
+
+
+def test_broadcast_ops():
+    a_np = np.random.randn(3, 1).astype("float32")
+    b_np = np.random.randn(1, 4).astype("float32")
+    a, b = nd.array(a_np), nd.array(b_np)
+    assert_almost_equal(nd.broadcast_add(a, b), a_np + b_np)
+    assert_almost_equal(nd.broadcast_mul(a, b), a_np * b_np)
+    assert same(nd.broadcast_to(nd.array([[1.0], [2.0]]), (2, 3)),
+                np.broadcast_to([[1.], [2.]], (2, 3)))
+    assert_almost_equal(nd.broadcast_maximum(a, b), np.maximum(a_np, b_np))
+
+
+def test_wait_and_scalar():
+    a = nd.ones((2, 2))
+    a.wait_to_read()
+    nd.waitall()
+    s = nd.array([3.5])
+    assert float(s) == 3.5
+    assert s.asscalar() == 3.5
+    with pytest.raises(ValueError):
+        nd.ones((2, 2)).asscalar()
+
+
+def test_sequence_ops():
+    data = nd.array(np.arange(24).reshape(4, 3, 2))  # (T,B,D)
+    length = nd.array([2, 3, 1], dtype="int32")
+    masked = nd.SequenceMask(data, length, use_sequence_length=True,
+                             value=-1.0)
+    out = masked.asnumpy()
+    assert out[2, 0, 0] == -1 and out[1, 1, 0] != -1
+    last = nd.SequenceLast(data, length, use_sequence_length=True)
+    assert last.shape == (3, 2)
+    assert last.asnumpy()[0, 0] == data.asnumpy()[1, 0, 0]
