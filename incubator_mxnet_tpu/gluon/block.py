@@ -91,7 +91,7 @@ def record_state_update(param, new_value_nd):
         _STATE.active.append((param, new_value_nd._data))
         return
     for ctx, arr in param._data.items():
-        arr._data = new_value_nd._data
+        arr._data = new_value_nd._data.astype(arr._data.dtype)
         break
 
 
@@ -269,7 +269,9 @@ class _CachedGraph:
         self.params = None          # ordered Parameter objects
         self.state_params = None    # params receiving state updates
         self.out_treedef = None
-        self._jitted = {}           # training_flag -> jitted fn
+        self._jitted = {}           # training_flag -> jitted forward
+        self._raw = {}              # training_flag -> unjitted pure
+        self._jit_bwd = {}          # training_flag -> jitted backward
         self._jax = jax
 
     def _collect_params(self):
@@ -316,43 +318,115 @@ class _CachedGraph:
                     tuple(v for _, v in states))
         return pure
 
+    def _get_flat(self, training, np_, ni_):
+        """pure_flat(*leaves) -> flat tuple(outs + states); leaves =
+        params + inputs + key_bits."""
+        if training not in self._raw:
+            self._raw[training] = self._make_pure(training)
+        pure = self._raw[training]
+
+        def pure_flat(*leaves):
+            pv = leaves[:np_]
+            iv = leaves[np_:np_ + ni_]
+            kb = leaves[-1]
+            outs, states = pure(pv, iv, kb)
+            return tuple(outs) + tuple(states)
+        return pure_flat
+
+    def _get_bwd(self, training, np_, ni_, float_idx):
+        """Cached jitted backward: recomputes forward under jit (remat —
+        XLA buffer-shares what it can) and applies the transpose; ONE
+        compiled executable per (shape, training) signature, the
+        CachedOp::Backward equivalent.  Only float leaves (index list is
+        static per signature) are differentiated."""
+        import jax
+        key = (training, tuple(float_idx), np_, ni_)
+        if key in self._jit_bwd:
+            return self._jit_bwd[key]
+        pure_flat = self._get_flat(training, np_, ni_)
+
+        def bwd(float_leaves, other_leaves, cots):
+            # merge float/non-float back into positional order
+            def f(*fl):
+                leaves = list(other_leaves)
+                full = [None] * (len(fl) + len(other_leaves))
+                oi = 0
+                fi = 0
+                for i in range(len(full)):
+                    if i in key[1]:
+                        full[i] = fl[fi]; fi += 1
+                    else:
+                        full[i] = leaves[oi]; oi += 1
+                return pure_flat(*full)
+            _, vjp = jax.vjp(f, *float_leaves)
+            return vjp(cots)
+        self._jit_bwd[key] = jax.jit(bwd)
+        return self._jit_bwd[key]
+
     def __call__(self, args):
         import jax
+        import jax.numpy as jnp
+        import numpy as _np2
         if self.param_names is None:
             self._collect_params()
         training = _ag.is_training()
         ctx = args[0].context if args and isinstance(args[0], NDArray) \
             else current_context()
 
-        if training not in self._jitted:
-            self._jitted[training] = jax.jit(self._make_pure(training))
-        fn = self._jitted[training]
-
         param_nds = [p.data(ctx) for p in self.params]
         key_bits = jax.random.key_data(_rnd.split_key(ctx))
         key_nd = NDArray(key_bits, ctx=ctx)
-
-        # flatten for apply_fn: it records vjp over NDArray positions
         flat_inputs = list(param_nds) + list(args) + [key_nd]
         np_, ni_ = len(param_nds), len(args)
 
-        def fn_flat(*leaves):
-            pv = leaves[:np_]
-            iv = leaves[np_:np_ + ni_]
-            kb = leaves[-1]
-            outs, states = fn(pv, iv, kb)
-            return tuple(outs) + tuple(states)
+        fkey = (training, np_, ni_)
+        if fkey not in self._jitted:
+            self._jitted[fkey] = jax.jit(
+                self._get_flat(training, np_, ni_))
+        fwd = self._jitted[fkey]
 
-        result = apply_fn(fn_flat, flat_inputs, {},
-                          name=self.block.name + "_cachedop", ctx=ctx)
-        if not isinstance(result, tuple):
-            result = (result,)
+        leaf_data = [a._data for a in flat_inputs]
+        from .. import engine as _engine
+        with _engine._dispatch_hook(self.block.name + "_cachedop", ctx):
+            result = fwd(*leaf_data)
+        if _engine.naive_mode():
+            for o in result:
+                o.block_until_ready()
+        wrapped = tuple(NDArray(o, ctx=ctx) for o in result)
+
+        record = _ag.is_recording() and any(
+            _ag._requires_tracking(a) for a in flat_inputs)
+        if record:
+            float_idx = tuple(
+                i for i, d in enumerate(leaf_data)
+                if jnp.issubdtype(d.dtype, jnp.inexact))
+            bwd = self._get_bwd(training, np_, ni_, float_idx)
+            floats = tuple(leaf_data[i] for i in float_idx)
+            others = tuple(d for i, d in enumerate(leaf_data)
+                           if i not in float_idx)
+
+            def vjp_fn(cots):
+                gf = bwd(floats, others, tuple(cots))
+                out = []
+                fi = 0
+                for i in range(len(leaf_data)):
+                    if i in float_idx:
+                        out.append(gf[fi]); fi += 1
+                    else:
+                        out.append(_np2.zeros((), jax.dtypes.float0))
+                return tuple(out)
+
+            _ag.record_op(vjp_fn, flat_inputs, wrapped,
+                          name=self.block.name + "_cachedop",
+                          out_is_tuple=True)
+
         n_states = len(self.state_params or ())
-        outs = result[:len(result) - n_states]
-        states = result[len(result) - n_states:]
+        outs = wrapped[:len(wrapped) - n_states]
+        states = wrapped[len(wrapped) - n_states:]
         for p, s in zip(self.state_params or (), states):
             for c in list(p._data.keys()):
-                p._data[c]._data = s._data
+                # keep the param's stored dtype (stats compute in f32)
+                p._data[c]._data = s._data.astype(p._data[c]._data.dtype)
                 break
         return _unflatten_out(list(outs), self.out_treedef)
 

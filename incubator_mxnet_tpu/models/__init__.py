@@ -1,0 +1,7 @@
+"""Model families beyond the Gluon model zoo (transformer/BERT etc.)."""
+from . import transformer
+from .transformer import (BERTModel, TransformerEncoder, bert_base,
+                          bert_small)
+
+__all__ = ["transformer", "BERTModel", "TransformerEncoder", "bert_base",
+           "bert_small"]

@@ -1,0 +1,153 @@
+"""Transformer encoder (BERT-style) built on Gluon layers.
+
+Parity target: BASELINE.json config 2 (BERT-base MLM pretrain, GluonNLP
+`BERTEncoder`-equivalent; ref upstream: gluon-nlp bert.py — the reference
+repo itself carries only contrib attention ops, see
+src/operator/contrib/transformer.cc interleaved_matmul_*).
+
+TPU-first notes: attention is jnp einsum/matmul on the MXU; bf16-friendly;
+Dense weights are laid out so tensor-parallel sharding (P('model', None))
+splits heads / FFN columns cleanly over the mesh's 'model' axis.
+"""
+from __future__ import annotations
+
+import math
+
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+
+__all__ = ["MultiHeadAttention", "PositionwiseFFN",
+           "TransformerEncoderLayer", "TransformerEncoder", "BERTModel",
+           "bert_base", "bert_small"]
+
+
+class MultiHeadAttention(HybridBlock):
+    def __init__(self, units, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        assert units % num_heads == 0
+        self._units = units
+        self._num_heads = num_heads
+        self.query = nn.Dense(units, flatten=False, use_bias=True)
+        self.key = nn.Dense(units, flatten=False, use_bias=True)
+        self.value = nn.Dense(units, flatten=False, use_bias=True)
+        self.proj = nn.Dense(units, flatten=False, use_bias=True)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x, mask=None):
+        from .. import ndarray as F
+        B, T, C = x.shape
+        H = self._num_heads
+        d = C // H
+        q = self.query(x).reshape((B, T, H, d)).transpose((0, 2, 1, 3))
+        k = self.key(x).reshape((B, T, H, d)).transpose((0, 2, 1, 3))
+        v = self.value(x).reshape((B, T, H, d)).transpose((0, 2, 1, 3))
+        scores = F.batch_dot(q.reshape((B * H, T, d)),
+                             k.reshape((B * H, T, d)),
+                             transpose_b=True) / math.sqrt(d)
+        if mask is not None:
+            scores = scores.reshape((B, H, T, T)) + mask
+            scores = scores.reshape((B * H, T, T))
+        attn = F.softmax(scores, axis=-1)
+        if self.dropout is not None:
+            attn = self.dropout(attn)
+        ctx = F.batch_dot(attn, v.reshape((B * H, T, d)))
+        ctx = ctx.reshape((B, H, T, d)).transpose((0, 2, 1, 3)) \
+            .reshape((B, T, C))
+        return self.proj(ctx)
+
+
+class PositionwiseFFN(HybridBlock):
+    def __init__(self, units, hidden_size, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.ffn1 = nn.Dense(hidden_size, flatten=False)
+        self.ffn2 = nn.Dense(units, flatten=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        from .. import ndarray as F
+        h = F.LeakyReLU(self.ffn1(x), act_type="gelu")
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return self.ffn2(h)
+
+
+class TransformerEncoderLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.attn = MultiHeadAttention(units, num_heads, dropout)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout)
+        self.ln1 = nn.LayerNorm(in_channels=units)
+        self.ln2 = nn.LayerNorm(in_channels=units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x, mask=None):
+        h = self.attn(x, mask)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        x = self.ln1(x + h)
+        h = self.ffn(x)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return self.ln2(x + h)
+
+
+class TransformerEncoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads,
+                 dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.layers = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.layers.add(TransformerEncoderLayer(
+                units, hidden_size, num_heads, dropout))
+
+    def forward(self, x, mask=None):
+        for layer in self.layers._children.values():
+            x = layer(x, mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """Token + position embeddings → encoder → MLM head."""
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self.word_embed = nn.Embedding(vocab_size, units)
+        self.pos_embed = nn.Embedding(max_length, units)
+        self.ln = nn.LayerNorm(in_channels=units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+        self.encoder = TransformerEncoder(num_layers, units, hidden_size,
+                                          num_heads, dropout)
+        self.mlm_dense = nn.Dense(units, flatten=False, activation=None)
+        self.mlm_ln = nn.LayerNorm(in_channels=units)
+        self.decoder = nn.Dense(vocab_size, flatten=False)
+
+    def forward(self, tokens):
+        from .. import ndarray as F
+        B, T = tokens.shape
+        pos = F.arange_like(tokens.slice_axis(0, 0, 1).reshape((-1,)))
+        x = self.word_embed(tokens) + self.pos_embed(pos)
+        x = self.ln(x)
+        if self.dropout is not None:
+            x = self.dropout(x)
+        x = self.encoder(x)
+        h = F.LeakyReLU(self.mlm_dense(x), act_type="gelu")
+        h = self.mlm_ln(h)
+        return self.decoder(h)
+
+
+def bert_base(vocab_size=30522, **kwargs):
+    return BERTModel(vocab_size=vocab_size, units=768, hidden_size=3072,
+                     num_layers=12, num_heads=12, **kwargs)
+
+
+def bert_small(vocab_size=1000, **kwargs):
+    kwargs.setdefault("units", 64)
+    kwargs.setdefault("hidden_size", 128)
+    kwargs.setdefault("num_layers", 2)
+    kwargs.setdefault("num_heads", 4)
+    kwargs.setdefault("max_length", 128)
+    return BERTModel(vocab_size=vocab_size, **kwargs)

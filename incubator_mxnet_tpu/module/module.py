@@ -248,7 +248,9 @@ class Module(BaseModule):
             feed[name] = arr
         if data_batch.label is not None:
             for name, arr in zip(self._label_names, data_batch.label):
-                feed[name] = arr
+                # graphs without an in-graph loss have no label arg
+                if name in self._exec.arg_dict:
+                    feed[name] = arr
         self._exec.forward(is_train=is_train, **feed)
 
     def backward(self, out_grads=None):

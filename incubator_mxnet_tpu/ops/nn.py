@@ -31,9 +31,11 @@ def fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
     MXU hot path — XLA fuses the bias add."""
     if flatten and data.ndim > 2:
         data = data.reshape(data.shape[0], -1)
+    if weight.dtype != data.dtype:      # amp: follow activation dtype
+        weight = weight.astype(data.dtype)
     out = jnp.matmul(data, weight.T)
     if not no_bias and bias is not None:
-        out = out + bias
+        out = out + bias.astype(out.dtype)
     return out
 
 
@@ -65,6 +67,8 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     stride = tuple(stride) if stride else (1,) * k
     dilate = tuple(dilate) if dilate else (1,) * k
     pad = tuple(pad) if pad else (0,) * k
+    if weight.dtype != data.dtype:      # amp: follow activation dtype
+        weight = weight.astype(data.dtype)
     dn = lax.conv_dimension_numbers(data.shape, weight.shape,
                                     _conv_dim_numbers(nd))
     out = lax.conv_general_dilated(
@@ -74,7 +78,8 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
         feature_group_count=num_group,
         preferred_element_type=None)
     if not no_bias and bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * (nd - 2))
+        out = out + bias.astype(out.dtype).reshape(
+            (1, -1) + (1,) * (nd - 2))
     return out
 
 
@@ -273,16 +278,21 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     red = tuple(i for i in range(data.ndim) if i != axis)
     bshape = tuple(data.shape[axis] if i == axis else 1
                    for i in range(data.ndim))
+    # amp: statistics always in f32 (the reference's BN stays fp32 under
+    # AMP); output returns in the activation dtype
+    x32 = data.astype(jnp.float32)
     if _training and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        mean = jnp.mean(x32, axis=red)
+        var = jnp.var(x32, axis=red)
     else:
-        mean, var = moving_mean, moving_var
+        mean = moving_mean.astype(jnp.float32)
+        var = moving_var.astype(jnp.float32)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     inv = lax.rsqrt(var + eps)
-    out = (data - mean.reshape(bshape)) * (g * inv).reshape(bshape) \
-        + beta.reshape(bshape)
-    return out, mean, var
+    out = (x32 - mean.reshape(bshape)) * \
+        (g.astype(jnp.float32) * inv).reshape(bshape) \
+        + beta.astype(jnp.float32).reshape(bshape)
+    return out.astype(data.dtype), mean, var
 
 
 @register("LayerNorm", ndarray_inputs=("data", "gamma", "beta"))

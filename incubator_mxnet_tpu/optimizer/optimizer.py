@@ -41,20 +41,29 @@ __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta",
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def _jit_update(opname: str, static_kv: tuple):
+def _jit_update(opname: str, static_kv: tuple, donate: bool = True):
     fn = _registry.get(opname).fn
 
     def f(arrs, scalars):
         return fn(*arrs, **scalars, **dict(static_kv))
-    return jax.jit(f, donate_argnums=0)
+    return jax.jit(f, donate_argnums=0 if donate else ())
 
 
-def _fused(opname, arrays, scalars, static):
+def _fused(opname, arrays, scalars, static, donate=True):
     """Run a fused update op: donates `arrays`' buffers, returns new ones."""
-    jf = _jit_update(opname, tuple(sorted(static.items())))
+    jf = _jit_update(opname, tuple(sorted(static.items())), donate)
     data = tuple(a._data for a in arrays)
     scal = {k: jnp.asarray(v, jnp.float32) for k, v in scalars.items()}
     return jf(data, scal)
+
+
+def _zeros_state(weight):
+    """Fresh zero state buffer.  Each state gets its OWN buffer — fused
+    updates donate their inputs, and donating one buffer through two
+    arguments is an error on real TPU (CPU ignores donation, which hid
+    this until hardware runs)."""
+    return NDArray(jnp.zeros(weight.shape, weight._data.dtype),
+                   ctx=weight.context)
 
 
 # ---------------------------------------------------------------------------
@@ -261,9 +270,7 @@ class Adam(Optimizer):
         self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
-        return (NDArray(z, ctx=weight.context),
-                NDArray(z, ctx=weight.context))
+        return (_zeros_state(weight), _zeros_state(weight))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -310,8 +317,7 @@ class AdaDelta(Optimizer):
         self.rho, self.epsilon = rho, epsilon
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
-        return (NDArray(z, ctx=weight.context), NDArray(z, ctx=weight.context))
+        return (_zeros_state(weight), _zeros_state(weight))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -341,12 +347,10 @@ class RMSProp(Optimizer):
         self.clip_weights = clip_weights
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
         if self.centered:
-            return (NDArray(z, ctx=weight.context),
-                    NDArray(z, ctx=weight.context),
-                    NDArray(z, ctx=weight.context))
-        return (NDArray(z, ctx=weight.context),)
+            return (_zeros_state(weight), _zeros_state(weight),
+                    _zeros_state(weight))
+        return (_zeros_state(weight),)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -378,8 +382,7 @@ class Ftrl(Optimizer):
         self.lamda1, self.beta = lamda1, beta
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
-        return (NDArray(z, ctx=weight.context), NDArray(z, ctx=weight.context))
+        return (_zeros_state(weight), _zeros_state(weight))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -443,8 +446,7 @@ class LAMB(Optimizer):
         self.bias_correction = bias_correction
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
-        return (NDArray(z, ctx=weight.context), NDArray(z, ctx=weight.context))
+        return (_zeros_state(weight), _zeros_state(weight))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -455,8 +457,10 @@ class LAMB(Optimizer):
         static = dict(t=t, bias_correction=self.bias_correction,
                       clip_gradient=self.clip_gradient
                       if self.clip_gradient is not None else -1.0)
+        # no donation: the weight buffer is read again in phase2
         g, new_m, new_v = _fused("lamb_update_phase1",
-                                 (weight, grad, mean, var), scal, static)
+                                 (weight, grad, mean, var), scal, static,
+                                 donate=False)
         mean._data, var._data = new_m, new_v
         r1 = jnp.linalg.norm(weight._data)
         r2 = jnp.linalg.norm(g)
@@ -481,8 +485,7 @@ class Adamax(Optimizer):
         self.beta1, self.beta2 = beta1, beta2
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
-        return (NDArray(z, ctx=weight.context), NDArray(z, ctx=weight.context))
+        return (_zeros_state(weight), _zeros_state(weight))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -509,8 +512,7 @@ class Nadam(Optimizer):
         self.m_schedule = 1.0
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
-        return (NDArray(z, ctx=weight.context), NDArray(z, ctx=weight.context))
+        return (_zeros_state(weight), _zeros_state(weight))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)

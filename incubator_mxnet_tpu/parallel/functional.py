@@ -32,12 +32,18 @@ def extract_params(block) -> Dict[str, "jax.Array"]:
 
 
 def load_params(block, params: Dict[str, "jax.Array"]):
-    """Write a param dict back into the block (post-training sync)."""
+    """Write a param dict back into the block (post-training sync).
+
+    Mesh-sharded values are gathered to the param's own device — block
+    params are single-device arrays (imperative surface)."""
+    import numpy as _np
     pd = block.collect_params()
     for name, val in params.items():
         p = pd[name]
         for ctx in list(p._data.keys()):
-            p._data[ctx]._data = val
+            tgt = p._data[ctx]
+            p._data[ctx]._data = jax.device_put(
+                _np.asarray(val), ctx.jax_device).astype(tgt._data.dtype)
             break
 
 

@@ -131,8 +131,10 @@ class Symbol:
 
     # -- shape/type inference ---------------------------------------------
     def infer_shape(self, *args, **kwargs):
-        """Via jax.eval_shape over the graph (XLA's abstract eval replaces
-        the nnvm InferShape pass)."""
+        """Partial shape inference (the nnvm InferShape pass equivalent):
+        unknown *parameter* shapes are solved from data shapes via per-op
+        rules (_PARAM_SHAPE_RULES); output shapes come from jax.eval_shape
+        per node — XLA's abstract eval replaces hand-written FInferShape."""
         import jax
         import numpy as _np
         arg_names = self.list_arguments()
@@ -143,18 +145,80 @@ class Symbol:
                     shapes[n] = tuple(s)
         shapes.update({k: tuple(v) for k, v in kwargs.items()
                        if v is not None})
+
+        node_shape: Dict[int, object] = {}    # id(node) -> shape|tuple
+        eval_cache: Dict[tuple, object] = {}  # dedup multi-output views
+        for node in self._topo():
+            if node.op is None:
+                s = shapes.get(node.name)
+                if s is None:
+                    s = node.attrs.get("__shape__")
+                node_shape[id(node)] = tuple(s) if s is not None else None
+                if s is not None:
+                    shapes[node.name] = tuple(s)
+            elif node.op == "_group":
+                continue
+            else:
+                in_shapes = []
+                for i in node.inputs:
+                    s = node_shape.get(id(i))
+                    if i._out_index is not None and isinstance(s, list):
+                        s = s[i._out_index]
+                    in_shapes.append(s)
+                if any(s is None for s in in_shapes):
+                    rule = _PARAM_SHAPE_RULES.get(node.op)
+                    if rule is None or in_shapes[0] is None:
+                        raise MXNetError(
+                            "infer_shape: cannot solve input shapes of "
+                            "op %s (%s)" % (node.op, node.name))
+                    solved = rule(in_shapes, node.attrs)
+                    for i, s in zip(node.inputs, solved):
+                        if node_shape.get(id(i)) is None and s is not None:
+                            node_shape[id(i)] = tuple(s)
+                            if i.op is None:
+                                shapes[i.name] = tuple(s)
+                    in_shapes = solved
+                od = _registry.get(node.op)
+                # multi-output views duplicate (op, inputs, attrs): reuse
+                ckey = (node.op,
+                        tuple(id(i) for i in node.inputs),
+                        tuple(sorted((k, str(v))
+                                     for k, v in node.attrs.items())))
+                if ckey in eval_cache:
+                    node_shape[id(node)] = eval_cache[ckey]
+                    continue
+                specs = [jax.ShapeDtypeStruct(tuple(s), _np.float32)
+                         for s in in_shapes]
+                try:
+                    out = jax.eval_shape(
+                        lambda *a: od.fn(*a, **node.attrs), *specs)
+                except Exception as e:
+                    raise MXNetError(
+                        "infer_shape failed at op %s (%s): %s"
+                        % (node.op, node.name, e))
+                if isinstance(out, (tuple, list)):
+                    node_shape[id(node)] = [tuple(o.shape) for o in out]
+                else:
+                    node_shape[id(node)] = tuple(out.shape)
+                eval_cache[ckey] = node_shape[id(node)]
+
         missing = [n for n in arg_names if n not in shapes]
         if missing:
-            raise MXNetError("infer_shape: missing shapes for %s" % missing)
-        specs = {n: jax.ShapeDtypeStruct(shapes[n], _np.float32)
-                 for n in arg_names}
+            raise MXNetError("infer_shape: unresolved shapes for %s"
+                             % missing)
 
-        def f(feed):
-            return _eval_symbol(self, feed, raw=True)
-        out = jax.eval_shape(f, specs)
-        outs = out if isinstance(out, (list, tuple)) else [out]
-        return ([shapes[n] for n in arg_names],
-                [tuple(o.shape) for o in outs], [])
+        def out_shape(node):
+            s = node_shape[id(node)]
+            if node._out_index is not None and isinstance(s, list):
+                return s[node._out_index]
+            return s
+        if self.op == "_group":
+            outs = [out_shape(o) for o in self.inputs]
+        else:
+            s = out_shape(self)
+            outs = s if isinstance(s, list) and self._out_index is None \
+                else [s]
+        return ([shapes[n] for n in arg_names], outs, [])
 
     def infer_type(self, *args, **kwargs):
         import numpy as _np
@@ -232,6 +296,94 @@ class Symbol:
     def __call__(self, *args, **kwargs):
         raise MXNetError("symbol composition via __call__ is not supported "
                          "in the TPU build; apply ops functionally")
+
+
+# Param-shape solving rules (the FInferShape "backward" direction the
+# reference ops implemented; only ops with learnable params need one).
+def _prod(t):
+    p = 1
+    for x in t:
+        p *= x
+    return p
+
+
+def _fc_rule(shapes, attrs):
+    data = shapes[0]
+    nh = int(attrs.get("num_hidden", 0))
+    flatten = attrs.get("flatten", True)
+    in_units = _prod(data[1:]) if flatten else data[-1]
+    out = [data, shapes[1] or (nh, in_units)]
+    if len(shapes) > 2:
+        out.append(shapes[2] or (nh,))
+    return out
+
+
+def _conv_rule(shapes, attrs):
+    data = shapes[0]
+    nf = int(attrs.get("num_filter", 0))
+    g = int(attrs.get("num_group", 1))
+    kernel = tuple(attrs.get("kernel", ()))
+    out = [data, shapes[1] or (nf, data[1] // g) + kernel]
+    if len(shapes) > 2:
+        out.append(shapes[2] or (nf,))
+    return out
+
+
+def _deconv_rule(shapes, attrs):
+    data = shapes[0]
+    nf = int(attrs.get("num_filter", 0))
+    g = int(attrs.get("num_group", 1))
+    kernel = tuple(attrs.get("kernel", ()))
+    out = [data, shapes[1] or (data[1], nf // g) + kernel]
+    if len(shapes) > 2:
+        out.append(shapes[2] or (nf,))
+    return out
+
+
+def _channel_params_rule(shapes, attrs):
+    data = shapes[0]
+    axis = int(attrs.get("axis", 1))
+    c = data[axis]
+    return [data] + [s or (c,) for s in shapes[1:]]
+
+
+def _layernorm_rule(shapes, attrs):
+    data = shapes[0]
+    axis = int(attrs.get("axis", -1))
+    c = data[axis]
+    return [data] + [s or (c,) for s in shapes[1:]]
+
+
+def _embedding_rule(shapes, attrs):
+    return [shapes[0], shapes[1] or (int(attrs["input_dim"]),
+                                     int(attrs["output_dim"]))]
+
+
+def _rnn_rule(shapes, attrs):
+    from ..ops.rnn import rnn_param_size
+    data = shapes[0]
+    H = int(attrs.get("state_size"))
+    L = int(attrs.get("num_layers", 1))
+    bi = bool(attrs.get("bidirectional", False))
+    d = 2 if bi else 1
+    psize = rnn_param_size(attrs.get("mode", "lstm"), L, data[2], H, bi)
+    out = [data, shapes[1] or (psize,)]
+    for s in shapes[2:]:
+        out.append(s or (L * d, data[1], H))
+    return out
+
+
+_PARAM_SHAPE_RULES = {
+    "FullyConnected": _fc_rule,
+    "Convolution": _conv_rule,
+    "Deconvolution": _deconv_rule,
+    "BatchNorm": _channel_params_rule,
+    "InstanceNorm": _channel_params_rule,
+    "GroupNorm": _channel_params_rule,
+    "LayerNorm": _layernorm_rule,
+    "Embedding": _embedding_rule,
+    "RNN": _rnn_rule,
+}
 
 
 _COUNTER = {}

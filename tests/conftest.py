@@ -7,13 +7,16 @@ Set MXNET_TEST_DEVICE=tpu to run the corpus against a real chip.
 """
 import os
 
-# must happen before jax import anywhere
+# must happen before jax backend initialisation
 if os.environ.get("MXNET_TEST_DEVICE", "cpu") == "cpu":
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
     flags = os.environ.get("XLA_FLAGS", "")
     if "host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = \
             flags + " --xla_force_host_platform_device_count=8"
+    import jax
+    # the axon sitecustomize force-selects the TPU platform; override it
+    # for the CPU-mesh corpus (config update beats JAX_PLATFORMS env)
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as _np
 import pytest

@@ -1,0 +1,197 @@
+"""IO: recordio wire format, iterators, DataLoader
+(ref: tests/python/unittest/test_io.py, test_recordio.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.io import (recordio, NDArrayIter, CSVIter,
+                                    LibSVMIter, ImageRecordIter)
+from incubator_mxnet_tpu import gluon
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [b"hello", b"x" * 1000, b"", b"abc\x00def"]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(10):
+        w.write_idx(i, b"record%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    assert r.read_idx(7) == b"record7"
+    assert r.read_idx(2) == b"record2"
+    assert r.keys == list(range(10))
+    r.close()
+
+
+def test_irheader_pack_unpack():
+    hdr = recordio.IRHeader(0, 3.0, 42, 0)
+    packed = recordio.pack(hdr, b"imagedata")
+    h2, data = recordio.unpack(packed)
+    assert h2.label == 3.0
+    assert h2.id == 42
+    assert data == b"imagedata"
+    # multi-label
+    hdr = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0]), 1, 0)
+    h3, data = recordio.unpack(recordio.pack(hdr, b"x"))
+    assert np.allclose(h3.label, [1, 2, 3])
+
+
+def test_pack_img_roundtrip():
+    img = (np.random.rand(8, 8, 3) * 255).astype("uint8")
+    packed = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                               img_fmt=".png")
+    hdr, decoded = recordio.unpack_img(packed)
+    assert decoded.shape[2] == 3
+    assert hdr.label == 1.0
+
+
+def test_ndarray_iter():
+    data = np.random.rand(25, 3).astype("float32")
+    label = np.arange(25).astype("float32")
+    it = NDArrayIter(data, label, batch_size=10, shuffle=False,
+                     last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (10, 3)
+    assert batches[2].pad == 5
+    it.reset()
+    b0 = next(iter(it))
+    assert np.allclose(b0.data[0].asnumpy(), data[:10])
+
+
+def test_ndarray_iter_discard():
+    it = NDArrayIter(np.zeros((25, 2)), np.zeros(25), batch_size=10,
+                     last_batch_handle="discard")
+    assert len(list(it)) == 2
+
+
+def test_csv_iter(tmp_path):
+    data_path = str(tmp_path / "data.csv")
+    np.savetxt(data_path, np.random.rand(10, 4), delimiter=",")
+    it = CSVIter(data_csv=data_path, data_shape=(4,), batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 4)
+
+
+def test_libsvm_iter(tmp_path):
+    path = str(tmp_path / "data.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.0\n0 1:1.0\n1 2:3.0 4:1.0\n")
+    it = LibSVMIter(data_libsvm=path, data_shape=(5,), batch_size=2)
+    batch = next(iter(it))
+    csr = batch.data[0]
+    assert csr.shape == (2, 5)
+    dense = csr.asnumpy()
+    assert dense[0, 0] == 1.5 and dense[0, 3] == 2.0
+
+
+def test_image_record_iter(tmp_path):
+    # build a small .rec with RAWI-framed images
+    path = str(tmp_path / "imgs.rec")
+    idx = str(tmp_path / "imgs.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(12):
+        img = (np.random.rand(12, 12, 3) * 255).astype("uint8")
+        packed = recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img, img_fmt=".png")
+        w.write_idx(i, packed)
+    w.close()
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                         batch_size=4, shuffle=True, preprocess_threads=2)
+    batches = list(iter_batches(it))
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 8, 8)
+    it.reset()
+    assert next(it).data[0].shape == (4, 3, 8, 8)
+
+
+def iter_batches(it):
+    while True:
+        try:
+            yield it.next()
+        except StopIteration:
+            return
+
+
+def test_dataloader_serial():
+    dataset = gluon.data.ArrayDataset(
+        np.random.rand(20, 4).astype("float32"),
+        np.arange(20).astype("float32"))
+    loader = gluon.data.DataLoader(dataset, batch_size=6,
+                                   last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 4
+    x, y = batches[0]
+    assert x.shape == (6, 4)
+    assert y.shape == (6,)
+
+
+def test_dataloader_shuffle_covers_all():
+    dataset = gluon.data.ArrayDataset(np.arange(30).astype("float32"))
+    loader = gluon.data.DataLoader(dataset, batch_size=10, shuffle=True)
+    seen = np.concatenate([b.asnumpy() for b in loader])
+    assert sorted(seen.tolist()) == list(range(30))
+
+
+def test_dataloader_multiworker():
+    dataset = gluon.data.ArrayDataset(
+        np.random.rand(16, 2).astype("float32"),
+        np.arange(16).astype("float32"))
+    loader = gluon.data.DataLoader(dataset, batch_size=4, num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 4
+    ys = np.concatenate([b[1].asnumpy() for b in batches])
+    assert sorted(ys.tolist()) == list(range(16))
+
+
+def test_dataset_transform():
+    dataset = gluon.data.ArrayDataset(
+        np.ones((4, 2), "float32"), np.zeros(4, "float32"))
+    t = dataset.transform_first(lambda x: x * 2)
+    x, y = t[0]
+    assert np.allclose(x, 2)
+
+
+def test_vision_transforms():
+    from incubator_mxnet_tpu.gluon.data.vision import transforms as T
+    img = nd.array((np.random.rand(10, 12, 3) * 255).astype("uint8"))
+    t = T.ToTensor()(img)
+    assert t.shape == (3, 10, 12)
+    assert float(t.max().asscalar()) <= 1.0
+    norm = T.Normalize(mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5))(t)
+    assert norm.shape == (3, 10, 12)
+    resized = T.Resize((6, 5))(img)
+    assert resized.shape == (5, 6, 3)
+    crop = T.CenterCrop((8, 8))(img)
+    assert crop.shape == (8, 8, 3)
+    comp = T.Compose([T.Resize(8), T.ToTensor()])
+    out = comp(img)
+    assert out.shape[0] == 3
+
+
+def test_synthetic_dataset():
+    from incubator_mxnet_tpu.gluon.data.vision import SyntheticImageDataset
+    ds = SyntheticImageDataset(num_samples=8, shape=(16, 16, 3),
+                               num_classes=4)
+    assert len(ds) == 8
+    x, y = ds[0]
+    assert x.shape == (16, 16, 3)
+    assert 0 <= y < 4

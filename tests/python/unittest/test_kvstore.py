@@ -1,0 +1,98 @@
+"""KVStore facade (ref: tests/python/unittest/test_kvstore.py — init/push/
+pull invariants; exact-value asserts with deterministic inputs)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, kv
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_init_pull():
+    store = kv.create("local")
+    store.init(3, nd.ones((2, 3)))
+    out = nd.zeros((2, 3))
+    store.pull(3, out=out)
+    assert_almost_equal(out, np.ones((2, 3)))
+
+
+def test_push_aggregates():
+    store = kv.create("local")
+    store.init("w", nd.zeros((4,)))
+    vals = [nd.ones((4,)), nd.ones((4,)) * 2, nd.ones((4,)) * 3]
+    store.push("w", vals)
+    out = nd.zeros((4,))
+    store.pull("w", out=out)
+    assert_almost_equal(out, np.full((4,), 6.0))
+
+
+def test_pushpull_fused():
+    store = kv.create("nccl")
+    store.init(0, nd.zeros((3,)))
+    a = nd.ones((3,))
+    b = nd.ones((3,)) * 4
+    store.pushpull(0, [a, b], out=[a, b])
+    assert_almost_equal(a, np.full((3,), 5.0))
+    assert_almost_equal(b, np.full((3,), 5.0))
+
+
+def test_list_keys():
+    store = kv.create("device")
+    keys = [1, 2, 3]
+    store.init(keys, [nd.ones((2,))] * 3)
+    outs = [nd.zeros((2,)) for _ in keys]
+    store.pull(keys, out=outs)
+    for o in outs:
+        assert_almost_equal(o, np.ones((2,)))
+
+
+def test_set_optimizer_server_side_update():
+    store = kv.create("local")
+    store.init(0, nd.zeros((3,)))
+    from incubator_mxnet_tpu import optimizer as opt
+    store.set_optimizer(opt.SGD(learning_rate=1.0))
+    store.push(0, nd.ones((3,)))       # grad=1, lr=1 → w -= 1
+    out = nd.zeros((3,))
+    store.pull(0, out=out)
+    assert_almost_equal(out, -np.ones((3,)))
+
+
+def test_row_sparse_pull():
+    store = kv.create("local")
+    w = nd.array(np.arange(12).reshape(4, 3).astype("float32"))
+    store.init("emb", w)
+    out = nd.zeros((4, 3))
+    rows = nd.array([0, 2], dtype="int64")
+    store.row_sparse_pull("emb", out=out, row_ids=rows)
+    got = out.asnumpy()
+    assert np.allclose(got[0], w.asnumpy()[0])
+    assert np.allclose(got[2], w.asnumpy()[2])
+    assert np.allclose(got[1], 0)
+
+
+def test_gradient_compression_api():
+    store = kv.create("device")
+    store.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    assert store._compression["type"] == "2bit"
+
+
+def test_rank_single_process():
+    store = kv.create("local")
+    assert store.rank == 0
+    assert store.num_workers == 1
+
+
+def test_invalid_type():
+    with pytest.raises(mx.MXNetError):
+        kv.create("bogus")
+
+
+def test_optimizer_states_roundtrip(tmp_path):
+    fname = str(tmp_path / "kv.states")
+    store = kv.create("local")
+    store.init(0, nd.zeros((2,)))
+    from incubator_mxnet_tpu import optimizer as opt
+    store.set_optimizer(opt.SGD(learning_rate=0.1, momentum=0.9))
+    store.push(0, nd.ones((2,)))
+    store.save_optimizer_states(fname)
+    store.load_optimizer_states(fname)

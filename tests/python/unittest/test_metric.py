@@ -1,0 +1,84 @@
+"""Metrics (ref: tests/python/unittest/test_metric.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, metric
+
+
+def test_accuracy():
+    m = metric.Accuracy()
+    pred = nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert acc == pytest.approx(2.0 / 3.0)
+
+
+def test_topk():
+    m = metric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]])
+    label = nd.array([1, 0])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_mae_mse_rmse():
+    pred = nd.array([[1.0], [2.0]])
+    label = nd.array([[1.5], [1.0]])
+    m = metric.MAE()
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.75)
+    m = metric.MSE()
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx((0.25 + 1.0) / 2)
+    m = metric.RMSE()
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(np.sqrt(0.625))
+
+
+def test_cross_entropy_and_perplexity():
+    pred = nd.array([[0.9, 0.1], [0.2, 0.8]])
+    label = nd.array([0, 1])
+    ce = metric.CrossEntropy()
+    ce.update([label], [pred])
+    expect = -(np.log(0.9) + np.log(0.8)) / 2
+    assert ce.get()[1] == pytest.approx(expect, rel=1e-5)
+    p = metric.Perplexity()
+    p.update([label], [pred])
+    assert p.get()[1] == pytest.approx(np.exp(expect), rel=1e-5)
+
+
+def test_f1():
+    m = metric.F1()
+    pred = nd.array([[0.2, 0.8], [0.8, 0.2], [0.3, 0.7], [0.6, 0.4]])
+    label = nd.array([1, 0, 0, 1])
+    m.update([label], [pred])
+    assert 0 < m.get()[1] <= 1
+
+
+def test_composite_and_create():
+    m = metric.create(["acc", "ce"])
+    assert isinstance(m, metric.CompositeEvalMetric)
+    pred = nd.array([[0.1, 0.9]])
+    label = nd.array([1])
+    m.update([label], [pred])
+    names, values = m.get()
+    assert len(names) == 2
+    m2 = metric.create("accuracy")
+    assert isinstance(m2, metric.Accuracy)
+
+
+def test_custom_metric():
+    def feval(label, pred):
+        return float(np.abs(label - pred).sum())
+    m = metric.CustomMetric(feval, name="abssum")
+    m.update([nd.array([1.0])], [nd.array([0.5])])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_loss_metric():
+    m = metric.Loss()
+    m.update(None, [nd.array([1.0, 2.0, 3.0])])
+    assert m.get()[1] == pytest.approx(2.0)

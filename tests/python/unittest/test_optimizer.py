@@ -1,0 +1,146 @@
+"""Optimizers (ref: tests/python/unittest/test_optimizer.py — numpy
+reference implementations checked against the fused update ops)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, optimizer as opt
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _run_steps(optimizer, w0, grads, nsteps=3):
+    w = nd.array(w0.copy())
+    state = optimizer.create_state(0, w)
+    for i in range(nsteps):
+        g = nd.array(grads[i])
+        optimizer.update(0, w, g, state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    w0 = np.random.randn(5).astype("float32")
+    grads = [np.random.randn(5).astype("float32") for _ in range(3)]
+    out = _run_steps(opt.SGD(learning_rate=0.1), w0, grads)
+    w = w0.copy()
+    for g in grads:
+        w = w - 0.1 * g
+    assert_almost_equal(out, w, rtol=1e-5)
+
+
+def test_sgd_momentum_wd():
+    w0 = np.random.randn(5).astype("float32")
+    grads = [np.random.randn(5).astype("float32") for _ in range(4)]
+    out = _run_steps(opt.SGD(learning_rate=0.1, momentum=0.9, wd=0.01),
+                     w0, grads, 4)
+    w = w0.copy()
+    mom = np.zeros_like(w)
+    for g in grads:
+        mom = 0.9 * mom - 0.1 * (g + 0.01 * w)
+        w = w + mom
+    assert_almost_equal(out, w, rtol=1e-4, atol=1e-5)
+
+
+def test_adam_matches_numpy():
+    w0 = np.random.randn(6).astype("float32")
+    grads = [np.random.randn(6).astype("float32") for _ in range(5)]
+    out = _run_steps(opt.Adam(learning_rate=0.01), w0, grads, 5)
+    w = w0.astype("float64").copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t, g in enumerate(grads, 1):
+        g = g.astype("float64")
+        lr = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w = w - lr * m / (np.sqrt(v) + eps)
+    assert_almost_equal(out, w.astype("float32"), rtol=1e-4, atol=1e-5)
+
+
+def test_rmsprop():
+    w0 = np.random.randn(4).astype("float32")
+    grads = [np.random.randn(4).astype("float32") for _ in range(3)]
+    out = _run_steps(opt.RMSProp(learning_rate=0.01, gamma1=0.9), w0, grads)
+    w = w0.astype("float64").copy()
+    n = np.zeros_like(w)
+    for g in grads:
+        g = g.astype("float64")
+        n = 0.9 * n + 0.1 * g * g
+        w = w - 0.01 * g / np.sqrt(n + 1e-8)
+    assert_almost_equal(out, w.astype("float32"), rtol=1e-4, atol=1e-5)
+
+
+def test_adagrad():
+    w0 = np.random.randn(4).astype("float32")
+    grads = [np.random.randn(4).astype("float32") for _ in range(3)]
+    out = _run_steps(opt.AdaGrad(learning_rate=0.1), w0, grads)
+    w = w0.astype("float64").copy()
+    h = np.zeros_like(w)
+    for g in grads:
+        g = g.astype("float64")
+        h += g * g
+        w = w - 0.1 * g / (np.sqrt(h) + 1e-7)
+    assert_almost_equal(out, w.astype("float32"), rtol=1e-4, atol=1e-5)
+
+
+def test_clip_gradient():
+    w0 = np.zeros(3, "float32")
+    grads = [np.array([10.0, -10.0, 0.5], "float32")]
+    out = _run_steps(opt.SGD(learning_rate=1.0, clip_gradient=1.0),
+                     w0, grads, 1)
+    assert_almost_equal(out, [-1.0, 1.0, -0.5], rtol=1e-5)
+
+
+def test_lr_scheduler_integration():
+    from incubator_mxnet_tpu.lr_scheduler import FactorScheduler
+    sched = FactorScheduler(step=2, factor=0.5, base_lr=1.0)
+    o = opt.SGD(learning_rate=1.0, lr_scheduler=sched)
+    w = nd.zeros((1,))
+    g = nd.ones((1,))
+    lrs = []
+    for i in range(6):
+        o.update(0, w, g, None)
+        lrs.append(o.learning_rate)
+    assert lrs[0] == 1.0
+    assert lrs[-1] < 1.0
+
+
+def test_optimizer_registry():
+    for name in ["sgd", "adam", "nag", "rmsprop", "adagrad", "adadelta",
+                 "ftrl", "signum", "lamb", "adamax", "nadam", "sgld"]:
+        o = opt.create(name)
+        assert isinstance(o, opt.Optimizer)
+    with pytest.raises(mx.MXNetError):
+        opt.create("nonexistent")
+
+
+def test_lamb_runs():
+    w0 = np.random.randn(4, 4).astype("float32")
+    grads = [np.random.randn(4, 4).astype("float32") for _ in range(2)]
+    out = _run_steps(opt.LAMB(learning_rate=0.01), w0, grads, 2)
+    assert out.shape == (4, 4)
+    assert not np.allclose(out, w0)
+
+
+def test_multi_precision_sgd():
+    w = nd.array(np.random.randn(4).astype("float16"), dtype="float16")
+    o = opt.SGD(learning_rate=0.1, multi_precision=True)
+    state = o.create_state_multi_precision(0, w)
+    g = nd.array(np.random.randn(4).astype("float16"), dtype="float16")
+    o.update_multi_precision(0, w, g, state)
+    assert w.dtype == np.float16
+    _, w32 = state
+    assert w32._data.dtype == np.float32
+
+
+def test_updater_states_roundtrip():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    upd = opt.get_updater(o)
+    w = nd.array(np.random.randn(3).astype("float32"))
+    g = nd.ones((3,))
+    upd(0, g, w)
+    blob = upd.get_states()
+    upd2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    upd2.set_states(blob)
+    assert 0 in upd2.states

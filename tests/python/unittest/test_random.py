@@ -1,0 +1,73 @@
+"""RNG facade (ref: tests/python/unittest/test_random.py)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def test_seed_reproducibility():
+    mx.random.seed(42)
+    a = nd.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(42)
+    b = nd.uniform(shape=(5,)).asnumpy()
+    assert np.allclose(a, b)
+    c = nd.uniform(shape=(5,)).asnumpy()
+    assert not np.allclose(b, c)      # keys split per call
+
+
+def test_uniform_range():
+    x = nd.random.uniform(low=2.0, high=3.0, shape=(1000,)).asnumpy()
+    assert x.min() >= 2.0 and x.max() <= 3.0
+    assert abs(x.mean() - 2.5) < 0.05
+
+
+def test_normal_moments():
+    x = nd.random.normal(loc=1.0, scale=2.0, shape=(20000,)).asnumpy()
+    assert abs(x.mean() - 1.0) < 0.1
+    assert abs(x.std() - 2.0) < 0.1
+
+
+def test_randint():
+    x = nd.random.randint(low=0, high=10, shape=(1000,)).asnumpy()
+    assert x.min() >= 0 and x.max() < 10
+    assert x.dtype == np.int32
+
+
+def test_poisson_gamma_exponential():
+    p = nd.random.poisson(lam=4.0, shape=(5000,)).asnumpy()
+    assert abs(p.mean() - 4.0) < 0.2
+    g = nd.random.gamma(alpha=2.0, beta=3.0, shape=(5000,)).asnumpy()
+    assert abs(g.mean() - 6.0) < 0.5
+    e = nd.random.exponential(lam=2.0, shape=(5000,)).asnumpy()
+    assert abs(e.mean() - 0.5) < 0.1
+
+
+def test_multinomial():
+    probs = nd.array([0.1, 0.0, 0.9])
+    draws = nd.random.multinomial(probs, shape=(1000,)).asnumpy()
+    assert (draws == 1).sum() == 0
+    assert (draws == 2).mean() > 0.8
+
+
+def test_sample_parametrized():
+    mu = nd.array([0.0, 10.0])
+    sigma = nd.array([1.0, 1.0])
+    s = nd.random.normal(mu, sigma, shape=(500,)).asnumpy()
+    assert s.shape == (2, 500)
+    assert abs(s[0].mean()) < 0.3
+    assert abs(s[1].mean() - 10) < 0.3
+
+
+def test_shuffle():
+    x = nd.arange(0, 100)
+    y = nd.random.shuffle(x).asnumpy()
+    assert sorted(y.tolist()) == list(range(100))
+    assert not np.allclose(y, np.arange(100))
+
+
+def test_per_context_independent_streams():
+    mx.random.seed(7)
+    a = nd.uniform(shape=(4,)).asnumpy()
+    mx.random.seed(7, ctx=mx.cpu())
+    b = nd.uniform(shape=(4,)).asnumpy()
+    assert a.shape == b.shape

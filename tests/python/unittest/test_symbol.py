@@ -1,0 +1,133 @@
+"""Symbol + Executor + Module (ref: tests/python/unittest/test_symbol.py,
+test_executor.py, test_module.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_symbol_compose_and_eval():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = a + b * 2
+    assert set(c.list_arguments()) == {"a", "b"}
+    out = c.eval(a=nd.array([1.0]), b=nd.array([2.0]))
+    assert_almost_equal(out[0], [5.0])
+
+
+def test_symbol_infer_shape():
+    x = sym.var("x")
+    w = sym.var("w")
+    y = sym.FullyConnected(x, w, None, num_hidden=8, no_bias=True)
+    arg_shapes, out_shapes, _ = y.infer_shape(x=(4, 3), w=(8, 3))
+    assert out_shapes[0] == (4, 8)
+
+
+def test_symbol_json_roundtrip():
+    a = sym.var("a")
+    y = sym.exp(a) + 1
+    js = y.tojson()
+    y2 = sym.load_json(js)
+    assert set(y2.list_arguments()) == {"a"}
+    out1 = y.eval(a=nd.array([0.0, 1.0]))[0]
+    out2 = y2.eval(a=nd.array([0.0, 1.0]))[0]
+    assert_almost_equal(out1, out2)
+
+
+def test_executor_forward_backward():
+    x = sym.var("x")
+    w = sym.var("w")
+    y = sym.FullyConnected(x, w, None, num_hidden=2, no_bias=True)
+    loss = sym.sum(sym.square(y))
+    exe = loss.simple_bind(mx.cpu(), x=(3, 4), w=(2, 4))
+    x_np = np.random.randn(3, 4).astype("float32")
+    w_np = np.random.randn(2, 4).astype("float32")
+    exe.arg_dict["x"]._data = nd.array(x_np)._data
+    exe.arg_dict["w"]._data = nd.array(w_np)._data
+    outs = exe.forward(is_train=True)
+    expect = ((x_np @ w_np.T) ** 2).sum()
+    assert_almost_equal(outs[0], expect, rtol=1e-3)
+    exe.backward()
+    expected_wgrad = 2 * (x_np @ w_np.T).T @ x_np
+    assert_almost_equal(exe.grad_dict["w"], expected_wgrad, rtol=1e-3,
+                        atol=1e-3)
+
+
+def test_module_fit_smoke():
+    from incubator_mxnet_tpu.io import NDArrayIter
+    # linearly separable 2-class problem
+    n = 200
+    x_np = np.random.randn(n, 2).astype("float32")
+    y_np = (x_np[:, 0] + x_np[:, 1] > 0).astype("float32")
+    data_iter = NDArrayIter(x_np, y_np, batch_size=20, shuffle=False)
+
+    x = sym.var("data")
+    w = sym.var("fc_weight")
+    b = sym.var("fc_bias")
+    logits = sym.FullyConnected(x, w, b, num_hidden=2)
+    out = sym.softmax(logits)
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (20, 2))],
+             label_shapes=[("softmax_label", (20,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.5),))
+
+    # manual training loop with explicit CE gradient through backward
+    import incubator_mxnet_tpu.metric as metric
+    acc0 = None
+    for epoch in range(3):
+        data_iter.reset()
+        m = metric.Accuracy()
+        for batch in data_iter:
+            mod.forward(batch, is_train=True)
+            probs = mod.get_outputs()[0]
+            label = batch.label[0]
+            onehot = nd.one_hot(label, 2)
+            grad = (probs - onehot) / probs.shape[0]
+            mod.backward([grad])
+            mod.update()
+            m.update(batch.label, mod.get_outputs())
+        if acc0 is None:
+            acc0 = m.get()[1]
+    assert m.get()[1] >= acc0
+    assert m.get()[1] > 0.8
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    prefix = str(tmp_path / "model")
+    x = sym.var("data")
+    w = sym.var("w")
+    y = sym.FullyConnected(x, w, None, num_hidden=3, no_bias=True)
+    mod = mx.mod.Module(y, data_names=("data",), label_names=())
+    mod.bind(data_shapes=[("data", (2, 5))], for_training=False)
+    mod.init_params()
+    mod.save_checkpoint(prefix, 0)
+    symbol, arg_params, aux_params = mx.mod.Module.load_checkpoint(prefix, 0)
+    assert "w" in arg_params
+    assert arg_params["w"].shape == (3, 5)
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        data = sym.var("data")
+        w = sym.var("w")
+        pooled = sym.sum(data, axis=1, keepdims=True)   # (N, 1) any bucket
+        out = sym.FullyConnected(pooled, w, None, num_hidden=4,
+                                 no_bias=True)
+        return out, ("data",), ()
+
+    from incubator_mxnet_tpu.io import DataBatch
+    bm = mx.mod.BucketingModule(sym_gen, default_bucket_key=10)
+    bm.bind(data_shapes=[("data", (2, 10))])
+    bm.init_params()
+    # batch with a different bucket
+    b5 = DataBatch([nd.ones((2, 5))], bucket_key=5)
+    bm.forward(b5, is_train=False)
+    assert bm.get_outputs()[0].shape == (2, 4)
+    b10 = DataBatch([nd.ones((2, 10))], bucket_key=10)
+    bm.forward(b10, is_train=False)
+    assert bm.get_outputs()[0].shape == (2, 4)
