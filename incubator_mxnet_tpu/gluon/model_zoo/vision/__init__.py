@@ -1,16 +1,32 @@
 """mx.gluon.model_zoo.vision (ref: python/mxnet/gluon/model_zoo/vision/).
 
-Model families arrive incrementally; resnet (north-star) first. `get_model`
-mirrors the reference registry interface.
+`get_model` mirrors the reference registry interface; families: resnet
+v1/v1b/v2 (north-star), vgg(+bn), alexnet, mobilenet v1/v2, densenet,
+squeezenet.
 """
-from .resnet import *        # noqa: F401,F403
-from . import resnet as _resnet_mod
+from . import resnet as _m1
+from . import alexnet as _m2
+from . import vgg as _m3
+from . import mobilenet as _m4
+from . import densenet as _m5
+from . import squeezenet as _m6
+
+# star-import AFTER module refs: `alexnet`/`vgg` factory functions shadow
+# the submodule names in this namespace (reference behaves the same way)
+from .resnet import *        # noqa: F401,F403,E402
+from .alexnet import *       # noqa: F401,F403,E402
+from .vgg import *           # noqa: F401,F403,E402
+from .mobilenet import *     # noqa: F401,F403,E402
+from .densenet import *      # noqa: F401,F403,E402
+from .squeezenet import *    # noqa: F401,F403,E402
 
 _models = {}
-for _name in _resnet_mod.__all__:
-    _obj = getattr(_resnet_mod, _name)
-    if callable(_obj) and _name.startswith("resnet"):
-        _models[_name] = _obj
+for _mod in (_m1, _m2, _m3, _m4, _m5, _m6):
+    for _name in _mod.__all__:
+        _obj = getattr(_mod, _name)
+        if callable(_obj) and _name[0].islower() and \
+                not _name.startswith("get_"):
+            _models[_name] = _obj
 
 
 def get_model(name, **kwargs):

@@ -180,6 +180,23 @@ random.multinomial = _rand_stub("multinomial", "_sample_multinomial")
 random.shuffle = _rand_stub("shuffle", "_shuffle")
 _sys.modules[random.__name__] = random
 
+# nd.contrib namespace (ref: python/mxnet/ndarray/contrib.py): contrib ops
+# are registered flat; expose them under .contrib for API parity
+contrib = _types.ModuleType(__name__ + ".contrib")
+for _opname in ["box_iou", "box_nms", "box_encode", "box_decode",
+                "bipartite_matching", "MultiBoxPrior", "MultiBoxTarget",
+                "MultiBoxDetection", "ROIAlign", "BilinearResize2D",
+                "AdaptiveAvgPooling2D", "count_sketch", "index_copy",
+                "getnnz", "boolean_mask", "arange_like",
+                "interleaved_matmul_selfatt_qk",
+                "interleaved_matmul_selfatt_valatt"]:
+    if hasattr(_this, _opname):
+        setattr(contrib, _opname, getattr(_this, _opname))
+_sys.modules[contrib.__name__] = contrib
+
+# nd.sparse namespace
+from . import sparse          # noqa: E402,F401
+
 
 def uniform(low=0.0, high=1.0, shape=(), ctx=None, dtype="float32", **kw):
     return invoke("_random_uniform", low=low, high=high, shape=_tuple(shape),

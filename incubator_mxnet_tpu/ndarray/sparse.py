@@ -141,6 +141,141 @@ def cast_storage(arr, stype):
     raise MXNetError("unknown stype %r" % stype)
 
 
+# ---------------------------------------------------------------------------
+# sparse compute kernels (ref: FComputeEx paths — dot-inl.h csr cases,
+# optimizer_op.cc row_sparse updates, indexing_op.h sparse Embedding grad)
+# ---------------------------------------------------------------------------
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (ref: dot FComputeEx: csr×dense, csr^T×dense).
+
+    XLA has no sparse matmul; realisation is gather + segment-sum over the
+    static-nnz buffers — the TPU-friendly form (SURVEY §7.2 "Sparse on
+    XLA")."""
+    from .ndarray import NDArray as _ND
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, _ND):
+        n, k = lhs.shape
+        values = lhs.data._data
+        indices = lhs.indices._data.astype(jnp.int32)
+        indptr = lhs.indptr._data.astype(jnp.int32)
+        nnz = values.shape[0]
+        # row id per nnz from indptr (static nnz): searchsorted
+        rows = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+        gathered = jnp.take(rhs._data, indices, axis=0)       # (nnz, m)
+        contrib = gathered * values[:, None]
+        if transpose_a:
+            out = jnp.zeros((k, rhs.shape[1]), rhs._data.dtype)
+            out = out.at[indices].add(rhs._data[rows] * values[:, None])
+            return _ND(out, ctx=rhs.context)
+        out = jnp.zeros((n, rhs.shape[1]), rhs._data.dtype)
+        out = out.at[rows].add(contrib)
+        return _ND(out, ctx=rhs.context)
+    if isinstance(lhs, _ND) and isinstance(rhs, _ND):
+        from .ndarray import invoke
+        return invoke("dot", lhs, rhs, transpose_a=transpose_a,
+                      transpose_b=transpose_b)
+    raise MXNetError("unsupported sparse dot combination")
+
+
+def embedding_grad(indices, out_grad, vocab_size):
+    """Build the row_sparse gradient of an Embedding lookup
+    (ref: EmbeddingOpBackwardEx row_sparse path): unique rows + summed
+    per-row cotangents."""
+    from .ndarray import NDArray as _ND
+    idx = _np.asarray(indices.asnumpy() if hasattr(indices, "asnumpy")
+                      else indices).astype(_np.int64).reshape(-1)
+    g = out_grad.asnumpy() if hasattr(out_grad, "asnumpy") else \
+        _np.asarray(out_grad)
+    g = g.reshape(-1, g.shape[-1])
+    uniq, inv = _np.unique(idx, return_inverse=True)
+    vals = _np.zeros((len(uniq), g.shape[1]), g.dtype)
+    _np.add.at(vals, inv, g)
+    return RowSparseNDArray(uniq, vals, (vocab_size, g.shape[1]))
+
+
+def sparse_sgd_update(weight, grad_rsp, lr, wd=0.0, rescale_grad=1.0,
+                      clip_gradient=None, lazy_update=True):
+    """Row-sparse SGD (ref: sgd_update FComputeEx w/ lazy_update): only
+    rows present in the gradient are touched."""
+    rows = grad_rsp.indices._data.astype(jnp.int32)
+    g = grad_rsp.data._data * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w = weight._data
+    wr = jnp.take(w, rows, axis=0)
+    new_rows = wr - lr * (g + wd * wr)
+    weight._data = w.at[rows].set(new_rows)
+
+
+def sparse_adagrad_update(weight, grad_rsp, history, lr, epsilon=1e-7,
+                          wd=0.0, rescale_grad=1.0, clip_gradient=None):
+    """ref: _sparse_adagrad_update — history updated only on live rows."""
+    rows = grad_rsp.indices._data.astype(jnp.int32)
+    g = grad_rsp.data._data * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    h = history._data
+    hr = jnp.take(h, rows, axis=0) + jnp.square(g)
+    history._data = h.at[rows].set(hr)
+    w = weight._data
+    wr = jnp.take(w, rows, axis=0)
+    new_rows = wr - lr * (g / (jnp.sqrt(hr) + epsilon) + wd * wr)
+    weight._data = w.at[rows].set(new_rows)
+
+
+def sparse_adam_update(weight, grad_rsp, mean, var, lr, beta1=0.9,
+                       beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=None, lazy_update=True):
+    """ref: adam_update FComputeEx lazy path."""
+    rows = grad_rsp.indices._data.astype(jnp.int32)
+    g = grad_rsp.data._data * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w = weight._data
+    wr = jnp.take(w, rows, axis=0)
+    g = g + wd * wr
+    mr = beta1 * jnp.take(mean._data, rows, axis=0) + (1 - beta1) * g
+    vr = beta2 * jnp.take(var._data, rows, axis=0) + \
+        (1 - beta2) * jnp.square(g)
+    mean._data = mean._data.at[rows].set(mr)
+    var._data = var._data.at[rows].set(vr)
+    weight._data = w.at[rows].set(wr - lr * mr / (jnp.sqrt(vr) + epsilon))
+
+
+def add(lhs, rhs):
+    """elemwise add with row_sparse operands (ref: FComputeEx add)."""
+    from .ndarray import NDArray as _ND
+    if isinstance(lhs, RowSparseNDArray) and \
+            isinstance(rhs, RowSparseNDArray):
+        idx = _np.union1d(lhs.indices.asnumpy(), rhs.indices.asnumpy())
+        idx_j = jnp.asarray(idx.astype(_np.int64))
+        dense = jnp.zeros((len(idx), lhs.shape[1]), lhs.data._data.dtype)
+        pos_l = _np.searchsorted(idx, lhs.indices.asnumpy())
+        pos_r = _np.searchsorted(idx, rhs.indices.asnumpy())
+        dense = dense.at[jnp.asarray(pos_l)].add(lhs.data._data)
+        dense = dense.at[jnp.asarray(pos_r)].add(rhs.data._data)
+        return RowSparseNDArray(_ND(idx_j), _ND(dense), lhs.shape,
+                                ctx=lhs.context)
+    l = lhs.tostype("default") if not isinstance(lhs, _ND) else lhs
+    r = rhs.tostype("default") if not isinstance(rhs, _ND) else rhs
+    return l + r
+
+
+def retain(rsp, indices):
+    """ref: _retain op — keep only the requested rows."""
+    from .ndarray import NDArray as _ND
+    want = _np.asarray(indices.asnumpy() if hasattr(indices, "asnumpy")
+                       else indices).astype(_np.int64)
+    have = rsp.indices.asnumpy()
+    mask = _np.isin(have, want)
+    keep = _np.where(mask)[0]
+    return RowSparseNDArray(
+        _ND(jnp.asarray(have[keep])),
+        _ND(jnp.take(rsp.data._data, jnp.asarray(keep), axis=0)),
+        rsp.shape, ctx=rsp.context)
+
+
 def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
     if isinstance(arg, tuple) and len(arg) == 2:
         values, indices = arg

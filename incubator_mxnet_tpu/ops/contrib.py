@@ -1,0 +1,502 @@
+"""Contrib operators: detection boxes/NMS/ROI, resize, adaptive pooling.
+
+TPU-native equivalents of ref: src/operator/contrib/{bounding_box.cc,
+multibox_prior.cc, multibox_target.cc, multibox_detection.cc,
+roi_align.cc, adaptive_avg_pooling.cc, bilinear_resize.cc} and
+src/operator/roi_pooling.cc.
+
+Dynamic-output ops (NMS) follow the TPU convention (SURVEY §7.2): fixed
+shapes, suppressed entries marked with -1 — which is exactly the
+reference's `box_nms` contract, so no API change was needed.  Greedy NMS
+is a `lax.fori_loop` over score-ranked boxes with vectorised suppression
+masks (no host loop, jittable).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+from jax import lax
+
+from .registry import register, alias
+
+
+# ---------------------------------------------------------------------------
+# box primitives
+# ---------------------------------------------------------------------------
+
+def _iou_corner(a, b):
+    """IoU of (..., 4) corner boxes vs (..., M, 4) — broadcasting."""
+    tl = jnp.maximum(a[..., None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., None, 2:4], b[..., None, :, 2:4])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0.0) * \
+        jnp.maximum(a[..., 3] - a[..., 1], 0.0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0.0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0.0)
+    union = area_a[..., None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("box_iou", ndarray_inputs=("lhs", "rhs"))
+def box_iou(lhs, rhs, format="corner"):
+    """ref: bounding_box.cc box_iou — pairwise IoU."""
+    if format == "center":
+        def c2c(x):
+            cx, cy, w, h = (x[..., 0], x[..., 1], x[..., 2], x[..., 3])
+            return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                              cy + h / 2], axis=-1)
+        lhs, rhs = c2c(lhs), c2c(rhs)
+    la = lhs.reshape(-1, 4)
+    rb = rhs.reshape(-1, 4)
+    out = _iou_corner(la, rb)
+    return out.reshape(lhs.shape[:-1] + rhs.shape[:-1])
+
+
+@register("box_nms", ndarray_inputs=("data",), differentiable=False)
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1,
+            background_id=-1, force_suppress=False, in_format="corner",
+            out_format="corner"):
+    """ref: bounding_box.cc box_nms. Input (..., N, K); output same shape
+    with suppressed boxes' score set to -1 (fixed shape — TPU friendly
+    and reference-compatible)."""
+    shape = data.shape
+    d = data.reshape((-1,) + shape[-2:])       # (B, N, K)
+    B, N, K = d.shape
+    scores = d[..., score_index]
+    boxes = lax.dynamic_slice_in_dim(d, coord_start, 4, axis=2)
+    if in_format == "center":
+        cx, cy, w, h = (boxes[..., 0], boxes[..., 1], boxes[..., 2],
+                        boxes[..., 3])
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                           cy + h / 2], axis=-1)
+    cls = d[..., id_index] if id_index >= 0 else jnp.zeros_like(scores)
+    valid = scores > valid_thresh
+    if id_index >= 0 and background_id >= 0:
+        valid = jnp.logical_and(valid, cls != background_id)
+
+    order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf), axis=1)
+    if topk > 0:
+        keep_rank = jnp.arange(N) < topk
+    else:
+        keep_rank = jnp.ones((N,), bool)
+
+    sboxes = jnp.take_along_axis(boxes, order[..., None], axis=1)
+    svalid = jnp.take_along_axis(valid, order, axis=1) & keep_rank
+    scls = jnp.take_along_axis(cls, order, axis=1)
+
+    iou = _iou_corner(sboxes, sboxes)          # (B, N, N)
+    same_cls = scls[..., :, None] == scls[..., None, :]
+    suppress_pair = iou > overlap_thresh
+    if not force_suppress:
+        suppress_pair = suppress_pair & same_cls
+
+    def body(i, keep):
+        # box i suppresses later boxes if it is kept & valid
+        row = suppress_pair[:, i, :] & (jnp.arange(N) > i)
+        ki = keep[:, i] & svalid[:, i]
+        return jnp.where(ki[:, None], keep & ~row, keep)
+
+    keep = lax.fori_loop(0, N, body, jnp.ones((B, N), bool))
+    keep = keep & svalid
+    # scatter back to original order
+    inv = jnp.argsort(order, axis=1)
+    keep_orig = jnp.take_along_axis(keep, inv, axis=1)
+    new_scores = jnp.where(keep_orig, scores, -jnp.ones_like(scores))
+    out = d.at[..., score_index].set(new_scores)
+    return out.reshape(shape)
+
+
+@register("box_encode", ndarray_inputs=("samples", "matches", "anchors",
+                                        "refs"), differentiable=False)
+def box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+               stds=(0.1, 0.1, 0.2, 0.2)):
+    """ref: bounding_box.cc box_encode — corner gt vs center anchors."""
+    m = matches.astype(jnp.int32)
+    ref = jnp.take_along_axis(refs, m[..., None], axis=1)
+    def corner2center(x):
+        w = x[..., 2] - x[..., 0]
+        h = x[..., 3] - x[..., 1]
+        return (x[..., 0] + w / 2, x[..., 1] + h / 2, w, h)
+    gx, gy, gw, gh = corner2center(ref)
+    ax, ay, aw, ah = corner2center(anchors)
+    t0 = ((gx - ax) / aw - means[0]) / stds[0]
+    t1 = ((gy - ay) / ah - means[1]) / stds[1]
+    t2 = (jnp.log(jnp.maximum(gw / aw, 1e-12)) - means[2]) / stds[2]
+    t3 = (jnp.log(jnp.maximum(gh / ah, 1e-12)) - means[3]) / stds[3]
+    targets = jnp.stack([t0, t1, t2, t3], axis=-1)
+    mask = (samples > 0.5)[..., None]
+    return jnp.where(mask, targets, 0.0), \
+        jnp.broadcast_to(mask, targets.shape).astype(targets.dtype)
+
+
+@register("box_decode", ndarray_inputs=("data", "anchors"))
+def box_decode(data, anchors, std0=1.0, std1=1.0, std2=1.0, std3=1.0,
+               clip=-1.0, format="corner"):
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    ax = anchors[..., 0] + aw / 2
+    ay = anchors[..., 1] + ah / 2
+    ox = data[..., 0] * std0 * aw + ax
+    oy = data[..., 1] * std1 * ah + ay
+    dw = data[..., 2] * std2
+    dh = data[..., 3] * std3
+    if clip > 0:
+        dw = jnp.minimum(dw, clip)
+        dh = jnp.minimum(dh, clip)
+    ow = jnp.exp(dw) * aw / 2
+    oh = jnp.exp(dh) * ah / 2
+    return jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+
+
+@register("bipartite_matching", ndarray_inputs=("data",),
+          differentiable=False, num_outputs=2)
+def bipartite_matching(data, threshold=0.5, is_ascend=False, topk=-1):
+    """ref: bounding_box.cc bipartite_matching — greedy row/col matching
+    on a (B, N, M) score matrix."""
+    B, N, M = data.shape
+    score = data if not is_ascend else -data
+    K = N if topk <= 0 else min(topk, N)
+
+    def step(carry, _):
+        s, row_match, col_used = carry
+        flat = s.reshape(B, N * M)
+        idx = jnp.argmax(flat, axis=1)
+        best = jnp.take_along_axis(flat, idx[:, None], axis=1)[:, 0]
+        r = idx // M
+        c = idx % M
+        ok = best > (threshold if not is_ascend else -threshold)
+        row_match = jnp.where(
+            ok, row_match.at[jnp.arange(B), r].set(
+                jnp.where(ok, c, row_match[jnp.arange(B), r])), row_match)
+        col_used = col_used.at[jnp.arange(B), c].set(
+            col_used[jnp.arange(B), c] | ok)
+        s = s.at[jnp.arange(B), r, :].set(-jnp.inf)
+        s = jnp.where(ok[:, None, None] &
+                      (jnp.arange(M)[None, None, :] == c[:, None, None]),
+                      -jnp.inf, s)
+        return (s, row_match, col_used), None
+
+    init = (jnp.where(score > -jnp.inf, score, score),
+            jnp.full((B, N), -1, jnp.int32),
+            jnp.zeros((B, M), bool))
+    (s, row_match, _), _ = lax.scan(step, init, None, length=K)
+    cmatch = jnp.full((B, M), -1, jnp.int32)
+    return row_match.astype(jnp.float32), cmatch.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# MultiBox family (SSD config)
+# ---------------------------------------------------------------------------
+
+
+@register("MultiBoxPrior", ndarray_inputs=("data",), differentiable=False)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """ref: multibox_prior.cc — anchors for one feature map (1, H*W*A, 4)."""
+    H, W = data.shape[2], data.shape[3]
+    sizes = tuple(sizes)
+    ratios = tuple(ratios)
+    step_y = steps[1] if steps[1] > 0 else 1.0 / H
+    step_x = steps[0] if steps[0] > 0 else 1.0 / W
+    ys = (jnp.arange(H) + offsets[1]) * step_y
+    xs = (jnp.arange(W) + offsets[0]) * step_x
+    cy, cx = jnp.meshgrid(ys, xs, indexing="ij")
+    # anchor shapes: (sizes[0], r) for all ratios + (s, 1) for sizes[1:]
+    ws, hs = [], []
+    for r in ratios:
+        sr = _np.sqrt(r)
+        ws.append(sizes[0] * sr)
+        hs.append(sizes[0] / sr)
+    for s in sizes[1:]:
+        ws.append(s)
+        hs.append(s)
+    ws = jnp.asarray(ws)
+    hs = jnp.asarray(hs)
+    A = ws.shape[0]
+    cxe = cx[..., None]
+    cye = cy[..., None]
+    boxes = jnp.stack([cxe - ws / 2, cye - hs / 2,
+                       cxe + ws / 2, cye + hs / 2], axis=-1)  # (H,W,A,4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes.reshape(1, H * W * A, 4)
+
+
+@register("MultiBoxTarget", ndarray_inputs=("anchor", "label", "cls_pred"),
+          differentiable=False, num_outputs=3)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """ref: multibox_target.cc — SSD training targets.
+
+    anchor (1, N, 4) corner; label (B, M, 5) [cls, x1, y1, x2, y2] with
+    -1 padding.  Returns (loc_target (B, N*4), loc_mask (B, N*4),
+    cls_target (B, N))."""
+    anchors = anchor.reshape(-1, 4)
+    N = anchors.shape[0]
+    B, M, _ = label.shape
+
+    def per_sample(lab):
+        gt_valid = lab[:, 0] >= 0
+        gt_boxes = lab[:, 1:5]
+        iou = _iou_corner(anchors, gt_boxes)[..., :]    # (N, M)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)               # per anchor
+        best_iou = jnp.max(iou, axis=1)
+        # force-match: best anchor per gt
+        best_anchor = jnp.argmax(iou, axis=0)           # (M,)
+        forced = jnp.zeros((N,), bool).at[best_anchor].set(gt_valid)
+        pos = forced | (best_iou >= overlap_threshold)
+        matched_gt = best_gt
+        cls_t = jnp.where(
+            pos, lab[matched_gt, 0] + 1.0, 0.0)          # 0 = background
+        # location targets (center encoding with variances)
+        mg = gt_boxes[matched_gt]
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        ax = anchors[:, 0] + aw / 2
+        ay = anchors[:, 1] + ah / 2
+        gw = jnp.maximum(mg[:, 2] - mg[:, 0], 1e-8)
+        gh = jnp.maximum(mg[:, 3] - mg[:, 1], 1e-8)
+        gx = mg[:, 0] + gw / 2
+        gy = mg[:, 1] + gh / 2
+        t = jnp.stack([(gx - ax) / aw / variances[0],
+                       (gy - ay) / ah / variances[1],
+                       jnp.log(gw / aw) / variances[2],
+                       jnp.log(gh / ah) / variances[3]], axis=-1)
+        mask = pos[:, None].astype(t.dtype) * jnp.ones((1, 4), t.dtype)
+        t = t * mask
+        return t.reshape(-1), mask.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(per_sample)(label)
+    return loc_t, loc_m, cls_t
+
+
+@register("MultiBoxDetection", ndarray_inputs=("cls_prob", "loc_pred",
+                                               "anchor"),
+          differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                       threshold=0.01, background_id=0, nms_threshold=0.5,
+                       force_suppress=False, variances=(0.1, 0.1, 0.2, 0.2),
+                       nms_topk=-1):
+    """ref: multibox_detection.cc — decode + per-class NMS.
+    cls_prob (B, C, N), loc_pred (B, N*4), anchor (1, N, 4).
+    Output (B, N, 6) rows [cls_id, score, x1, y1, x2, y2], -1 padded."""
+    B, C, N = cls_prob.shape
+    anchors = anchor.reshape(-1, 4)
+    loc = loc_pred.reshape(B, N, 4)
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = anchors[:, 0] + aw / 2
+    ay = anchors[:, 1] + ah / 2
+    ox = loc[..., 0] * variances[0] * aw + ax
+    oy = loc[..., 1] * variances[1] * ah + ay
+    ow = jnp.exp(loc[..., 2] * variances[2]) * aw / 2
+    oh = jnp.exp(loc[..., 3] * variances[3]) * ah / 2
+    boxes = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    # best non-background class per anchor
+    fg = cls_prob[:, 1:, :] if background_id == 0 else cls_prob
+    cls_id = jnp.argmax(fg, axis=1).astype(jnp.float32)
+    score = jnp.max(fg, axis=1)
+    keep = score > threshold
+    cls_id = jnp.where(keep, cls_id, -1.0)
+    score = jnp.where(keep, score, -1.0)
+    det = jnp.concatenate([cls_id[..., None], score[..., None], boxes],
+                          axis=-1)
+    return box_nms(det, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                   force_suppress=force_suppress)
+
+
+# ---------------------------------------------------------------------------
+# ROI ops (Faster-RCNN config)
+# ---------------------------------------------------------------------------
+
+
+@register("ROIAlign", ndarray_inputs=("data", "rois"), nograd_argnums=(1,))
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=2, position_sensitive=False, aligned=False):
+    """ref: contrib/roi_align.cc — bilinear-sampled ROI pooling.
+    data (B, C, H, W); rois (R, 5) [batch_idx, x1, y1, x2, y2]."""
+    PH, PW = pooled_size
+    S = max(1, int(sample_ratio))
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_w = rw / PW
+        bin_h = rh / PH
+        img = data[b]                      # (C, H, W)
+        # sample grid: (PH*S, PW*S)
+        ys = y1 + (jnp.arange(PH * S) + 0.5) * (bin_h / S)
+        xs = x1 + (jnp.arange(PW * S) + 0.5) * (bin_w / S)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        sampled = _bilinear_sample(img, gy, gx)   # (C, PH*S, PW*S)
+        C = sampled.shape[0]
+        pooled = sampled.reshape(C, PH, S, PW, S).mean(axis=(2, 4))
+        return pooled
+
+    return jax.vmap(one_roi)(rois)
+
+
+def _bilinear_sample(img, gy, gx):
+    """img (C, H, W); gy/gx sample coords → (C, *grid)."""
+    C, H, W = img.shape
+    y0 = jnp.floor(gy)
+    x0 = jnp.floor(gx)
+    y1 = y0 + 1
+    x1 = x0 + 1
+    wy1 = gy - y0
+    wx1 = gx - x0
+    wy0 = 1.0 - wy1
+    wx0 = 1.0 - wx1
+
+    def gather(yy, xx):
+        yi = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
+        xi = jnp.clip(xx.astype(jnp.int32), 0, W - 1)
+        return img[:, yi, xi]
+
+    out = (gather(y0, x0) * (wy0 * wx0) + gather(y0, x1) * (wy0 * wx1) +
+           gather(y1, x0) * (wy1 * wx0) + gather(y1, x1) * (wy1 * wx1))
+    inb = ((gy >= -1) & (gy <= H) & (gx >= -1) & (gx <= W))
+    return jnp.where(inb, out, 0.0)
+
+
+@register("ROIPooling", ndarray_inputs=("data", "rois"), nograd_argnums=(1,))
+def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """ref: src/operator/roi_pooling.cc — quantised max pooling."""
+    PH, PW = pooled_size
+    B, C, H, W = data.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        img = data[b]
+        ph = jnp.arange(PH)
+        pw = jnp.arange(PW)
+        hstart = jnp.floor(ph * rh / PH) + y1
+        hend = jnp.ceil((ph + 1) * rh / PH) + y1
+        wstart = jnp.floor(pw * rw / PW) + x1
+        wend = jnp.ceil((pw + 1) * rw / PW) + x1
+        yy = jnp.arange(H)[None, :]
+        in_h = (yy >= hstart[:, None]) & (yy < hend[:, None])  # (PH, H)
+        xx = jnp.arange(W)[None, :]
+        in_w = (xx >= wstart[:, None]) & (xx < wend[:, None])  # (PW, W)
+        m = in_h[:, None, :, None] & in_w[None, :, None, :]    # PH PW H W
+        big = jnp.where(m[None], img[:, None, None, :, :], -jnp.inf)
+        return jnp.max(big, axis=(3, 4))                       # (C, PH, PW)
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# resize / adaptive pooling
+# ---------------------------------------------------------------------------
+
+
+@register("BilinearResize2D", ndarray_inputs=("data",))
+def bilinear_resize_2d(data, height=0, width=0, scale_height=None,
+                       scale_width=None, mode="size",
+                       align_corners=True):
+    """ref: contrib/bilinear_resize.cc."""
+    n, c, h, w = data.shape
+    if height == 0 or mode != "size":
+        height = int(h * (scale_height or 1.0))
+        width = int(w * (scale_width or 1.0))
+    return jax.image.resize(data, (n, c, int(height), int(width)),
+                            method="bilinear")
+
+
+@register("AdaptiveAvgPooling2D", ndarray_inputs=("data",))
+def adaptive_avg_pooling_2d(data, output_size=(1, 1)):
+    """ref: contrib/adaptive_avg_pooling.cc — exact torch-style binning."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    OH, OW = output_size
+    n, c, H, W = data.shape
+    if H % OH == 0 and W % OW == 0:
+        return data.reshape(n, c, OH, H // OH, OW, W // OW).mean(
+            axis=(3, 5))
+    rows = []
+    for oh in range(OH):
+        h0 = (oh * H) // OH
+        h1 = -(-((oh + 1) * H) // OH)
+        cols = []
+        for ow in range(OW):
+            w0 = (ow * W) // OW
+            w1 = -(-((ow + 1) * W) // OW)
+            cols.append(data[:, :, h0:h1, w0:w1].mean(axis=(2, 3)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
+
+
+@register("count_sketch", ndarray_inputs=("data", "h", "s"),
+          differentiable=False)
+def count_sketch(data, h, s, out_dim=0, processing_batch_size=32):
+    """ref: contrib/count_sketch.cc — compact bilinear pooling hash."""
+    idx = h.astype(jnp.int32).reshape(-1)
+    sign = s.reshape(-1)
+    out = jnp.zeros(data.shape[:-1] + (int(out_dim),), data.dtype)
+    return out.at[..., idx].add(data * sign)
+
+
+@register("index_copy", ndarray_inputs=("old", "index", "new"),
+          nograd_argnums=(1,))
+def index_copy(old, index, new):
+    """ref: contrib/index_copy.cc."""
+    return old.at[index.astype(jnp.int32)].set(new)
+
+
+@register("getnnz", ndarray_inputs=("data",), differentiable=False)
+def getnnz(data, axis=None):
+    nz = (data != 0)
+    if axis is None:
+        return jnp.sum(nz).astype(jnp.int64).reshape(1)
+    return jnp.sum(nz, axis=axis).astype(jnp.int64)
+
+
+# interleaved attention kernels (ref: contrib/transformer.cc — BERT path);
+# XLA fuses these patterns natively, bodies provided for API parity.
+
+@register("interleaved_matmul_selfatt_qk",
+          ndarray_inputs=("queries_keys_values",))
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
+    """qkv: (T, B, 3*C) interleaved per head. Returns (B*H, T, T)."""
+    T, B, C3 = queries_keys_values.shape
+    C = C3 // 3
+    d = C // heads
+    qkv = queries_keys_values.reshape(T, B, heads, 3, d)
+    q = qkv[:, :, :, 0, :].transpose(1, 2, 0, 3).reshape(B * heads, T, d)
+    k = qkv[:, :, :, 1, :].transpose(1, 2, 0, 3).reshape(B * heads, T, d)
+    return jnp.matmul(q, k.transpose(0, 2, 1)) / _np.sqrt(d)
+
+
+@register("interleaved_matmul_selfatt_valatt",
+          ndarray_inputs=("queries_keys_values", "attention"))
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
+                                      heads=1):
+    T, B, C3 = queries_keys_values.shape
+    C = C3 // 3
+    d = C // heads
+    qkv = queries_keys_values.reshape(T, B, heads, 3, d)
+    v = qkv[:, :, :, 2, :].transpose(1, 2, 0, 3).reshape(B * heads, T, d)
+    out = jnp.matmul(attention, v)                 # (B*H, T, d)
+    return out.reshape(B, heads, T, d).transpose(2, 0, 1, 3) \
+        .reshape(T, B, C)

@@ -219,6 +219,12 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        from ..ndarray.sparse import RowSparseNDArray, sparse_sgd_update
+        if isinstance(grad, RowSparseNDArray):
+            # lazy row_sparse path (ref: sgd_update FComputeEx)
+            sparse_sgd_update(weight, grad, lr, wd, self.rescale_grad,
+                              self.clip_gradient, self.lazy_update)
+            return
         scal = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad)
         static = dict(clip_gradient=self.clip_gradient
                       if self.clip_gradient is not None else -1.0)
@@ -278,6 +284,13 @@ class Adam(Optimizer):
         lr = self._get_lr(index)
         lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
         mean, var = state
+        from ..ndarray.sparse import RowSparseNDArray, sparse_adam_update
+        if isinstance(grad, RowSparseNDArray):
+            sparse_adam_update(weight, grad, mean, var, lr, self.beta1,
+                               self.beta2, self.epsilon,
+                               self._get_wd(index), self.rescale_grad,
+                               self.clip_gradient, self.lazy_update)
+            return
         scal = dict(lr=lr, wd=self._get_wd(index),
                     rescale_grad=self.rescale_grad,
                     beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
@@ -300,6 +313,14 @@ class AdaGrad(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
+        from ..ndarray.sparse import RowSparseNDArray, \
+            sparse_adagrad_update
+        if isinstance(grad, RowSparseNDArray):
+            sparse_adagrad_update(weight, grad, state, self._get_lr(index),
+                                  self.float_stable_eps,
+                                  self._get_wd(index), self.rescale_grad,
+                                  self.clip_gradient)
+            return
         scal = dict(lr=self._get_lr(index), wd=self._get_wd(index),
                     rescale_grad=self.rescale_grad,
                     epsilon=self.float_stable_eps)

@@ -1,0 +1,139 @@
+"""Contrib ops (ref: tests/python/unittest/test_contrib_operator.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_box_iou():
+    a = nd.array([[0, 0, 2, 2]])
+    b = nd.array([[1, 1, 3, 3], [0, 0, 2, 2], [5, 5, 6, 6]])
+    iou = nd.contrib.box_iou(a, b).asnumpy()
+    assert iou[0, 0] == pytest.approx(1.0 / 7.0, rel=1e-4)
+    assert iou[0, 1] == pytest.approx(1.0)
+    assert iou[0, 2] == 0.0
+
+
+def test_box_nms_suppression():
+    det = nd.array([[[0, 0.9, 0, 0, 1, 1],
+                     [0, 0.8, 0.05, 0.05, 1, 1],
+                     [1, 0.7, 0.8, 0.8, 1.5, 1.5],
+                     [0, 0.05, 0, 0, 0.1, 0.1]]])
+    out = nd.contrib.box_nms(det, overlap_thresh=0.5, valid_thresh=0.1,
+                             id_index=0).asnumpy()
+    scores = out[0, :, 1]
+    assert scores[0] == pytest.approx(0.9)          # kept
+    assert scores[1] == -1                          # IoU > 0.5, same class
+    assert scores[2] == pytest.approx(0.7)          # other class kept
+    assert scores[3] == -1                          # below valid_thresh
+    # force_suppress ignores class ids
+    out2 = nd.contrib.box_nms(det, overlap_thresh=0.01, valid_thresh=0.1,
+                              id_index=0, force_suppress=True).asnumpy()
+    assert (out2[0, 1:, 1] <= 0.7).all()
+
+
+def test_box_nms_topk():
+    n = 8
+    det = np.zeros((1, n, 6), "float32")
+    det[0, :, 0] = 0
+    det[0, :, 1] = np.linspace(0.9, 0.2, n)
+    # far-apart boxes: no overlap suppression
+    for i in range(n):
+        det[0, i, 2:] = [i * 10, 0, i * 10 + 1, 1]
+    out = nd.contrib.box_nms(nd.array(det), topk=3, id_index=0).asnumpy()
+    assert (out[0, :3, 1] > 0).all()
+    assert (out[0, 3:, 1] == -1).all()
+
+
+def test_multibox_prior():
+    anchors = nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 2, 2)),
+                                       sizes=(0.5,), ratios=(1.0,))
+    a = anchors.asnumpy()
+    assert a.shape == (1, 4, 4)
+    # first anchor centered at (0.25, 0.25) with size 0.5
+    assert_almost_equal(a[0, 0], [0.0, 0.0, 0.5, 0.5], atol=1e-5)
+
+
+def test_multibox_target_and_detection():
+    anchors = nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 4, 4)),
+                                       sizes=(0.3,), ratios=(1.0,))
+    N = anchors.shape[1]
+    label = nd.array([[[0, 0.1, 0.1, 0.4, 0.4],
+                       [-1, 0, 0, 0, 0]]])       # one gt + padding
+    cls_pred = nd.zeros((1, 2, N))
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(anchors, label,
+                                                    cls_pred)
+    assert loc_t.shape == (1, N * 4)
+    assert cls_t.shape == (1, N)
+    ct = cls_t.asnumpy()
+    assert (ct == 1).sum() >= 1                    # at least forced match
+    assert (ct == 0).sum() > 0                     # background exists
+    # detection decodes + nms
+    cls_prob = nd.array(np.random.rand(1, 2, N).astype("float32"))
+    loc_pred = nd.zeros((1, N * 4))
+    det = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors)
+    assert det.shape == (1, N, 6)
+
+
+def test_roialign_known_values():
+    # constant image → every pooled value equals the constant
+    img = nd.ones((1, 1, 8, 8)) * 3.0
+    rois = nd.array([[0, 1, 1, 5, 5]], dtype="float32")
+    out = nd.contrib.ROIAlign(img, rois, pooled_size=(2, 2),
+                              spatial_scale=1.0)
+    assert_almost_equal(out, np.full((1, 1, 2, 2), 3.0), rtol=1e-4)
+
+
+def test_roialign_gradient():
+    from incubator_mxnet_tpu import autograd as ag
+    x = nd.array(np.random.randn(1, 2, 8, 8).astype("float32"))
+    rois = nd.array([[0, 0, 0, 4, 4]], dtype="float32")
+    x.attach_grad()
+    with ag.record():
+        out = nd.contrib.ROIAlign(x, rois, pooled_size=(2, 2))
+        out.sum().backward()
+    assert float(x.grad.norm().asscalar()) > 0
+
+
+def test_roi_pooling():
+    img = nd.array(np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    rois = nd.array([[0, 0, 0, 3, 3]], dtype="float32")
+    out = nd.ROIPooling(img, rois, pooled_size=(2, 2),
+                        spatial_scale=1.0).asnumpy()
+    assert out[0, 0, 1, 1] == 15.0       # bottom-right max
+
+
+def test_adaptive_avg_pool():
+    x = nd.array(np.random.randn(2, 3, 8, 8).astype("float32"))
+    out = nd.contrib.AdaptiveAvgPooling2D(x, output_size=(2, 2))
+    expect = x.asnumpy().reshape(2, 3, 2, 4, 2, 4).mean(axis=(3, 5))
+    assert_almost_equal(out, expect, rtol=1e-4)
+    # non-divisible
+    out2 = nd.contrib.AdaptiveAvgPooling2D(x, output_size=(3, 3))
+    assert out2.shape == (2, 3, 3, 3)
+
+
+def test_bilinear_resize():
+    x = nd.array(np.random.randn(1, 1, 4, 4).astype("float32"))
+    out = nd.contrib.BilinearResize2D(x, height=8, width=8)
+    assert out.shape == (1, 1, 8, 8)
+
+
+def test_box_decode_encode_roundtrip():
+    anchors = nd.array([[[0.2, 0.2, 0.6, 0.6]]])
+    zero_pred = nd.zeros((1, 1, 4))
+    decoded = nd.contrib.box_decode(zero_pred, anchors)
+    assert_almost_equal(decoded, anchors.asnumpy(), atol=1e-5)
+
+
+def test_interleaved_attention():
+    T, B, H, d = 4, 2, 2, 8
+    C = H * d
+    qkv = nd.array(np.random.randn(T, B, 3 * C).astype("float32"))
+    att = nd.interleaved_matmul_selfatt_qk(qkv, heads=H)
+    assert att.shape == (B * H, T, T)
+    sm = nd.softmax(att, axis=-1)
+    out = nd.interleaved_matmul_selfatt_valatt(qkv, sm, heads=H)
+    assert out.shape == (T, B, C)

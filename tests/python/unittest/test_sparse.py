@@ -1,0 +1,140 @@
+"""Sparse NDArray + ops (ref: tests/python/unittest/test_sparse_ndarray.py,
+test_sparse_operator.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.ndarray import sparse
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _rand_sparse(shape, density=0.3):
+    a = np.random.randn(*shape).astype("float32")
+    mask = np.random.rand(*shape) < density
+    return a * mask
+
+
+def test_csr_roundtrip():
+    a = _rand_sparse((6, 8))
+    csr = sparse.csr_matrix(a)
+    assert csr.stype == "csr"
+    assert csr.shape == (6, 8)
+    assert_almost_equal(csr.asnumpy(), a)
+    dense = csr.tostype("default")
+    assert_almost_equal(dense, a)
+
+
+def test_row_sparse_roundtrip():
+    a = np.zeros((8, 4), "float32")
+    a[1] = 1.0
+    a[5] = 2.0
+    rsp = sparse.row_sparse_array(a)
+    assert rsp.stype == "row_sparse"
+    assert list(rsp.indices.asnumpy()) == [1, 5]
+    assert_almost_equal(rsp.asnumpy(), a)
+
+
+def test_cast_storage():
+    a = _rand_sparse((5, 5))
+    dense = nd.array(a)
+    csr = sparse.cast_storage(dense, "csr")
+    back = sparse.cast_storage(csr, "default")
+    assert_almost_equal(back, a)
+    rsp = sparse.cast_storage(dense, "row_sparse")
+    assert_almost_equal(rsp.asnumpy(), a)
+
+
+def test_csr_dot():
+    a = _rand_sparse((6, 10))
+    w = np.random.randn(10, 3).astype("float32")
+    csr = sparse.csr_matrix(a)
+    out = sparse.dot(csr, nd.array(w))
+    assert_almost_equal(out, a @ w, rtol=1e-4, atol=1e-4)
+
+
+def test_csr_dot_transpose():
+    a = _rand_sparse((6, 10))
+    x = np.random.randn(6, 3).astype("float32")
+    csr = sparse.csr_matrix(a)
+    out = sparse.dot(csr, nd.array(x), transpose_a=True)
+    assert_almost_equal(out, a.T @ x, rtol=1e-4, atol=1e-4)
+
+
+def test_embedding_grad_row_sparse():
+    idx = nd.array([2, 7, 2, 0], dtype="int32")
+    og = nd.array(np.ones((4, 3), "float32"))
+    g = sparse.embedding_grad(idx, og, vocab_size=10)
+    assert list(g.indices.asnumpy()) == [0, 2, 7]
+    vals = g.data.asnumpy()
+    assert vals[1, 0] == 2.0       # row 2 hit twice
+
+
+def test_sparse_sgd_lazy():
+    w = nd.array(np.ones((6, 2), "float32"))
+    g = sparse.RowSparseNDArray(np.array([1, 4]),
+                                np.ones((2, 2), "float32"), (6, 2))
+    sparse.sparse_sgd_update(w, g, lr=0.5)
+    out = w.asnumpy()
+    assert out[1, 0] == 0.5
+    assert out[4, 0] == 0.5
+    assert out[0, 0] == 1.0        # untouched
+
+
+def test_sparse_adagrad_and_adam():
+    w = nd.array(np.ones((6, 2), "float32"))
+    h = nd.array(np.zeros((6, 2), "float32"))
+    g = sparse.RowSparseNDArray(np.array([2]),
+                                np.full((1, 2), 2.0, "float32"), (6, 2))
+    sparse.sparse_adagrad_update(w, g, h, lr=1.0)
+    assert h.asnumpy()[2, 0] == 4.0
+    assert w.asnumpy()[2, 0] != 1.0
+    assert w.asnumpy()[0, 0] == 1.0
+
+    w2 = nd.array(np.ones((6, 2), "float32"))
+    m = nd.array(np.zeros((6, 2), "float32"))
+    v = nd.array(np.zeros((6, 2), "float32"))
+    sparse.sparse_adam_update(w2, g, m, v, lr=0.1)
+    assert m.asnumpy()[2, 0] != 0
+    assert w2.asnumpy()[0, 0] == 1.0
+
+
+def test_optimizer_dispatches_sparse():
+    from incubator_mxnet_tpu import optimizer as opt
+    w = nd.array(np.ones((6, 2), "float32"))
+    g = sparse.RowSparseNDArray(np.array([3]),
+                                np.ones((1, 2), "float32"), (6, 2))
+    o = opt.SGD(learning_rate=1.0)
+    o.update(0, w, g, o.create_state(0, w))
+    assert w.asnumpy()[3, 0] == 0.0
+    assert w.asnumpy()[0, 0] == 1.0
+    o2 = opt.Adam()
+    w2 = nd.array(np.ones((6, 2), "float32"))
+    o2.update(0, w2, g, o2.create_state(0, w2))
+    assert w2.asnumpy()[3, 0] != 1.0
+
+
+def test_retain():
+    rsp = sparse.RowSparseNDArray(np.array([1, 3, 5]),
+                                  np.arange(6, dtype="float32")
+                                  .reshape(3, 2), (8, 2))
+    out = sparse.retain(rsp, np.array([3, 5, 7]))
+    assert list(out.indices.asnumpy()) == [3, 5]
+
+
+def test_rsp_add():
+    a = sparse.RowSparseNDArray(np.array([0, 2]),
+                                np.ones((2, 3), "float32"), (4, 3))
+    b = sparse.RowSparseNDArray(np.array([2, 3]),
+                                np.ones((2, 3), "float32") * 2, (4, 3))
+    out = sparse.add(a, b)
+    d = out.asnumpy()
+    assert d[0, 0] == 1 and d[2, 0] == 3 and d[3, 0] == 2
+
+
+def test_rand_ndarray_sparse():
+    from incubator_mxnet_tpu.test_utils import rand_ndarray
+    csr = rand_ndarray((10, 10), stype="csr", density=0.2)
+    assert csr.stype == "csr"
+    rsp = rand_ndarray((10, 4), stype="row_sparse", density=0.3)
+    assert rsp.stype == "row_sparse"
