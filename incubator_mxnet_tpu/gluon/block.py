@@ -90,9 +90,17 @@ def record_state_update(param, new_value_nd):
     if _STATE.active is not None:
         _STATE.active.append((param, new_value_nd._data))
         return
+    _write_state_all_ctx(param, new_value_nd._data)
+
+
+def _write_state_all_ctx(param, value):
+    """Write an updated aux-state value to EVERY per-context copy of the
+    parameter (running stats must stay in sync across devices in
+    multi-context training), keeping each copy's dtype and device."""
+    import jax as _jax
     for ctx, arr in param._data.items():
-        arr._data = new_value_nd._data.astype(arr._data.dtype)
-        break
+        arr._data = _jax.device_put(value.astype(arr._data.dtype),
+                                    ctx.jax_device)
 
 
 # ---------------------------------------------------------------------------
@@ -267,11 +275,15 @@ class _CachedGraph:
         self.flags = flags
         self.param_names = None     # ordered param names (stable)
         self.params = None          # ordered Parameter objects
-        self.state_params = None    # params receiving state updates
-        self.out_treedef = None
-        self._jitted = {}           # training_flag -> jitted forward
-        self._raw = {}              # training_flag -> unjitted pure
-        self._jit_bwd = {}          # training_flag -> jitted backward
+        self._jitted = {}           # fkey -> jitted forward
+        self._raw = {}              # fkey -> unjitted pure
+        self._jit_bwd = {}          # bwd key -> jitted backward
+        # fkey -> (out_treedef, state_params): BatchNorm-style state
+        # outputs exist only in training mode, so trace metadata MUST be
+        # keyed by the same (training, np_, ni_) signature as the jitted
+        # executables — a single global copy mis-slices outputs when a
+        # hybridized net switches between train and eval
+        self._trace_meta = {}
         self._jax = jax
 
     def _collect_params(self):
@@ -279,7 +291,7 @@ class _CachedGraph:
         self.param_names = list(pd.keys())
         self.params = [pd[n] for n in self.param_names]
 
-    def _make_pure(self, training):
+    def _make_pure(self, training, fkey):
         import jax
         block = self.block
 
@@ -309,11 +321,9 @@ class _CachedGraph:
                 for p, ctx0, orig in saved:
                     p._data[ctx0] = orig
             out_flat, treedef = _flatten_out(out)
-            if self.out_treedef is None:
-                self.out_treedef = treedef
-            sp = [p for p, _ in states]
-            if self.state_params is None:
-                self.state_params = sp
+            # unconditional: a retrace with the same signature yields the
+            # same structure; a NEW signature records its own metadata
+            self._trace_meta[fkey] = (treedef, [p for p, _ in states])
             return (tuple(o._data for o in out_flat),
                     tuple(v for _, v in states))
         return pure
@@ -321,9 +331,10 @@ class _CachedGraph:
     def _get_flat(self, training, np_, ni_):
         """pure_flat(*leaves) -> flat tuple(outs + states); leaves =
         params + inputs + key_bits."""
-        if training not in self._raw:
-            self._raw[training] = self._make_pure(training)
-        pure = self._raw[training]
+        fkey = (training, np_, ni_)
+        if fkey not in self._raw:
+            self._raw[fkey] = self._make_pure(training, fkey)
+        pure = self._raw[fkey]
 
         def pure_flat(*leaves):
             pv = leaves[:np_]
@@ -420,15 +431,15 @@ class _CachedGraph:
                           name=self.block.name + "_cachedop",
                           out_is_tuple=True)
 
-        n_states = len(self.state_params or ())
+        out_treedef, state_params = self._trace_meta[fkey]
+        n_states = len(state_params)
         outs = wrapped[:len(wrapped) - n_states]
         states = wrapped[len(wrapped) - n_states:]
-        for p, s in zip(self.state_params or (), states):
-            for c in list(p._data.keys()):
-                # keep the param's stored dtype (stats compute in f32)
-                p._data[c]._data = s._data.astype(p._data[c]._data.dtype)
-                break
-        return _unflatten_out(list(outs), self.out_treedef)
+        for p, s in zip(state_params, states):
+            # every ctx copy, kept in the param's stored dtype (stats
+            # compute in f32)
+            _write_state_all_ctx(p, s._data)
+        return _unflatten_out(list(outs), out_treedef)
 
 
 def _flatten_out(out):

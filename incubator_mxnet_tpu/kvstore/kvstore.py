@@ -79,7 +79,16 @@ class KVStore:
                 # DataHandleEx → updater(key, grad, weight))
                 self._updater(self._int_key(k), agg, self._store[k])
             else:
-                self._store[k]._data = self._store[k]._data + agg._data
+                # reference semantics: push REPLACES the stored value with
+                # the aggregate (init 2, push 8 → pull 8), it does not
+                # accumulate into it.  Cast to the stored dtype and force a
+                # copy: storing the caller's buffer verbatim would alias it
+                # (fatal if the caller's buffer is later donated) and drift
+                # the store's dtype to the pushed dtype.
+                self._store[k]._data = jax.device_put(
+                    jnp.array(agg._data, dtype=self._store[k]._data.dtype,
+                              copy=True),
+                    self._store[k].context.jax_device)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize(key, out)

@@ -257,6 +257,11 @@ class NDArray:
     # autograd (ref: MXNDArrayAttachGrad / MXAutogradBackward)
     # ------------------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):
+        # ref MarkVariables replaces the autograd entry with a fresh
+        # variable node: attaching a grad makes this array a LEAF, so a
+        # recorded history no longer flows through it
+        self._tape_node = None
+        self._out_index = 0
         self._grad = NDArray(jnp.zeros(self.shape, self._data.dtype),
                              ctx=self._ctx)
         self._grad_req = grad_req

@@ -14,17 +14,11 @@ from __future__ import annotations
 
 import functools
 import math
-import warnings
 from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as _np
-
-# grad buffers are donated alongside weight/state (one donate list keeps
-# the jit cache simple); XLA can't reuse them — silence that advisory
-warnings.filterwarnings(
-    "ignore", message="Some donated buffers were not usable")
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
@@ -41,20 +35,30 @@ __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta",
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def _jit_update(opname: str, static_kv: tuple, donate: bool = True):
+def _jit_update(opname: str, static_kv: tuple, donate_idx: tuple = ()):
+    """Jit a fused update op with per-position donation.  Arrays are
+    passed as separate positional args (scalars dict last) so
+    `donate_argnums` can donate weight/state buffers while leaving the
+    gradient untouched — `Parameter._grad` still references it after the
+    step (donating it dereferences a dead buffer on real TPU, where
+    donation is enforced; CPU ignores it and hid the bug)."""
     fn = _registry.get(opname).fn
 
-    def f(arrs, scalars):
+    def f(*args):
+        arrs, scalars = args[:-1], args[-1]
         return fn(*arrs, **scalars, **dict(static_kv))
-    return jax.jit(f, donate_argnums=0 if donate else ())
+    return jax.jit(f, donate_argnums=donate_idx)
 
 
 def _fused(opname, arrays, scalars, static, donate=True):
-    """Run a fused update op: donates `arrays`' buffers, returns new ones."""
-    jf = _jit_update(opname, tuple(sorted(static.items())), donate)
-    data = tuple(a._data for a in arrays)
+    """Run a fused update op `fn(weight, grad, *states, ...)`: donates the
+    weight/state buffers (positions != 1), never the grad, returns new
+    buffers."""
+    donate_idx = tuple(i for i in range(len(arrays)) if i != 1) \
+        if donate else ()
+    jf = _jit_update(opname, tuple(sorted(static.items())), donate_idx)
     scal = {k: jnp.asarray(v, jnp.float32) for k, v in scalars.items()}
-    return jf(data, scal)
+    return jf(*(a._data for a in arrays), scal)
 
 
 def _zeros_state(weight):
@@ -492,8 +496,10 @@ class LAMB(Optimizer):
             if self.lower_bound is not None else -1.0,
             upper_bound=self.upper_bound
             if self.upper_bound is not None else -1.0)
-        jf = _jit_update("lamb_update_phase2", tuple(sorted(static2.items())))
-        new_w = jf((w_nd._data, g, r1, r2),
+        # donate only the weight; g/r1/r2 are fresh phase1 outputs
+        jf = _jit_update("lamb_update_phase2", tuple(sorted(static2.items())),
+                         donate_idx=(0,))
+        new_w = jf(w_nd._data, g, r1, r2,
                    {k: jnp.asarray(v, jnp.float32)
                     for k, v in scal2.items()})
         weight._data = new_w

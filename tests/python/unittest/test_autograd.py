@@ -156,3 +156,18 @@ def test_mark_variables():
         y = (x * x).sum()
     y.backward()
     assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_attach_grad_detaches_from_graph():
+    """Regression: attach_grad must make the array a LEAF (ref
+    MarkVariables replaces the entry with a fresh variable node) — the
+    recorded history upstream of it no longer receives gradient."""
+    x = nd.array(np.array([1.0, 2.0], dtype="float32"))
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+        y.attach_grad()         # detaches y from the x*2 history
+        z = y * 3
+    z.backward()
+    assert_almost_equal(y.grad.asnumpy(), np.full((2,), 3.0))
+    assert_almost_equal(x.grad.asnumpy(), np.zeros((2,)))

@@ -260,3 +260,57 @@ def test_constant_parameter():
     const.initialize()
     assert const.data().shape == (1, 2)
     assert const.grad_req == "null"
+
+
+def test_hybridize_batchnorm_train_then_eval():
+    """Regression: cached-graph trace metadata must be per-(training,
+    signature) — BatchNorm state outputs exist only in training mode, so a
+    net hybridized and run in train mode then eval mode (or vice versa)
+    must not mis-slice outputs or corrupt running stats."""
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(6, in_units=4), nn.BatchNorm(), nn.Dense(2))
+        net.initialize()
+        net.hybridize()
+        return net
+
+    x = nd.array(np.random.randn(8, 4).astype("float32"))
+
+    # train first, then eval
+    net = build()
+    bn = net[1]
+    with ag.record():
+        out_t = net(x)
+        out_t.backward()
+    rm_after_train = bn.running_mean.data().asnumpy().copy()
+    assert np.abs(rm_after_train).sum() > 0      # stats did update
+    out_e = net(x)                               # eval: no state outputs
+    assert out_e.shape == (8, 2)
+    # running stats untouched by eval and NOT corrupted by net outputs
+    assert_almost_equal(bn.running_mean.data().asnumpy(), rm_after_train)
+
+    # eval first, then train
+    net2 = build()
+    bn2 = net2[1]
+    out_e2 = net2(x)
+    assert out_e2.shape == (8, 2)
+    assert np.abs(bn2.running_mean.data().asnumpy()).sum() == 0
+    with ag.record():
+        net2(x).backward()
+    # running stats must update on the training pass (not silently dropped)
+    assert np.abs(bn2.running_mean.data().asnumpy()).sum() > 0
+
+
+def test_batchnorm_state_updates_all_contexts():
+    """Regression: aux-state write-back must hit every per-context copy,
+    not just the first (multi-device running stats stayed divergent)."""
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize(ctx=ctxs)
+    x = nd.array(np.random.randn(4, 3, 5, 5).astype("float32"))
+    with ag.record():
+        bn(x)
+    rm0 = bn.running_mean.data(ctxs[0]).asnumpy()
+    rm1 = bn.running_mean.data(ctxs[1]).asnumpy()
+    assert np.abs(rm0).sum() > 0
+    assert_almost_equal(rm0, rm1)

@@ -96,3 +96,18 @@ def test_optimizer_states_roundtrip(tmp_path):
     store.push(0, nd.ones((2,)))
     store.save_optimizer_states(fname)
     store.load_optimizer_states(fname)
+
+
+def test_push_replaces_stored_value():
+    """Regression: reference semantics — push REPLACES the stored value
+    with the aggregate (init 2, push 8 → pull 8, not 10)."""
+    store = kv.create("local")
+    store.init("k", nd.ones((3,)) * 2)
+    store.push("k", nd.ones((3,)) * 8)
+    out = nd.zeros((3,))
+    store.pull("k", out=out)
+    assert_almost_equal(out, np.full((3,), 8.0))
+    # and again: aggregate of a list replaces, not accumulates
+    store.push("k", [nd.ones((3,)), nd.ones((3,)) * 4])
+    store.pull("k", out=out)
+    assert_almost_equal(out, np.full((3,), 5.0))

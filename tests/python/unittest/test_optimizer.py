@@ -144,3 +144,28 @@ def test_updater_states_roundtrip():
     upd2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
     upd2.set_states(blob)
     assert 0 in upd2.states
+
+
+def test_grad_buffer_survives_update():
+    """Regression: fused updates donate weight/state buffers but must NOT
+    donate the gradient — Parameter._grad still references it after
+    trainer.step() (on real TPU, where donation is enforced, reading a
+    donated buffer fails; grad_req='add' also accumulates into it)."""
+    from incubator_mxnet_tpu import optimizer as opt
+    w = nd.array(np.ones((4,), dtype="float32"))
+    g = nd.array(np.full((4,), 0.5, dtype="float32"))
+    sgd = opt.create("sgd", learning_rate=0.1, momentum=0.9)
+    state = sgd.create_state(0, w)
+    sgd.update(0, w, g, state)
+    # grad buffer must still be alive and unchanged
+    assert not g._data.is_deleted()
+    assert_almost_equal(g.asnumpy(), np.full((4,), 0.5))
+    # weight/state were updated through fresh (donated-input) buffers
+    assert_almost_equal(w.asnumpy(), np.full((4,), 1.0 - 0.1 * 0.5))
+    # adam path exercises 4-array donation layout
+    w2 = nd.array(np.ones((4,), dtype="float32"))
+    adam = opt.create("adam", learning_rate=0.1)
+    st = adam.create_state(0, w2)
+    adam.update(0, w2, g, st)
+    assert not g._data.is_deleted()
+    assert_almost_equal(g.asnumpy(), np.full((4,), 0.5))
