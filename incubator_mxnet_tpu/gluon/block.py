@@ -104,6 +104,37 @@ def _write_state_all_ctx(param, value):
 
 
 # ---------------------------------------------------------------------------
+# symbol tracing (HybridBlock.export / SymbolBlock round-trip)
+# ---------------------------------------------------------------------------
+
+class _SymbolTraceState(threading.local):
+    def __init__(self):
+        self.vars = None        # None or {param_name: Symbol var}
+
+
+_SYMTRACE = _SymbolTraceState()
+
+
+class _ShapePassState(threading.local):
+    def __init__(self):
+        self.active = False     # inside an abstract infer_shape pass
+
+
+_SHAPEPASS = _ShapePassState()
+
+
+def _param_symbol(param):
+    """Symbol variable for a Parameter; deduped per trace so shared
+    parameters map to ONE arg node in the exported graph."""
+    if _SYMTRACE.vars is not None and param.name in _SYMTRACE.vars:
+        return _SYMTRACE.vars[param.name]
+    v = param.var()
+    if _SYMTRACE.vars is not None:
+        _SYMTRACE.vars[param.name] = v
+    return v
+
+
+# ---------------------------------------------------------------------------
 # Block
 # ---------------------------------------------------------------------------
 
@@ -210,6 +241,9 @@ class Block:
                         dtype_source="current"):
         from .. import ndarray as nd
         loaded = nd.load(filename, ctx=ctx)
+        # reference checkpoints key arrays as "arg:name"/"aux:name"
+        loaded = {(k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
+                   else k): v for k, v in loaded.items()}
         params = self._collect_params_with_prefix()
         if not allow_missing:
             for name in params:
@@ -254,6 +288,15 @@ class Block:
 
 def _indent(s):
     return s.replace("\n", "\n  ")
+
+
+def _flat_symbols(out):
+    if isinstance(out, (list, tuple)):
+        flat = []
+        for o in out:
+            flat.extend(_flat_symbols(o))
+        return flat
+    return [out]
 
 
 # ---------------------------------------------------------------------------
@@ -491,12 +534,39 @@ class HybridBlock(Block):
                           static_shape=static_shape)
 
     def infer_shape(self, *args):
-        """Layer-specific deferred-shape hook (ref: HybridBlock's symbolic
-        _deferred_infer_shape; here each parametrised layer sets its own
-        param shapes from input shapes)."""
-        for child in self._children.values():
-            if isinstance(child, HybridBlock):
-                pass   # children infer when called
+        """Resolve deferred parameter shapes from input shapes WITHOUT
+        executing any compute (ref: HybridBlock's _deferred_infer_shape
+        runs symbolic InferShape; here the forward runs abstractly under
+        jax.eval_shape — XLA abstract eval IS the shape pass).
+
+        Parametrised leaf layers override this with a direct rule
+        (e.g. Dense sets weight from x.shape); this default drives the
+        whole composite: each child materialises its params when the
+        abstract trace reaches it."""
+        if _SHAPEPASS.active:
+            # re-entered from a leaf layer that has no shape rule while
+            # already inside the abstract pass: nothing more to infer
+            return
+        import jax
+        _SHAPEPASS.active = True
+        # swallow state updates (running stats) — values are tracers here
+        prev_state, _STATE.active = _STATE.active, []
+        # sandbox RNG: ops like Dropout split keys during the trace; the
+        # stateful per-ctx key must not be overwritten with a tracer
+        _rnd.push_trace_key(_rnd.KeyHolder(jax.random.PRNGKey(0)))
+        try:
+            def f(*ivals):
+                nd_in = [NDArray(v) for v in ivals]
+                with _ag.pause():
+                    self.forward(*nd_in)
+                return 0
+            jax.eval_shape(f, *[
+                jax.ShapeDtypeStruct(a.shape, a._data.dtype) if
+                isinstance(a, NDArray) else a for a in args])
+        finally:
+            _rnd.pop_trace_key()
+            _STATE.active = prev_state
+            _SHAPEPASS.active = False
 
     def _finish_deferred(self, *args):
         try:
@@ -505,6 +575,12 @@ class HybridBlock(Block):
             raise
         for p in self._reg_params.values():
             if p._deferred_init:
+                if _SHAPEPASS.active:
+                    # abstract pass: shapes are now known; real
+                    # initialization (RNG on concrete buffers) must not
+                    # run inside the eval_shape trace — it happens on
+                    # the first real forward / in __call__'s pre-pass
+                    continue
                 p._finish_deferred_init()
 
     def cast(self, dtype):
@@ -512,72 +588,150 @@ class HybridBlock(Block):
         super().cast(dtype)
 
     def __call__(self, *args, **kwargs):
+        from ..symbol.symbol import Symbol as _Sym
+        if args and isinstance(args[0], _Sym):
+            # symbol trace (export path): bypass the cached executable
+            return Block.__call__(self, *args, **kwargs)
         # _STATE.active is not None ⇔ some ancestor cached-op is tracing:
         # children must trace inline (ref: CachedOp inlines the whole
         # subgraph; nested CachedOps are not re-entered)
         if self._active and not kwargs and _STATE.active is None:
             if self._cached_graph is None:
-                # let any deferred params materialise with one imperative
-                # pass before tracing (ref: CachedOp created after first
-                # forward's shape inference)
+                # materialise deferred params before tracing (ref:
+                # CachedOp created after first forward's shape inference).
+                # Abstract pass first (no FLOPs); full imperative pass as
+                # fallback for forwards eval_shape can't abstract
                 try:
                     pd = self.collect_params()
                     deferred = any(p._deferred_init for p in pd.values())
                 except Exception:
                     deferred = False
                 if deferred:
-                    with _ag.pause():
-                        Block.__call__(self, *args)
+                    try:
+                        self.infer_shape(*args)
+                        for p in pd.values():
+                            if p._deferred_init:
+                                p._finish_deferred_init()
+                    except Exception:
+                        with _ag.pause():
+                            Block.__call__(self, *args)
                 self._cached_graph = _CachedGraph(self, self._flags)
             return self._cached_graph(list(args))
         return Block.__call__(self, *args, **kwargs)
 
     def forward(self, x, *args):
         """Gathers this block's params and calls hybrid_forward with the
-        `F` namespace (always the ndarray stubs here — tracing happens at
-        the jax level, so one code path serves both modes)."""
-        from .. import ndarray as F
-        try:
-            params = {k: p.data(x.context if isinstance(x, NDArray) else None)
+        `F` namespace: the ndarray stubs normally (tracing happens at the
+        jax level), or the symbol stubs when `x` is a Symbol (export
+        path — params become named variable nodes)."""
+        from ..symbol.symbol import Symbol as _Sym
+        if isinstance(x, _Sym):
+            from .. import symbol as F_sym
+            params = {k: _param_symbol(p)
                       for k, p in self._reg_params.items()}
+            return self.hybrid_forward(F_sym, x, *args, **params)
+        from .. import ndarray as F
+        ctx = x.context if isinstance(x, NDArray) else None
+
+        def _gather():
+            if _SHAPEPASS.active:
+                # abstract pass: deferred-but-shape-known params stand in
+                # as zeros tracers (values irrelevant, shapes flow)
+                import jax.numpy as jnp
+                out = {}
+                for k, p in self._reg_params.items():
+                    if p._data is None and p._deferred_init and \
+                            p._shape_known():
+                        out[k] = NDArray(jnp.zeros(tuple(p.shape), p.dtype))
+                    else:
+                        out[k] = p.data(ctx)
+                return out
+            return {k: p.data(ctx) for k, p in self._reg_params.items()}
+
+        try:
+            params = _gather()
         except DeferredInitializationError:
             self._finish_deferred(x, *args)
-            params = {k: p.data(x.context if isinstance(x, NDArray) else None)
-                      for k, p in self._reg_params.items()}
+            params = _gather()
         return self.hybrid_forward(F, x, *args, **params)
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
     def export(self, path, epoch=0, remove_amp_cast=True):
-        """ref: HybridBlock.export → model-symbol.json + params.  Here the
-        graph artifact is the StableHLO of the cached executable plus the
-        params file (SURVEY §5.4 TPU equiv)."""
-        import jax
-        params = self._collect_params_with_prefix()
+        """ref: HybridBlock.export → `path-symbol.json` + epoch params.
+
+        Traces `hybrid_forward` with Symbol inputs (inference mode) into a
+        portable graph over the shared op registry, writes its JSON, and
+        saves the parameters keyed by their symbol arg names — the two
+        artifacts `SymbolBlock.imports` reloads for identical prediction
+        (SURVEY §5.4).  Requires initialized parameters with known shapes
+        (call the block once first)."""
+        from .. import symbol as sym_ns
         from .. import ndarray as nd
+
+        pd = self.collect_params()
+        uninit = [p.name for p in pd.values()
+                  if p._data is None or not p._shape_known()]
+        if uninit:
+            raise MXNetError(
+                "export requires initialized parameters with known shapes "
+                "(run a forward pass first); missing: %s" % uninit)
+
+        # input arity: taken from the traced cache when available,
+        # else a single 'data' input
+        n_in = 1
+        if self._cached_graph is not None and self._cached_graph._raw:
+            n_in = next(iter(self._cached_graph._raw))[2]
+        in_names = ["data"] if n_in == 1 else \
+            ["data%d" % i for i in range(n_in)]
+        in_syms = [sym_ns.var(n) for n in in_names]
+
+        prev_vars, _SYMTRACE.vars = _SYMTRACE.vars, {}
+        prev_train = _ag.set_training(False)
+        try:
+            out = self(*in_syms)
+        finally:
+            _ag.set_training(prev_train)
+            _SYMTRACE.vars = prev_vars
+        if isinstance(out, (list, tuple)):
+            out = sym_ns.Group(_flat_symbols(out))
+
+        sym_file = "%s-symbol.json" % path
+        out.save(sym_file)
         nd.save("%s-%04d.params" % (path, epoch),
-                {k: v.data() for k, v in params.items()
-                 if v._data is not None})
-        if self._cached_graph is not None and self._cached_graph._jitted:
-            fn = next(iter(self._cached_graph._jitted.values()))
-            try:
-                lowered = getattr(fn, "lower", None)
-                if lowered:
-                    pass   # shapes needed; serialised HLO export is a
-                           # follow-up once Symbol json lands
-            except Exception:
-                pass
-        return "%s-symbol.json" % path
+                {p.name: p.data() for p in pd.values()
+                 if p._data is not None})
+        return sym_file
 
 
 class SymbolBlock(HybridBlock):
-    """ref: gluon.SymbolBlock — wrap a Symbol graph as a Block."""
+    """ref: gluon.SymbolBlock — wrap a Symbol graph as a Block.
+
+    Every non-input argument of the graph becomes a Parameter named by
+    its variable node (shape recovered from the exported `__shape__`
+    attr when present), so `load_parameters` on an `export()`ed params
+    file restores them by name."""
 
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix="symbolblock_", params=params)
+        from ..symbol.symbol import Symbol as _Sym, Group as _Group
+        if isinstance(outputs, (list, tuple)):
+            outputs = _Group(_flat_symbols(outputs))
+        if isinstance(inputs, _Sym):
+            inputs = [inputs]
         self._outputs = outputs
-        self._inputs = inputs
+        self._inputs = list(inputs)
+        input_names = {i.name for i in self._inputs}
+        arg_nodes = [n for n in outputs._topo() if n.op is None]
+        for node in arg_nodes:
+            if node.name in input_names or node.name in self._params:
+                continue
+            shape = node.attrs.get("__shape__")
+            p = Parameter(node.name,
+                          shape=tuple(shape) if shape is not None else None,
+                          allow_deferred_init=True)
+            self._params._params[node.name] = p
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
@@ -592,9 +746,34 @@ class SymbolBlock(HybridBlock):
                                   allow_missing=False, ignore_extra=True)
         return block
 
+    def _collect_params_with_prefix(self, prefix=""):
+        # graph params are keyed by their raw symbol arg names (export()'s
+        # params-file convention; load_parameters strips reference-style
+        # arg:/aux: key prefixes)
+        return dict(self._params.items())
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        super().load_parameters(filename, ctx=ctx,
+                                allow_missing=allow_missing,
+                                ignore_extra=ignore_extra,
+                                cast_dtype=cast_dtype,
+                                dtype_source=dtype_source)
+        # graph params start uninitialized, so the base missing-param
+        # check (which only fires for initialized params) cannot catch a
+        # file whose keys match nothing — fail loudly here instead of at
+        # the first forward
+        if not allow_missing:
+            missing = [p.name for p in self._params.values()
+                       if p._data is None]
+            if missing:
+                raise MXNetError(
+                    "SymbolBlock: params file %r left graph parameters "
+                    "unset: %s" % (filename, missing))
+
     def forward(self, *args):
         from ..symbol import _eval_symbol
-        feed = {str(i): a for i, a in zip(self._inputs, args)}
         feed = {i.name: a for i, a in zip(self._inputs, args)}
         pd = self.collect_params()
         for name, p in pd.items():

@@ -118,7 +118,10 @@ class Parameter:
 
     def _finish_init(self, initializer, ctx_list, default_init):
         import jax.numpy as jnp
-        self._data = OrderedDict()
+        # build into a local dict and assign atomically at the end: a
+        # failing initializer must not leave _data as a half-filled (or
+        # empty) dict that _check_initialized would accept
+        new_data = OrderedDict()
         for ctx in ctx_list:
             arr = NDArray(jnp.zeros(self._shape,
                                     _np.dtype(self.dtype)
@@ -130,7 +133,8 @@ class Parameter:
             chosen = initializer or self.init or default_init
             chosen = init_mod.create(chosen) if not callable(chosen) else chosen
             chosen(init_mod.InitDesc(self.name), arr)
-            self._data[ctx] = arr
+            new_data[ctx] = arr
+        self._data = new_data
         self._deferred_init = ()
         if self._grad_req != "null":
             self._init_grad()

@@ -90,6 +90,17 @@ class KVStore:
                               copy=True),
                     self._store[k].context.jax_device)
 
+    @staticmethod
+    def _copy_into(dst, src_data):
+        """Write `src_data` into `dst` as a FRESH buffer in dst's dtype.
+        Handing out an aliased buffer is fatal once the other alias is
+        donated (e.g. the in-store updater donates the stored weight on
+        the next push; same class of bug as push() storing the caller's
+        grad buffer)."""
+        dst._data = jax.device_put(
+            jnp.array(src_data, dtype=dst._data.dtype, copy=True),
+            dst.context.jax_device)
+
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize(key, out)
         for k, o in zip(keys, outs):
@@ -97,8 +108,7 @@ class KVStore:
                 raise MXNetError("key %r not initialised" % (k,))
             src = self._store[k]
             for dst in (o if _is_list(o) else [o]):
-                dst._data = jax.device_put(src._data,
-                                           dst.context.jax_device)
+                self._copy_into(dst, src._data)
 
     def pushpull(self, key, value, out=None, priority=0):
         """Fused allreduce (ref: KVStoreNCCL::PushPull — grouped
@@ -110,7 +120,7 @@ class KVStore:
         for k, v, o in zip(keys, values, outs):
             agg = self._reduce(v)
             for dst in (o if _is_list(o) else [o]):
-                dst._data = jax.device_put(agg._data, dst.context.jax_device)
+                self._copy_into(dst, agg._data)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the rows in `row_ids` (ref: sparse kvstore pull for

@@ -110,8 +110,17 @@ def apply_fn(fn, nd_args, kwargs, *, name="", differentiable=True,
 
 def invoke(opname, *args, **kwargs):
     """Invoke a registered operator imperatively (the generated-stub entry,
-    ref: python/mxnet/_ctypes/ndarray.py _imperative_invoke)."""
+    ref: python/mxnet/_ctypes/ndarray.py _imperative_invoke).
+
+    When any argument is a Symbol (export trace through a forward that
+    uses the ndarray namespace directly), composition is delegated to the
+    symbol front-end instead — one dispatch point makes every model
+    symbol-traceable."""
     od = _registry.get(opname)
+    from ..symbol.symbol import Symbol as _Sym, apply_stub_args
+    if any(isinstance(a, _Sym) for a in args) or \
+            any(isinstance(v, _Sym) for v in kwargs.values()):
+        return apply_stub_args(opname, args, kwargs)
     ctx = _resolve_ctx(args, kwargs)
     if od.needs_rng and "_rng_key" not in kwargs:
         kwargs["_rng_key"] = _rnd.split_key(ctx)

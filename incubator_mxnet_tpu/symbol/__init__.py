@@ -5,19 +5,14 @@ import sys as _sys
 
 from ..ops import registry as _registry
 from .symbol import (Symbol, var, Variable, Group, load, load_json,
-                     _eval_symbol, _apply)
+                     _eval_symbol, _apply, apply_stub_args)
 
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
 
 
 def _make_stub(opname):
     def stub(*args, **kwargs):
-        name = kwargs.pop("name", None)
-        sym_args = [a for a in args if isinstance(a, Symbol)]
-        attrs = {k: v for k, v in kwargs.items()
-                 if not isinstance(v, Symbol)}
-        sym_args += [v for v in kwargs.values() if isinstance(v, Symbol)]
-        return _apply(opname, sym_args, attrs, name=name)
+        return apply_stub_args(opname, args, kwargs)
     stub.__name__ = opname
     od = _registry.get(opname)
     stub.__doc__ = od.doc

@@ -402,6 +402,31 @@ def _apply(opname, inputs, attrs, name=None):
                   num_outputs=n_out if n_out > 0 else 1)
 
 
+def apply_stub_args(opname, args, kwargs):
+    """Shared stub-call → Symbol composition: split positional/keyword
+    Symbols from attribute params (single implementation for both the
+    sym namespace stubs and ndarray.invoke's symbol dispatch).
+
+    Mixing concrete arrays into a symbol composition is rejected — a
+    serialised graph cannot embed them, and silently dropping them
+    corrupts the exported model."""
+    from ..ndarray.ndarray import NDArray
+    kwargs = dict(kwargs)
+    name = kwargs.pop("name", None)
+    bad = [a for a in list(args) + list(kwargs.values())
+           if isinstance(a, NDArray)]
+    if bad:
+        raise MXNetError(
+            "op %s: cannot mix NDArray values into a Symbol composition "
+            "(use sym.var + feed, or a Parameter, for %d array operand(s))"
+            % (opname, len(bad)))
+    sym_args = [a for a in args if isinstance(a, Symbol)]
+    sym_args += [v for v in kwargs.values() if isinstance(v, Symbol)]
+    attrs = {k: v for k, v in kwargs.items()
+             if not isinstance(v, Symbol) and v is not None}
+    return _apply(opname, sym_args, attrs, name=name)
+
+
 def var(name, attr=None, shape=None, dtype=None, init=None, **kwargs):
     attrs = dict(attr or {})
     if shape is not None:
@@ -421,23 +446,28 @@ def Group(symbols):
 def load_json(json_str):
     data = json.loads(json_str)
     nodes = []
+
+    def pick_out(node, o):
+        # a multi-output node consumed as input must stay an output VIEW
+        # (even for output 0) or evaluation would feed the whole tuple
+        if node.num_outputs > 1 and node._out_index is None:
+            return node.outputs[o]
+        return node
+
     for spec in data["nodes"]:
         attrs = {k: _parse_attr(v) for k, v in
                  (spec.get("attrs") or {}).items()}
         if spec["op"] == "null":
             nodes.append(var(spec["name"], attr=attrs))
         else:
-            inputs = [nodes[i] if o == 0 else nodes[i].outputs[o]
-                      for i, o, _ in spec["inputs"]]
+            inputs = [pick_out(nodes[i], o) for i, o, _ in spec["inputs"]]
             nodes.append(_apply(spec["op"], inputs, attrs,
                                 name=spec["name"]))
     heads = data["heads"]
     if len(heads) == 1:
         i, o, _ = heads[0]
-        node = nodes[i]
-        return node if o == 0 else node.outputs[o]
-    return Group([nodes[i] if o == 0 else nodes[i].outputs[o]
-                  for i, o, _ in heads])
+        return pick_out(nodes[i], o)
+    return Group([pick_out(nodes[i], o) for i, o, _ in heads])
 
 
 def _parse_attr(v):

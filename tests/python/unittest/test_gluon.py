@@ -314,3 +314,44 @@ def test_batchnorm_state_updates_all_contexts():
     rm1 = bn.running_mean.data(ctxs[1]).asnumpy()
     assert np.abs(rm0).sum() > 0
     assert_almost_equal(rm0, rm1)
+
+
+def test_export_import_roundtrip(tmp_path):
+    """Regression: export() must actually WRITE the symbol json (it used
+    to return a filename it never wrote), and SymbolBlock.imports must
+    reload both artifacts and predict identically."""
+    import os
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.BatchNorm(), nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.randn(5, 8).astype("float32"))
+    ref_out = net(x).asnumpy()
+
+    path = str(tmp_path / "model")
+    sym_file = net.export(path, epoch=3)
+    assert os.path.exists(sym_file), "symbol json not written"
+    param_file = path + "-0003.params"
+    assert os.path.exists(param_file), "params file not written"
+
+    net2 = gluon.SymbolBlock.imports(sym_file, "data", param_file)
+    out2 = net2(x).asnumpy()
+    assert_almost_equal(ref_out, out2, rtol=1e-5, atol=1e-5)
+
+
+def test_export_requires_initialized():
+    net = nn.Dense(4)
+    net.initialize()    # deferred in_units: shape unknown until forward
+    with pytest.raises(Exception):
+        net.export("/tmp/should_not_exist")
+
+
+def test_infer_shape_no_compute():
+    """infer_shape resolves deferred param shapes abstractly (no forward
+    execution)."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    net.infer_shape(nd.ones((2, 8)))
+    assert net[0].weight.shape == (16, 8)
+    assert net[1].weight.shape == (4, 16)

@@ -111,3 +111,18 @@ def test_push_replaces_stored_value():
     store.push("k", [nd.ones((3,)), nd.ones((3,)) * 4])
     store.pull("k", out=out)
     assert_almost_equal(out, np.full((3,), 5.0))
+
+
+def test_pull_returns_fresh_buffer():
+    """Regression: pull must hand out a COPY — with a server-side
+    optimizer, the next push donates the stored weight buffer, which
+    killed previously pulled aliases on real TPU."""
+    from incubator_mxnet_tpu import optimizer as opt
+    store = kv.create("local")
+    store.set_optimizer(opt.create("sgd", learning_rate=0.1))
+    store.init("w", nd.ones((4,)))
+    out = nd.zeros((4,))
+    store.pull("w", out=out)
+    store.push("w", nd.ones((4,)))      # in-store update donates weight
+    assert not out._data.is_deleted()
+    assert_almost_equal(out, np.ones((4,)))
