@@ -126,6 +126,33 @@ def _is_float0(x):
     return getattr(x, "dtype", None) == jax.dtypes.float0
 
 
+# Zero/one cotangent constants are recreated every backward (one per
+# unused output — e.g. each BatchNorm's aux stats).  Each jnp.zeros is a
+# device dispatch; over a tunnelled link that dominates step time.  They
+# are immutable and never donated, so cache per (shape, dtype).
+_CONST_CACHE = {}
+
+
+def _zeros_const(shape, dtype):
+    import jax.numpy as jnp
+    key = ("z", tuple(shape), str(dtype))
+    v = _CONST_CACHE.get(key)
+    if v is None or v.is_deleted():
+        v = jnp.zeros(shape, dtype)
+        _CONST_CACHE[key] = v
+    return v
+
+
+def _ones_const(shape, dtype):
+    import jax.numpy as jnp
+    key = ("o", tuple(shape), str(dtype))
+    v = _CONST_CACHE.get(key)
+    if v is None or v.is_deleted():
+        v = jnp.ones(shape, dtype)
+        _CONST_CACHE[key] = v
+    return v
+
+
 def _requires_tracking(nd) -> bool:
     return nd is not None and (nd._tape_node is not None or
                                nd._grad_req not in (None, "null"))
@@ -190,7 +217,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
                 "cannot differentiate: output was not computed while "
                 "recording (is autograd.record() active?)")
         root_nodes.append(node)
-        g = jnp.ones(h.shape, h.dtype) if hg is None else hg._data
+        g = _ones_const(h.shape, h.dtype) if hg is None else hg._data
         key = (id(node), h._out_index)
         cot[key] = cot[key] + g if key in cot else g
 
@@ -216,7 +243,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
                     # integer/bool outputs take float0 cotangents
                     c = _np.zeros(node.out_shapes[i], jax.dtypes.float0)
                 else:
-                    c = jnp.zeros(node.out_shapes[i], dt)
+                    c = _zeros_const(node.out_shapes[i], dt)
             else:
                 any_c = True
             cots.append(c)

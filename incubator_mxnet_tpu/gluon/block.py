@@ -318,9 +318,10 @@ class _CachedGraph:
         self.flags = flags
         self.param_names = None     # ordered param names (stable)
         self.params = None          # ordered Parameter objects
-        self._jitted = {}           # fkey -> jitted forward
+        self._jitted = {}           # fkey -> jitted forward (inference)
         self._raw = {}              # fkey -> unjitted pure
-        self._jit_bwd = {}          # bwd key -> jitted backward
+        self._jit_fwdvjp = {}       # fkey -> jitted fwd returning vjp
+        self._jit_bwd_apply = None  # jitted residual-consuming backward
         # fkey -> (out_treedef, state_params): BatchNorm-style state
         # outputs exist only in training mode, so trace metadata MUST be
         # keyed by the same (training, np_, ni_) signature as the jitted
@@ -387,40 +388,29 @@ class _CachedGraph:
             return tuple(outs) + tuple(states)
         return pure_flat
 
-    def _get_bwd(self, training, np_, ni_, float_idx):
-        """Cached jitted backward: recomputes forward under jit (remat —
-        XLA buffer-shares what it can) and applies the transpose; ONE
-        compiled executable per (shape, training) signature, the
-        CachedOp::Backward equivalent.  Only float leaves (index list is
-        static per signature) are differentiated."""
+    def _get_fwd_vjp(self, training, np_, ni_):
+        """Jitted forward that ALSO returns the vjp residual closure (a
+        jax pytree of arrays).  Backward then consumes the residuals in
+        one executable with NO forward recompute — the
+        CachedOp::Forward/Backward pair sharing cached intermediates
+        (ref: cached_op.cc forward graph feeding the backward graph)."""
         import jax
-        key = (training, tuple(float_idx), np_, ni_)
-        if key in self._jit_bwd:
-            return self._jit_bwd[key]
+        fkey = (training, np_, ni_)
+        if fkey in self._jit_fwdvjp:
+            return self._jit_fwdvjp[fkey]
         pure_flat = self._get_flat(training, np_, ni_)
 
-        def bwd(float_leaves, other_leaves, cots):
-            # merge float/non-float back into positional order
-            def f(*fl):
-                leaves = list(other_leaves)
-                full = [None] * (len(fl) + len(other_leaves))
-                oi = 0
-                fi = 0
-                for i in range(len(full)):
-                    if i in key[1]:
-                        full[i] = fl[fi]; fi += 1
-                    else:
-                        full[i] = leaves[oi]; oi += 1
-                return pure_flat(*full)
-            _, vjp = jax.vjp(f, *float_leaves)
-            return vjp(cots)
-        self._jit_bwd[key] = jax.jit(bwd)
-        return self._jit_bwd[key]
+        def fwd(*leaves):
+            outs, vjp_fn = jax.vjp(pure_flat, *leaves)
+            return outs, vjp_fn
+        self._jit_fwdvjp[fkey] = jax.jit(fwd)
+        if self._jit_bwd_apply is None:
+            self._jit_bwd_apply = jax.jit(lambda v, cots: v(cots))
+        return self._jit_fwdvjp[fkey]
 
     def __call__(self, args):
         import jax
         import jax.numpy as jnp
-        import numpy as _np2
         if self.param_names is None:
             self._collect_params()
         training = _ag.is_training()
@@ -428,47 +418,39 @@ class _CachedGraph:
             else current_context()
 
         param_nds = [p.data(ctx) for p in self.params]
-        key_bits = jax.random.key_data(_rnd.split_key(ctx))
-        key_nd = NDArray(key_bits, ctx=ctx)
-        flat_inputs = list(param_nds) + list(args) + [key_nd]
+        # key bits derived host-side (zero device ops) and fed as a plain
+        # numpy jit input; the executable wraps them into a typed key
+        key_bits = _rnd.next_key_bits(ctx)
+        flat_inputs = list(param_nds) + list(args)
         np_, ni_ = len(param_nds), len(args)
 
         fkey = (training, np_, ni_)
-        if fkey not in self._jitted:
-            self._jitted[fkey] = jax.jit(
-                self._get_flat(training, np_, ni_))
-        fwd = self._jitted[fkey]
-
-        leaf_data = [a._data for a in flat_inputs]
+        record = _ag.is_recording() and any(
+            _ag._requires_tracking(a) for a in flat_inputs)
+        leaf_data = [a._data for a in flat_inputs] + [key_bits]
         from .. import engine as _engine
         with _engine._dispatch_hook(self.block.name + "_cachedop", ctx):
-            result = fwd(*leaf_data)
+            if record:
+                # forward keeps vjp residuals on device: backward is one
+                # executable, no forward recompute
+                result, vjp_closure = self._get_fwd_vjp(
+                    training, np_, ni_)(*leaf_data)
+            else:
+                if fkey not in self._jitted:
+                    self._jitted[fkey] = jax.jit(
+                        self._get_flat(training, np_, ni_))
+                result = self._jitted[fkey](*leaf_data)
         if _engine.naive_mode():
             for o in result:
                 o.block_until_ready()
         wrapped = tuple(NDArray(o, ctx=ctx) for o in result)
 
-        record = _ag.is_recording() and any(
-            _ag._requires_tracking(a) for a in flat_inputs)
         if record:
-            float_idx = tuple(
-                i for i, d in enumerate(leaf_data)
-                if jnp.issubdtype(d.dtype, jnp.inexact))
-            bwd = self._get_bwd(training, np_, ni_, float_idx)
-            floats = tuple(leaf_data[i] for i in float_idx)
-            others = tuple(d for i, d in enumerate(leaf_data)
-                           if i not in float_idx)
+            bwd_apply = self._jit_bwd_apply
 
             def vjp_fn(cots):
-                gf = bwd(floats, others, tuple(cots))
-                out = []
-                fi = 0
-                for i in range(len(leaf_data)):
-                    if i in float_idx:
-                        out.append(gf[fi]); fi += 1
-                    else:
-                        out.append(_np2.zeros((), jax.dtypes.float0))
-                return tuple(out)
+                # drop the trailing key-bits grad (float0)
+                return tuple(bwd_apply(vjp_closure, tuple(cots)))[:-1]
 
             _ag.record_op(vjp_fn, flat_inputs, wrapped,
                           name=self.block.name + "_cachedop",

@@ -147,6 +147,27 @@ class Trainer:
                 self._kvstore.push(i, param.list_grad())
                 self._kvstore.pull(i, param.list_data())
             return
+        if (len(self._contexts) == 1 and self._kvstore is None and
+                getattr(self._optimizer, "aggregatable", False) and
+                not self._optimizer.multi_precision):
+            # aggregated fast path: ONE executable updates every param
+            # (ref: multi_sgd_mom_update; cuts ~n-params dispatches to 1)
+            updater = self._updaters[0]
+            idxs, ws, gs, sts = [], [], [], []
+            for i, param in enumerate(self._params):
+                if param.grad_req == "null" or param._data is None:
+                    continue
+                if i not in updater.states:
+                    updater.states[i] = \
+                        self._optimizer.create_state_multi_precision(
+                            i, param.data())
+                idxs.append(i)
+                ws.append(param.data())
+                gs.append(param.grad())
+                sts.append(updater.states[i])
+            if idxs:
+                self._optimizer.update_multi(idxs, ws, gs, sts)
+            return
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:
                 continue

@@ -10,6 +10,8 @@ NCHW at the API for parity; XLA relayouts internally for the MXU.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as _np
@@ -260,6 +262,68 @@ def softmin(data, axis=-1, temperature=None, dtype=None):
 # ---------------------------------------------------------------------------
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train(x, g, b, axis, eps):
+    """Training BatchNorm core with a memory-exact custom vjp.
+
+    Plain autodiff of the f32-upcast formulation saves an f32 copy of
+    EVERY activation as a residual (2.5× the bf16 activation footprint —
+    OOMs ResNet-50 b128 on a 16G chip).  Here the residuals are only the
+    bf16 input + per-channel f32 stats; the backward recomputes x̂ on the
+    fly inside one fused executable — exactly the cuDNN BN training
+    kernel contract (save_mean/save_inv_var)."""
+    (out, _, _), _ = _bn_train_fwd(x, g, b, axis, eps)
+    return out
+
+
+def _bn_stats(x, axis):
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=red)
+    var = jnp.var(x32, axis=red)
+    return mean, var
+
+
+def _bn_train_fwd(x, g, b, axis, eps):
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    bshape = tuple(x.shape[axis] if i == axis else 1
+                   for i in range(x.ndim))
+    mean, var = _bn_stats(x, axis)
+    inv = lax.rsqrt(var + eps)
+    scale = (g.astype(jnp.float32) * inv).reshape(bshape)
+    shift = (b.astype(jnp.float32) -
+             mean * g.astype(jnp.float32) * inv).reshape(bshape)
+    # compute in the activation dtype: scale/shift are per-channel f32
+    # folded to x.dtype — no full-size f32 intermediate is ever live
+    out = x * scale.astype(x.dtype) + shift.astype(x.dtype)
+    return (out, mean, var), (x, g, mean, inv, red, bshape)
+
+
+def _bn_train_core_fwd(x, g, b, axis, eps):
+    (out, _, _), res = _bn_train_fwd(x, g, b, axis, eps)
+    return out, res
+
+
+def _bn_train_core_bwd(axis, eps, res, dy):
+    x, g, mean, inv, red, bshape = res
+    n = 1
+    for i in red:
+        n *= x.shape[i]
+    x32 = x.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    xhat = (x32 - mean.reshape(bshape)) * inv.reshape(bshape)
+    dbeta = jnp.sum(dy32, axis=red)
+    dgamma = jnp.sum(dy32 * xhat, axis=red)
+    m1 = (dbeta / n).reshape(bshape)
+    m2 = (dgamma / n).reshape(bshape)
+    dx = (g.astype(jnp.float32) * inv).reshape(bshape) * \
+        (dy32 - m1 - xhat * m2)
+    return dx.astype(x.dtype), dgamma.astype(g.dtype), dbeta.astype(g.dtype)
+
+
+_bn_train.defvjp(_bn_train_core_fwd, _bn_train_core_bwd)
+
+
 @register("BatchNorm",
           ndarray_inputs=("data", "gamma", "beta", "moving_mean",
                           "moving_var"),
@@ -274,25 +338,24 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     running stats (the reference mutates `moving_*` in-place inside the
     kernel; here mutation lives at the NDArray layer, keeping the body pure
     so it jits).  `fix_gamma=True` ⇒ gamma treated as 1 (reference default).
+    Batch statistics are auxiliary (non-differentiated) outputs, as in the
+    reference.
     """
-    red = tuple(i for i in range(data.ndim) if i != axis)
     bshape = tuple(data.shape[axis] if i == axis else 1
                    for i in range(data.ndim))
-    # amp: statistics always in f32 (the reference's BN stays fp32 under
-    # AMP); output returns in the activation dtype
-    x32 = data.astype(jnp.float32)
-    if _training and not use_global_stats:
-        mean = jnp.mean(x32, axis=red)
-        var = jnp.var(x32, axis=red)
-    else:
-        mean = moving_mean.astype(jnp.float32)
-        var = moving_var.astype(jnp.float32)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if _training and not use_global_stats:
+        out = _bn_train(data, g, beta, axis, eps)
+        mean, var = _bn_stats(lax.stop_gradient(data), axis)
+        return out, mean, var
+    mean = moving_mean.astype(jnp.float32)
+    var = moving_var.astype(jnp.float32)
     inv = lax.rsqrt(var + eps)
-    out = (x32 - mean.reshape(bshape)) * \
-        (g.astype(jnp.float32) * inv).reshape(bshape) \
-        + beta.astype(jnp.float32).reshape(bshape)
-    return out.astype(data.dtype), mean, var
+    scale = (g.astype(jnp.float32) * inv).reshape(bshape)
+    shift = (beta.astype(jnp.float32) - mean * g.astype(jnp.float32) *
+             inv).reshape(bshape)
+    out = data * scale.astype(data.dtype) + shift.astype(data.dtype)
+    return out, mean, var
 
 
 @register("LayerNorm", ndarray_inputs=("data", "gamma", "beta"))

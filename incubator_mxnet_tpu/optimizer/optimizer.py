@@ -71,6 +71,77 @@ def _zeros_state(weight):
 
 
 # ---------------------------------------------------------------------------
+# aggregated (multi-tensor) fused update
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _jit_multi_update(opname: str, static_kv: tuple, nparam: int,
+                      nstates: int):
+    """ONE executable updating every parameter (ref: multi_sgd_mom_update /
+    multi-tensor apply, src/operator/optimizer_op.cc).  Weights and states
+    are donated; grads are not.  Per-param lr/wd ride in as (n,) vectors so
+    schedules don't recompile."""
+    fn = _registry.get(opname).fn
+
+    def f(ws, gs, states, lrs, wds, scalars):
+        new_ws = []
+        new_states = tuple([] for _ in range(nstates))
+        for i in range(nparam):
+            sargs = tuple(states[j][i] for j in range(nstates))
+            out = fn(ws[i], gs[i], *sargs, lr=lrs[i], wd=wds[i],
+                     **scalars, **dict(static_kv))
+            if nstates:
+                new_ws.append(out[0])
+                for j in range(nstates):
+                    new_states[j].append(out[1 + j])
+            else:
+                new_ws.append(out)
+        return tuple(new_ws), tuple(tuple(s) for s in new_states)
+    return jax.jit(f, donate_argnums=(0, 2))
+
+
+_HYPER_CACHE = {}
+
+
+def _hyper_array(values):
+    """Device array of hypers (vector or scalar), cached by value — lr/wd
+    rarely change step-to-step and each jnp.asarray is a host→device
+    transfer."""
+    key = tuple(values) if isinstance(values, (list, tuple)) \
+        else float(values)
+    v = _HYPER_CACHE.get(key)
+    if v is None or v.is_deleted():
+        if len(_HYPER_CACHE) >= 512:
+            # bound the cache: per-step-unique keys (e.g. Adam's
+            # bias-corrected lr vector) would otherwise leak one device
+            # buffer per training step forever
+            _HYPER_CACHE.clear()
+        v = jnp.asarray(key, jnp.float32)
+        _HYPER_CACHE[key] = v
+    return v
+
+
+def _fused_multi(opname, weights, grads, state_cols, lr_list, wd_list,
+                 scalars, static):
+    """Run the aggregated update.  `state_cols`: one list per state slot
+    (e.g. adam: [means, vars]), each parallel to `weights`."""
+    jf = _jit_multi_update(opname, tuple(sorted(static.items())),
+                           len(weights), len(state_cols))
+    ws = tuple(w._data for w in weights)
+    gs = tuple(g._data for g in grads)
+    sts = tuple(tuple(s._data for s in col) for col in state_cols)
+    lrs = _hyper_array(lr_list)
+    wds = _hyper_array(wd_list)
+    scal = {k: _hyper_array(v) for k, v in scalars.items()}
+    new_ws, new_sts = jf(ws, gs, sts, lrs, wds, scal)
+    for w, nw in zip(weights, new_ws):
+        w._data = nw
+    for col, ncol in zip(state_cols, new_sts):
+        for s, ns in zip(col, ncol):
+            s._data = ns
+
+
+# ---------------------------------------------------------------------------
 # base class + registry
 # ---------------------------------------------------------------------------
 
@@ -190,6 +261,25 @@ class Optimizer:
         else:
             self.update(index, weight, grad, state)
 
+    # aggregated update: True on subclasses providing an update_multi
+    # that batches every parameter into one executable
+    aggregatable = False
+
+    def update_multi(self, indices, weights, grads, states):
+        """Update many parameters at once (ref: aggregate_num /
+        multi_sgd_* ops).  Default: per-param loop."""
+        for i, w, g, s in zip(indices, weights, grads, states):
+            self.update_multi_precision(i, w, g, s)
+
+    def _split_sparse(self, indices, weights, grads, states):
+        """Partition the batch into (dense positions, sparse positions) —
+        row_sparse grads take the per-param FComputeEx-style path."""
+        from ..ndarray.sparse import RowSparseNDArray
+        dense, sparse = [], []
+        for k, g in enumerate(grads):
+            (sparse if isinstance(g, RowSparseNDArray) else dense).append(k)
+        return dense, sparse
+
     def __repr__(self):
         return "%s(lr=%s)" % (self.__class__.__name__, self.lr)
 
@@ -239,6 +329,31 @@ class SGD(Optimizer):
             new_w, new_m = _fused("sgd_mom_update", (weight, grad, state),
                                   scal, static)
             weight._data, state._data = new_w, new_m
+
+    aggregatable = True
+
+    def update_multi(self, indices, weights, grads, states):
+        dense, sparse = self._split_sparse(indices, weights, grads, states)
+        for k in sparse:
+            self.update(indices[k], weights[k], grads[k], states[k])
+        if not dense:
+            return
+        for k in dense:
+            self._update_count(indices[k])
+        lrs = [self._get_lr(indices[k]) for k in dense]
+        wds = [self._get_wd(indices[k]) for k in dense]
+        scal = dict(rescale_grad=self.rescale_grad)
+        static = dict(clip_gradient=self.clip_gradient
+                      if self.clip_gradient is not None else -1.0)
+        ws = [weights[k] for k in dense]
+        gs = [grads[k] for k in dense]
+        if self.momentum == 0.0:
+            _fused_multi("sgd_update", ws, gs, [], lrs, wds, scal, static)
+        else:
+            scal["momentum"] = self.momentum
+            _fused_multi("sgd_mom_update", ws, gs,
+                         [[states[k] for k in dense]], lrs, wds, scal,
+                         static)
 
 
 @register
@@ -303,6 +418,33 @@ class Adam(Optimizer):
         new_w, new_m, new_v = _fused("adam_update",
                                      (weight, grad, mean, var), scal, static)
         weight._data, mean._data, var._data = new_w, new_m, new_v
+
+    aggregatable = True
+
+    def update_multi(self, indices, weights, grads, states):
+        dense, sparse = self._split_sparse(indices, weights, grads, states)
+        for k in sparse:
+            self.update(indices[k], weights[k], grads[k], states[k])
+        if not dense:
+            return
+        lrs = []
+        for k in dense:
+            self._update_count(indices[k])
+            t = self._index_update_count[indices[k]]
+            lrs.append(self._get_lr(indices[k]) *
+                       math.sqrt(1.0 - self.beta2 ** t) /
+                       (1.0 - self.beta1 ** t))
+        wds = [self._get_wd(indices[k]) for k in dense]
+        scal = dict(rescale_grad=self.rescale_grad, beta1=self.beta1,
+                    beta2=self.beta2, epsilon=self.epsilon)
+        static = dict(clip_gradient=self.clip_gradient
+                      if self.clip_gradient is not None else -1.0)
+        _fused_multi("adam_update",
+                     [weights[k] for k in dense],
+                     [grads[k] for k in dense],
+                     [[states[k][0] for k in dense],
+                      [states[k][1] for k in dense]],
+                     lrs, wds, scal, static)
 
 
 @register

@@ -29,8 +29,12 @@ def seed(seed_state: int, ctx="all"):
         if ctx == "all":
             _BASE_SEED = int(seed_state)
             _KEYS.clear()
+            _BITS_COUNTER.clear()
+            _CTX_SEED.clear()
         else:
             _KEYS[ctx] = jax.random.key(int(seed_state))
+            _BITS_COUNTER.pop(ctx, None)
+            _CTX_SEED[ctx] = int(seed_state)
 
 
 def _ctx_key(ctx: Context):
@@ -74,8 +78,35 @@ def pop_trace_key():
     return _TRACE_STATE.stack.pop()
 
 
+_BITS_COUNTER = {}  # ctx -> monotone draw counter (host-side)
+_CTX_SEED = {}      # ctx -> per-context seed override (seed(n, ctx=...))
+
+
+def next_key_bits(ctx: Context = None):
+    """Fresh threefry KEY DATA derived entirely on the host — zero device
+    ops.  A threefry key is 2×uint32 of arbitrary bits; (seed-mix, call
+    counter) gives each call an independent stream.  Used by hot paths
+    (cached-op executables) that feed the bits in as a jit input;
+    mx.random.seed resets the counter for reproducibility.
+
+    The mix uses crc32, not Python hash() — string hashing is salted
+    per process and would break cross-run reproducibility."""
+    import numpy as _np
+    import zlib
+    ctx = ctx or current_context()
+    with _LOCK:
+        c = _BITS_COUNTER.get(ctx, 0)
+        _BITS_COUNTER[ctx] = c + 1
+        seed_val = _CTX_SEED.get(ctx, _BASE_SEED)
+    mix = zlib.crc32(repr((ctx.device_type, ctx.device_id,
+                           seed_val)).encode()) & 0xFFFFFFFF
+    return _np.array([mix ^ ((c >> 32) & 0xFFFFFFFF), c & 0xFFFFFFFF],
+                     dtype=_np.uint32)
+
+
 def split_key(ctx: Context = None):
-    """Split the context's key; returns a fresh subkey for one op call."""
+    """Split the context's key; returns a fresh subkey for one op call.
+    (Hot paths avoid this device op entirely via `next_key_bits`.)"""
     if _TRACE_STATE.stack:
         return _TRACE_STATE.stack[-1].next()
     ctx = ctx or current_context()
