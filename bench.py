@@ -61,6 +61,50 @@ def run_cachedop(batch=128, warmup=3, iters=20):
     return batch * iters / (time.perf_counter() - t0)
 
 
+def run_bert(batch=8, seq=512, warmup=2, iters=8):
+    """North-star config 2: BERT-base MLM pretrain step, tokens/sec/chip.
+
+    Same user-facing path as config 1 (hybridize → CachedOp → Trainer);
+    attention runs the fused kernel (ops/attention.py).  Synthetic MLM:
+    predict the token ids at every position (dense CE over the vocab) —
+    same compute shape as a 100%-masked MLM step.
+    """
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, autograd as ag
+    from incubator_mxnet_tpu.models.transformer import bert_base
+
+    ctx = mx.gpu()
+    net = bert_base(dropout=0.0)
+    net.initialize(ctx=ctx)
+    net.hybridize(static_alloc=True, static_shape=True)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-4})
+    rs = np.random.RandomState(0)
+    tokens = nd.array(rs.randint(0, 30522, (batch, seq)).astype(np.int32),
+                      ctx=ctx, dtype="int32")
+    labels = nd.array(rs.randint(0, 30522, (batch, seq)).astype(np.float32),
+                      ctx=ctx)
+
+    def step():
+        with ag.record():
+            logits = net(tokens)
+            l = loss_fn(logits.reshape((batch * seq, -1)),
+                        labels.reshape((-1,)))
+            l.backward()
+        trainer.step(batch)
+
+    for _ in range(warmup):
+        step()
+    nd.waitall()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        step()
+    nd.waitall()
+    return batch * seq * iters / (time.perf_counter() - t0)
+
+
 def build_sharded_trainer(batch):
     import jax
     import jax.numpy as jnp
@@ -133,6 +177,12 @@ def main():
                  "sharded_trainer_batch": sbatch}
     except Exception as e:
         extra = {"sharded_trainer_error": str(e)[:120]}
+    try:
+        toks, bbatch = _try_batches(run_bert, (8, 4, 2))
+        extra.update({"bert_base_tokens_per_sec_per_chip": round(toks, 2),
+                      "bert_batch": bbatch, "bert_seq": 512})
+    except Exception as e:
+        extra["bert_error"] = str(e)[:120]
     print(json.dumps({
         "metric": "resnet50_v1b_train_images_per_sec_per_chip",
         "value": round(imgs, 2),

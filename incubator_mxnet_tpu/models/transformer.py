@@ -35,8 +35,19 @@ class MultiHeadAttention(HybridBlock):
 
     def forward(self, x, mask=None):
         from .. import ndarray as F
-        B, T, C = x.shape
+        from .. import autograd
         H = self._num_heads
+        # fused path: whole softmax(QK^T)V is one kernel (Pallas flash on
+        # TPU, fused XLA elsewhere — ops/attention.py); the score matrix
+        # never hits HBM.  Attention-prob dropout is only live while
+        # training, so inference fuses regardless of the dropout config.
+        # Shape-free on purpose: keeps the block symbol-traceable.
+        if mask is None and (self.dropout is None
+                             or not autograd.is_training()):
+            ctx = F._contrib_flash_attention(
+                self.query(x), self.key(x), self.value(x), num_heads=H)
+            return self.proj(ctx)
+        B, T, C = x.shape
         d = C // H
         q = self.query(x).reshape((B, T, H, d)).transpose((0, 2, 1, 3))
         k = self.key(x).reshape((B, T, H, d)).transpose((0, 2, 1, 3))

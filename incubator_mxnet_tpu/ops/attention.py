@@ -1,0 +1,314 @@
+"""Fused (flash) multi-head self-attention.
+
+TPU-native replacement for the reference's fused attention contrib ops
+(ref: src/operator/contrib/transformer.cc `interleaved_matmul_selfatt_qk`
+/ `_valatt`, which exist to keep the score matmul inside one kernel).
+Here the whole softmax(QK^T)V is ONE Pallas kernel using the online-
+softmax (flash) recurrence, so the T×T score matrix never hits HBM:
+
+  grid = (batch*heads, T/bq, T/bk), k-dimension innermost ("arbitrary"),
+  VMEM scratch carries (m, l, acc) across k blocks; outputs are written
+  on the last k step.  Forward also emits the log-sum-exp row statistics
+  so the backward pass can rebuild P = exp(S - lse) block-free in XLA
+  (one fused executable; dispatch cost matters more than HBM here, see
+  PROFILE.md).
+
+Fallback: plain jnp einsum-softmax path (identical math) when not on a
+TPU backend, when shapes don't tile (T % block != 0), or when
+MXNET_USE_PALLAS=0.  MXNET_PALLAS_INTERPRET=1 forces the Pallas kernel
+in interpreter mode so the CPU test suite exercises the real kernel.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _PALLAS_OK = True
+except Exception:                                       # pragma: no cover
+    pl = pltpu = None
+    _PALLAS_OK = False
+
+__all__ = ["flash_attention", "naive_attention"]
+
+_NEG_INF = -1e30
+
+
+def _largest_divisor(T, cap):
+    """Largest divisor of T that is ≤ cap and a multiple of 8 (TPU
+    sublane), or T itself if T ≤ cap."""
+    if T <= cap:
+        return T
+    for b in range(cap, 7, -1):
+        if T % b == 0 and b % 8 == 0:
+            return b
+    return 0
+
+
+def _block_sizes(T):
+    """Measured on this chip (PROFILE.md): per-grid-step overhead is
+    ~0.1–0.3 ms, so fewer+bigger blocks win.  Defaults keep the f32
+    score block ≤ 8 MB of VMEM."""
+    bq = int(os.environ.get("MXNET_FLASH_BLOCK_Q", "0")) \
+        or _largest_divisor(T, 1024)
+    bk = int(os.environ.get("MXNET_FLASH_BLOCK_K", "0")) \
+        or _largest_divisor(T, max(128, (2 * 1024 * 1024) // max(bq, 1)))
+    return min(bq, T), min(bk, T)
+
+
+def _interpret():
+    return os.environ.get("MXNET_PALLAS_INTERPRET", "0") == "1"
+
+
+def _tiles_ok(T, d):
+    bq, bk = _block_sizes(T)
+    return (bq and bk and T % bq == 0 and T % bk == 0
+            and (bq % 8 == 0 or bq == T) and (bk % 8 == 0 or bk == T))
+
+
+def _pallas_enabled(BH, T, d):
+    """Dispatch policy, measured on this chip (see PROFILE.md):
+    the one-fused-XLA-program path is HBM-roofline-bound and faster up
+    to ~T=4096, but its B·H·T·T f32 score matrix stops compiling well
+    before T=8192; the Pallas kernel streams k/v blocks through VMEM
+    and keeps working.  MXNET_USE_PALLAS: 0=never, 1=auto (score bytes
+    > MXNET_FLASH_AUTO_BYTES), 2=always."""
+    mode = os.environ.get("MXNET_USE_PALLAS", "1")
+    if mode == "0" or not _PALLAS_OK:
+        return False
+    if not _tiles_ok(T, d):
+        return False
+    if _interpret():
+        return True
+    if jax.default_backend() != "tpu" or d > 256:
+        return False
+    if mode == "2":
+        return True
+    auto_bytes = float(os.environ.get("MXNET_FLASH_AUTO_BYTES", 4e9))
+    return BH * T * T * 4.0 > auto_bytes
+
+
+# ---------------------------------------------------------------------------
+# naive (XLA) reference path — also the backward building block
+# ---------------------------------------------------------------------------
+
+def naive_attention(q, k, v, scale, causal=False, bias=None):
+    """softmax(q k^T * scale [+ bias]) v over (..., T, d) operands."""
+    f32 = jnp.float32
+    s = jnp.einsum("...qd,...kd->...qk", q.astype(f32), k.astype(f32))
+    s = s * scale
+    if bias is not None:
+        s = s + bias.astype(f32)
+    if causal:
+        T, S = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((T, S), bool))
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p.astype(q.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash kernel (forward)
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
+                scale, causal, bq, bk):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_s[:] = jnp.full(m_s.shape, _NEG_INF, m_s.dtype)
+        l_s[:] = jnp.zeros(l_s.shape, l_s.dtype)
+        acc_s[:] = jnp.zeros(acc_s.shape, acc_s.dtype)
+
+    # causal: skip k blocks strictly above the diagonal band
+    should_run = (ik * bk <= iq * bq + (bq - 1)) if causal else (ik >= 0)
+
+    @pl.when(should_run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale        # (bq, bk)
+        if causal:
+            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_prev = m_s[:, :1]                                    # (bq, 1)
+        l_prev = l_s[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                                 # (bq, bk) f32
+        alpha = jnp.exp(m_prev - m_new)                        # (bq, 1)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # (bq, d)
+        acc_s[:] = acc_s[:] * alpha + pv
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[:] = jnp.broadcast_to(l_new, l_s.shape)
+
+    @pl.when(ik == nk - 1)
+    def _done():
+        o_ref[0] = (acc_s[:] / l_s[:, :1]).astype(o_ref.dtype)
+        # lse replicated across the 128 lanes (TPU tiling needs a full
+        # lane-dim block; caller slices [..., 0])
+        lse_ref[0] = m_s[:] + jnp.log(l_s[:])
+
+
+def _flash_fwd(q, k, v, scale, causal):
+    """q,k,v: (BH, T, d) → out (BH, T, d), lse (BH, T) f32."""
+    BH, T, d = q.shape
+    bq, bk = _block_sizes(T)
+    grid = (BH, T // bq, T // bk)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# custom VJP: pallas forward, fused-XLA backward from lse residuals
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention(q, k, v, scale, causal):
+    out, _ = _flash_fwd(q, k, v, scale, causal)
+    return out
+
+
+def _flash_attention_fwd(q, k, v, scale, causal):
+    out, lse = _flash_fwd(q, k, v, scale, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_attention_bwd(scale, causal, res, do):
+    """Backward from the saved lse row statistics: P = exp(S - lse)
+    rebuilt blockwise.  One XLA program; a `lax.scan` over k blocks
+    bounds the live score slab to MXNET_FLASH_BWD_BYTES (the grid-step
+    overhead that hurts the Pallas forward does not apply to scan
+    iterations inside a single program)."""
+    q, k, v, out, lse = res
+    BH, T, d = q.shape
+    f32 = jnp.float32
+    qf, kf, vf, dof = (t.astype(f32) for t in (q, k, v, do))
+    D = jnp.sum(dof * out.astype(f32), axis=-1, keepdims=True)  # (BH, T, 1)
+
+    limit = float(os.environ.get("MXNET_FLASH_BWD_BYTES", 5e8))
+    bk = T
+    while BH * T * bk * 4.0 > limit and bk % 2 == 0:
+        bk //= 2
+    nk = T // bk
+
+    def block_grads(kb, vb, k0):
+        s = jnp.einsum("bqd,bkd->bqk", qf, kb) * scale
+        if causal:
+            qpos = jnp.arange(T)[:, None]
+            kpos = k0 + jnp.arange(bk)[None, :]
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])                         # (BH, T, bk)
+        dvb = jnp.einsum("bqk,bqd->bkd", p, dof)
+        dp = jnp.einsum("bqd,bkd->bqk", dof, vb)
+        ds = p * (dp - D) * scale
+        dq_part = jnp.einsum("bqk,bkd->bqd", ds, kb)
+        dkb = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        return dq_part, dkb, dvb
+
+    if nk == 1:
+        dq, dk, dv = block_grads(kf, vf, 0)
+    else:
+        def body(dq, ik):
+            k0 = ik * bk
+            kb = jax.lax.dynamic_slice_in_dim(kf, k0, bk, axis=1)
+            vb = jax.lax.dynamic_slice_in_dim(vf, k0, bk, axis=1)
+            dq_part, dkb, dvb = block_grads(kb, vb, k0)
+            return dq + dq_part, (dkb, dvb)
+
+        dq, (dks, dvs) = jax.lax.scan(body, jnp.zeros_like(qf),
+                                      jnp.arange(nk))
+        dk = dks.transpose(1, 0, 2, 3).reshape(BH, T, d)
+        dv = dvs.transpose(1, 0, 2, 3).reshape(BH, T, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def flash_attention(q, k, v, scale=None, causal=False, bias=None):
+    """Fused attention over (B, H, T, d) operands (any leading batch dims
+    folded by the caller).  Returns (B, H, T, d)."""
+    *lead, T, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    BH = 1
+    for n in lead:
+        BH *= n
+    if bias is None and _pallas_enabled(BH, T, d):
+        q3 = q.reshape(BH, T, d)
+        k3 = k.reshape(BH, T, d)
+        v3 = v.reshape(BH, T, d)
+        out = _flash_attention(q3, k3, v3, float(scale), bool(causal))
+        return out.reshape(*lead, T, d)
+    return naive_attention(q, k, v, scale, causal=causal, bias=bias)
+
+
+# ---------------------------------------------------------------------------
+# registry entry: (B, T, C) projected q/k/v, heads handled inside
+# ---------------------------------------------------------------------------
+
+@register("_contrib_flash_attention",
+          ndarray_inputs=("query", "key", "value"))
+def _contrib_flash_attention(query, key, value, num_heads=1, scale=None,
+                             causal=False):
+    """Fused multi-head attention core: softmax(QK^T/sqrt(d))V.
+
+    query/key/value: (B, T, C) post-projection activations; C = H*d.
+    Returns (B, T, C).  Pallas flash kernel on TPU, fused XLA fallback
+    elsewhere (ref: contrib interleaved_matmul_* fused attention ops,
+    src/operator/contrib/transformer.cc).
+    """
+    B, T, C = query.shape
+    H = int(num_heads)
+    d = C // H
+
+    def split(x):
+        return x.reshape(B, T, H, d).transpose(0, 2, 1, 3)
+
+    out = flash_attention(split(query), split(key), split(value),
+                          scale=scale, causal=causal)
+    return out.transpose(0, 2, 1, 3).reshape(B, T, C)

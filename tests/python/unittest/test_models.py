@@ -1,0 +1,65 @@
+"""Model-level convergence smokes (ref: tests/python/train/ — small
+end-to-end training with an accuracy/loss threshold)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon, autograd as ag
+
+
+def test_bert_mlm_convergence_smoke():
+    """Tiny BERT overfits a fixed batch: MLM loss must drop sharply.
+    (ref model: BASELINE config 2, BERT-base MLM pretrain.)"""
+    from incubator_mxnet_tpu.models.transformer import bert_small
+    vocab = 64
+    net = bert_small(vocab_size=vocab, units=32, hidden_size=64,
+                     num_layers=2, num_heads=4, max_length=16, dropout=0.0)
+    net.initialize()
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    rs = np.random.RandomState(0)
+    B, T = 4, 16
+    tokens = nd.array(rs.randint(0, vocab, (B, T)).astype(np.int32),
+                      dtype="int32")
+    labels = nd.array(rs.randint(0, vocab, (B, T)).astype(np.float32))
+
+    losses = []
+    for _ in range(60):
+        with ag.record():
+            logits = net(tokens)
+            l = loss_fn(logits.reshape((B * T, -1)), labels.reshape((-1,)))
+            l.backward()
+        trainer.step(B)
+        losses.append(float(l.asnumpy().mean()))
+    assert losses[-1] < losses[0] * 0.5, \
+        "MLM loss did not converge: %s -> %s" % (losses[0], losses[-1])
+
+
+def test_resnet_classification_convergence_smoke():
+    """8-class toy images; resnet18 trains above chance quickly
+    (ref: tests/python/train/test_conv.py MNIST convergence smoke)."""
+    from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+    net = resnet18_v1(classes=8)
+    net.initialize()
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    rs = np.random.RandomState(1)
+    B = 16
+    # separable data: class k has mean k in channel 0
+    y = rs.randint(0, 8, B)
+    x = rs.randn(B, 3, 32, 32).astype(np.float32) * 0.1
+    x[:, 0] += y[:, None, None]
+    xb, yb = nd.array(x), nd.array(y.astype(np.float32))
+    first = None
+    for i in range(25):
+        with ag.record():
+            l = loss_fn(net(xb), yb)
+            l.backward()
+        trainer.step(B)
+        if first is None:
+            first = float(l.asnumpy().mean())
+    last = float(l.asnumpy().mean())
+    assert last < first * 0.5, (first, last)
