@@ -157,34 +157,34 @@ def bipartite_matching(data, threshold=0.5, is_ascend=False, topk=-1):
     """ref: bounding_box.cc bipartite_matching — greedy row/col matching
     on a (B, N, M) score matrix."""
     B, N, M = data.shape
-    score = data if not is_ascend else -data
-    K = N if topk <= 0 else min(topk, N)
+    score = -data if is_ascend else data          # always maximize
+    K = min(N, M) if topk <= 0 else min(topk, N, M)
+    ar = jnp.arange(B)
 
     def step(carry, _):
-        s, row_match, col_used = carry
+        s, row_match, col_match = carry
         flat = s.reshape(B, N * M)
         idx = jnp.argmax(flat, axis=1)
         best = jnp.take_along_axis(flat, idx[:, None], axis=1)[:, 0]
         r = idx // M
         c = idx % M
-        ok = best > (threshold if not is_ascend else -threshold)
-        row_match = jnp.where(
-            ok, row_match.at[jnp.arange(B), r].set(
-                jnp.where(ok, c, row_match[jnp.arange(B), r])), row_match)
-        col_used = col_used.at[jnp.arange(B), c].set(
-            col_used[jnp.arange(B), c] | ok)
-        s = s.at[jnp.arange(B), r, :].set(-jnp.inf)
-        s = jnp.where(ok[:, None, None] &
-                      (jnp.arange(M)[None, None, :] == c[:, None, None]),
-                      -jnp.inf, s)
-        return (s, row_match, col_used), None
+        orig = -best if is_ascend else best       # user-scale score
+        ok = (orig < threshold) if is_ascend else (orig > threshold)
+        row_match = row_match.at[ar, r].set(
+            jnp.where(ok, c.astype(jnp.int32), row_match[ar, r]))
+        col_match = col_match.at[ar, c].set(
+            jnp.where(ok, r.astype(jnp.int32), col_match[ar, c]))
+        rmask = jnp.arange(N)[None, :] == r[:, None]
+        cmask = jnp.arange(M)[None, :] == c[:, None]
+        blank = rmask[:, :, None] | cmask[:, None, :]
+        s = jnp.where(ok[:, None, None] & blank, -jnp.inf, s)
+        return (s, row_match, col_match), None
 
-    init = (jnp.where(score > -jnp.inf, score, score),
+    init = (score,
             jnp.full((B, N), -1, jnp.int32),
-            jnp.zeros((B, M), bool))
-    (s, row_match, _), _ = lax.scan(step, init, None, length=K)
-    cmatch = jnp.full((B, M), -1, jnp.int32)
-    return row_match.astype(jnp.float32), cmatch.astype(jnp.float32)
+            jnp.full((B, M), -1, jnp.int32))
+    (_, row_match, col_match), _ = lax.scan(step, init, None, length=K)
+    return row_match.astype(jnp.float32), col_match.astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------

@@ -158,8 +158,19 @@ def _shuffle(data, _rng_key=None):
 @register("_sample_unique_zipfian", ndarray_inputs=(), differentiable=False,
           needs_rng=True)
 def _sample_unique_zipfian(range_max=1, shape=(), _rng_key=None):
-    """ref: src/operator/random/unique_sample_op.cc (log-uniform candidate
-    sampler for sampled softmax). Approximate: zipfian draws w/o dedup."""
-    u = jax.random.uniform(_rng_key, tuple(shape))
-    out = jnp.exp(u * jnp.log(float(range_max) + 1.0)) - 1.0
-    return out.astype(jnp.int64)
+    """ref: src/operator/random/unique_sample_op.cc — log-uniform
+    (zipfian) candidate sampler for sampled softmax, WITHOUT replacement:
+    p(k) ∝ log(1 + 1/(k+1)); drawn per leading row via weighted
+    choice(replace=False)."""
+    shape = tuple(shape)
+    n = shape[-1] if shape else 1
+    lead = 1
+    for s in shape[:-1]:
+        lead *= s
+    k = jnp.arange(int(range_max))
+    p = jnp.log1p(1.0 / (k + 1.0))
+    p = p / jnp.sum(p)
+    keys = jax.random.split(_rng_key, lead)
+    rows = jax.vmap(lambda key: jax.random.choice(
+        key, int(range_max), shape=(n,), replace=False, p=p))(keys)
+    return rows.reshape(shape).astype(jnp.int64)

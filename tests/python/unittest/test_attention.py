@@ -121,11 +121,23 @@ def test_contrib_op_ndarray_surface():
     assert np.abs(q.grad.asnumpy()).sum() > 0
 
 
-def test_mha_block_uses_fused_path():
-    from incubator_mxnet_tpu.models.transformer import MultiHeadAttention
-    blk = MultiHeadAttention(32, 4, dropout=0.0)
+def test_mha_block_uses_fused_path(monkeypatch):
+    from incubator_mxnet_tpu.models import transformer
+    from incubator_mxnet_tpu.ops import registry
+
+    calls = []
+    od = registry.get("_contrib_flash_attention")
+    orig = od.fn
+
+    def counting(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(od, "fn", counting)
+    blk = transformer.MultiHeadAttention(32, 4, dropout=0.0)
     blk.initialize()
     x = nd.array(np.random.RandomState(0).randn(2, 16, 32)
                  .astype(np.float32))
     out = blk(x)
     assert out.shape == (2, 16, 32)
+    assert calls, "MultiHeadAttention did not dispatch the fused op"
