@@ -12,7 +12,10 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
-from .base import MXNetError, MXTPUError
+from .base import MXNetError, MXTPUError, ensure_jax_distributed
+# distributed workers (DMLC_* env set) must join the coordination
+# service before the first XLA backend touch anywhere below
+ensure_jax_distributed()
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, cpu_shared,
                       current_context, num_gpus, num_tpus)
 from . import engine

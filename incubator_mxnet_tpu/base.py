@@ -10,7 +10,38 @@ from __future__ import annotations
 import numpy as _np
 
 __all__ = ["MXNetError", "MXTPUError", "string_types", "numeric_types",
-           "integer_types", "dtype_np", "dtype_name", "DTYPE_ALIASES"]
+           "integer_types", "dtype_np", "dtype_name", "DTYPE_ALIASES",
+           "ensure_jax_distributed"]
+
+
+def ensure_jax_distributed():
+    """Bootstrap jax.distributed from the reference's DMLC_* cluster env
+    (ref: src/kvstore/kvstore.cc reading DMLC_ROLE/DMLC_PS_ROOT_URI/...;
+    ps-lite Postoffice::Start).  Must run before the first XLA backend
+    touch, so the package __init__ calls this before anything else when
+    the env marks the process as a distributed worker.  The scheduler
+    role does not exist here: the jax coordination service plays it,
+    hosted by worker 0."""
+    import os
+    import jax
+    nworkers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if nworkers <= 1:
+        return
+    if os.environ.get("DMLC_ROLE", "worker") != "worker":
+        # server/scheduler roles have no analogue here (the coordination
+        # service replaces them, ref kvstore.cc role dispatch) — joining
+        # as a worker would collide with a real rank
+        return
+    if getattr(ensure_jax_distributed, "_done", False):
+        return
+    uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = os.environ.get("DMLC_PS_ROOT_PORT", "9000")
+    rank = int(os.environ.get("DMLC_WORKER_ID",
+                              os.environ.get("DMLC_RANK", "0")))
+    jax.distributed.initialize(
+        coordinator_address="%s:%s" % (uri, port),
+        num_processes=nworkers, process_id=rank)
+    ensure_jax_distributed._done = True
 
 
 class MXNetError(RuntimeError):

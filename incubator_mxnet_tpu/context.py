@@ -62,17 +62,18 @@ class Context:
     def jax_device(self):
         """Resolve to a concrete jax.Device (raises if absent)."""
         import jax
+        # Always bind to PROCESS-LOCAL devices: under jax.distributed
+        # (dist kvstore workers) jax.devices() is the GLOBAL list and
+        # indexing it would hand out other workers' non-addressable
+        # devices (ref: each MXNet worker process owns only its own GPUs).
         if self.device_type == "cpu":
-            devs = jax.devices("cpu") if jax.default_backend() != "cpu" \
-                else jax.devices()
+            devs = jax.local_devices(backend="cpu") \
+                if jax.default_backend() != "cpu" else jax.local_devices()
         else:
-            if jax.default_backend() == "cpu":
-                # Virtual-mesh testing: accelerator contexts fall back to
-                # host devices so the same test corpus runs everywhere
-                # (ref test strategy: tests/python/gpu reruns the CPU corpus).
-                devs = jax.devices()
-            else:
-                devs = jax.devices()
+            # Virtual-mesh testing: accelerator contexts fall back to
+            # host devices so the same test corpus runs everywhere
+            # (ref test strategy: tests/python/gpu reruns the CPU corpus).
+            devs = jax.local_devices()
         if self.device_id >= len(devs):
             raise MXNetError(
                 "context %r: device id %d out of range (%d devices)"

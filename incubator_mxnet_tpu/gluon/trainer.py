@@ -79,8 +79,11 @@ class Trainer:
                           for _ in self._contexts]
 
     def _init_kvstore(self):
-        if self._kvstore_type is None or len(self._contexts) <= 1:
-            # single device: updates run locally, no store needed
+        is_dist = isinstance(self._kvstore_type, str) and \
+            self._kvstore_type.startswith("dist")
+        if self._kvstore_type is None or \
+                (len(self._contexts) <= 1 and not is_dist):
+            # single device, single process: updates run locally
             self._kvstore = None
             self._update_on_kvstore = False
         else:
@@ -122,7 +125,12 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
-        self.allreduce_grads()
+        if not self._update_on_kvstore:
+            # update_on_kvstore: update() pushes raw grads and pulls
+            # weights — aggregation happens IN the store; a prior
+            # allreduce would double-count by num_workers (ref:
+            # Trainer.step's _allreduce_grads/_update split)
+            self.allreduce_grads()
         self.update(batch_size, ignore_stale_grad)
 
     def allreduce_grads(self):

@@ -15,8 +15,13 @@ Types accepted for `create(name)`:
   local/device/nccl — in-process reduction over per-device copies; on a
       multi-chip mesh the reduce lowers to an ICI all-reduce.
   dist_sync/dist_async/dist_sync_device — multi-host (jax.distributed)
-      data-parallel; in a single-process run they behave as `local` with
-      num_workers=1 (the multi-process path arrives with the DCN slice).
+      data-parallel: DistKVStore below; workers join the coordination
+      service from the DMLC_* env (base.ensure_jax_distributed), the
+      aggregate is a cross-process sum, optional 2-bit compression with
+      error feedback rides the wire payload.  Single-process runs behave
+      as `local` with num_workers=1 (honest fallback).  Multi-node is
+      faked as multi-process-on-localhost in tests, the reference's own
+      strategy (tests/nightly/dist_sync_kvstore.py).
 """
 from __future__ import annotations
 
@@ -30,7 +35,7 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from ..optimizer import Optimizer, get_updater
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "DistKVStore", "create"]
 
 
 def _is_list(x):
@@ -48,13 +53,16 @@ class KVStore:
         self._compression = {}
 
     # ------------------------------------------------------------------
+    def _is_dist(self):
+        return self.type.startswith("dist") or self.type == "p3store_dist"
+
     @property
     def rank(self) -> int:
-        return jax.process_index() if self.type.startswith("dist") else 0
+        return jax.process_index() if self._is_dist() else 0
 
     @property
     def num_workers(self) -> int:
-        return jax.process_count() if self.type.startswith("dist") else 1
+        return jax.process_count() if self._is_dist() else 1
 
     # ------------------------------------------------------------------
     def init(self, key, value):
@@ -142,9 +150,14 @@ class KVStore:
         self._updater = get_updater(optimizer)
 
     def set_gradient_compression(self, compression_params):
-        """ref: gradient_compression.h 2-bit quantisation. Recorded; the
-        DCN payload-compression path lands with multi-host support."""
-        self._compression = dict(compression_params)
+        """ref: gradient_compression.h 2-bit quantisation.  Only the
+        dist kvstores transfer payloads over a wire, so only they can
+        compress — matching the reference, which ties compression to the
+        ps-lite push path.  No silent no-op: the local store refuses."""
+        raise MXNetError(
+            "gradient compression requires a dist kvstore "
+            "(create('dist_sync')); %r is in-process and transfers "
+            "nothing to compress" % self.type)
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
@@ -199,6 +212,127 @@ class KVStore:
         return NDArray(acc, ctx=v[0].context)
 
 
+# ---------------------------------------------------------------------------
+# multi-process (DCN) kvstore
+# ---------------------------------------------------------------------------
+
+
+from ..base import ensure_jax_distributed as _ensure_jax_distributed
+
+
+def _quantize_2bit(g, residual, threshold):
+    """ref: gradient_compression.cu Quantize2BitKernel — map each grad
+    element (+ carried residual) to {-threshold, 0, +threshold}; the
+    quantisation error stays in `residual` (error feedback)."""
+    x = g + residual
+    q = jnp.where(x >= threshold, threshold,
+                  jnp.where(x <= -threshold, -threshold, 0.0)) \
+        .astype(g.dtype)
+    return q, x - q
+
+
+class DistKVStore(KVStore):
+    """Multi-host data-parallel store: every worker pushes its local
+    gradient, the aggregate is the sum over ALL workers (allreduce over
+    DCN via the jax coordination/collective layer), every worker pulls
+    the same value (ref: kvstore_dist.h + kvstore_dist_server.h
+    sync aggregation counting num_workers pushes)."""
+
+    def __init__(self, kv_type="dist_sync"):
+        super().__init__(kv_type)
+        _ensure_jax_distributed()
+        self._residuals: Dict = {}
+
+    # -- cross-process primitives --------------------------------------
+    def _allreduce_sum(self, data):
+        if self.num_workers == 1:
+            return data
+        from jax.experimental import multihost_utils
+        import numpy as _np
+        gathered = multihost_utils.process_allgather(_np.asarray(data))
+        return jnp.asarray(_np.sum(gathered, axis=0, dtype=_np.float64)
+                           .astype(_np.asarray(data).dtype))
+
+    def _bcast_from_root(self, data):
+        if self.num_workers == 1:
+            return data
+        from jax.experimental import multihost_utils
+        import numpy as _np
+        return jnp.asarray(multihost_utils.broadcast_one_to_all(
+            _np.asarray(data)))
+
+    def _barrier(self):
+        if self.num_workers > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+    # -- overridden API -------------------------------------------------
+    def init(self, key, value):
+        """Worker 0's value wins (ref: dist server stores the first
+        init; others are ignored) and is broadcast to every worker."""
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                continue
+            vv = v[0] if _is_list(v) else v
+            data = self._bcast_from_root(vv._data)
+            out = vv.copy() if isinstance(vv, NDArray) else NDArray(vv)
+            out._data = jax.device_put(data, out.context.jax_device)
+            self._store[k] = out
+
+    broadcast = init
+
+    def _maybe_compress(self, k, payload):
+        """2-bit quantise the wire payload with per-key error-feedback
+        residual (ref: GradientCompression::Quantize before ZPush)."""
+        if self._compression.get("type") == "2bit":
+            thr = float(self._compression.get("threshold", 0.5))
+            res = self._residuals.get(k)
+            if res is None:
+                res = jnp.zeros_like(payload)
+            payload, res = _quantize_2bit(payload, res, thr)
+            self._residuals[k] = res
+        return payload
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("key %r not initialised" % (k,))
+            local = self._reduce(v)                # intra-host first
+            agg_data = self._allreduce_sum(self._maybe_compress(
+                k, local._data))
+            agg = NDArray(agg_data, ctx=local.context)
+            if self._updater is not None:
+                self._updater(self._int_key(k), agg, self._store[k])
+            else:
+                self._store[k]._data = jax.device_put(
+                    jnp.array(agg._data,
+                              dtype=self._store[k]._data.dtype, copy=True),
+                    self._store[k].context.jax_device)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused allreduce across workers: local reduce → DCN sum →
+        write-out (ref: KVStoreDist push+pull pair in Trainer.step)."""
+        keys, values = self._normalize(key, value)
+        if out is None:
+            out = value
+        _, outs = self._normalize(key, out)
+        for k, v, o in zip(keys, values, outs):
+            local = self._reduce(v)
+            agg_data = self._allreduce_sum(self._maybe_compress(
+                k, local._data))
+            for dst in (o if _is_list(o) else [o]):
+                self._copy_into(dst, agg_data)
+
+    def set_gradient_compression(self, compression_params):
+        params = dict(compression_params)
+        ctype = params.get("type", "2bit")
+        if ctype != "2bit":
+            raise MXNetError("unsupported compression type %r" % ctype)
+        self._compression = params
+
+
 _TYPES = ("local", "device", "nccl", "dist_sync", "dist_async",
           "dist_sync_device", "dist_async_device", "horovod", "p3store_dist")
 
@@ -207,4 +341,6 @@ def create(name: str = "local") -> KVStore:
     """ref: KVStore::Create."""
     if name not in _TYPES:
         raise MXNetError("unknown kvstore type %r" % name)
+    if name.startswith("dist") or name == "p3store_dist":
+        return DistKVStore(name)
     return KVStore(name)

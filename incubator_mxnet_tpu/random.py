@@ -39,10 +39,14 @@ def seed(seed_state: int, ctx="all"):
 
 def _ctx_key(ctx: Context):
     if ctx not in _KEYS:
-        # derive deterministic per-context key from base seed + device id
-        _KEYS[ctx] = jax.random.fold_in(
-            jax.random.key(_BASE_SEED), hash((ctx.device_type,
-                                              ctx.device_id)) & 0x7FFFFFFF)
+        # derive deterministic per-context key from base seed + device id.
+        # crc32, NOT Python hash(): string hashing is salted per process,
+        # which would give dist workers different streams for the same
+        # seed (breaking same-init invariants; see next_key_bits).
+        import zlib
+        mix = zlib.crc32(repr((ctx.device_type,
+                               ctx.device_id)).encode()) & 0x7FFFFFFF
+        _KEYS[ctx] = jax.random.fold_in(jax.random.key(_BASE_SEED), mix)
     return _KEYS[ctx]
 
 

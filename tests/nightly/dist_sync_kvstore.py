@@ -1,0 +1,107 @@
+"""Multi-worker dist_sync kvstore invariants — run as N localhost
+processes (ref: tests/nightly/dist_sync_kvstore.py, launched by
+tools/launch.py with the dmlc `local` tracker; here the launcher is
+tests/python/unittest/test_kvstore_dist.py or a manual
+
+    DMLC_NUM_WORKER=2 DMLC_PS_ROOT_PORT=<p> DMLC_WORKER_ID=<i> \
+        python tests/nightly/dist_sync_kvstore.py
+
+per worker).  Asserts are exact-value, deterministic-input — the same
+contract as the reference's nightly test (init value; aggregate ==
+sum over workers; row_sparse rows; 2-bit compression with residual)."""
+import os
+import sys
+
+import numpy as np
+
+import jax
+
+# virtual CPU backend; the kvstore itself calls jax.distributed.initialize
+jax.config.update("jax_platforms", "cpu")
+
+import incubator_mxnet_tpu as mx                       # noqa: E402
+from incubator_mxnet_tpu import nd, kvstore            # noqa: E402
+
+
+def main():
+    kv = kvstore.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    expect_nw = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    assert nw == expect_nw, (nw, expect_nw)
+
+    # --- init/broadcast: worker 0's value wins everywhere -------------
+    init_val = 7.0 if rank == 0 else 99.0
+    kv.init(3, nd.array(np.full((4, 2), init_val, np.float32)))
+    out = nd.zeros((4, 2))
+    kv.pull(3, out=out)
+    assert np.allclose(out.asnumpy(), 7.0), out.asnumpy()
+
+    # --- push: stored value becomes sum over ALL workers --------------
+    kv.push(3, nd.array(np.full((4, 2), float(rank + 1), np.float32)))
+    kv.pull(3, out=out)
+    expected = nw * (nw + 1) / 2.0          # 1 + 2 + ... + nw
+    assert np.allclose(out.asnumpy(), expected), out.asnumpy()
+
+    # --- a second round on the same key (no state leakage) ------------
+    kv.push(3, nd.array(np.full((4, 2), 2.0, np.float32)))
+    kv.pull(3, out=out)
+    assert np.allclose(out.asnumpy(), 2.0 * nw), out.asnumpy()
+
+    # --- row_sparse_pull ----------------------------------------------
+    w = np.arange(12, dtype=np.float32).reshape(6, 2)
+    kv.init(9, nd.array(w))
+    rs = nd.zeros((6, 2))
+    kv.row_sparse_pull(9, out=rs, row_ids=nd.array(
+        np.array([1, 4], np.float32)))
+    exp = np.zeros((6, 2), np.float32)
+    exp[[1, 4]] = w[[1, 4]]
+    assert np.allclose(rs.asnumpy(), exp), rs.asnumpy()
+
+    # --- 2-bit gradient compression with error feedback ---------------
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(1, nd.zeros((4,)))
+    kv.push(1, nd.array(np.array([0.7, -0.7, 0.1, -0.1], np.float32)))
+    c = nd.zeros((4,))
+    kv.pull(1, out=c)
+    assert np.allclose(c.asnumpy(), [0.5 * nw, -0.5 * nw, 0.0, 0.0]), \
+        c.asnumpy()
+    # residuals [0.2, -0.2, 0.1, -0.1] make the next small push visible
+    kv.push(1, nd.array(np.array([0.3, -0.3, 0.0, 0.0], np.float32)))
+    kv.pull(1, out=c)
+    assert np.allclose(c.asnumpy(), [0.5 * nw, -0.5 * nw, 0.0, 0.0]), \
+        c.asnumpy()
+
+    # --- end-to-end: gluon.Trainer dist data-parallel step ------------
+    # every worker computes grads on ITS shard; after step(batch) all
+    # workers hold the identical, analytically-expected weight
+    from incubator_mxnet_tpu import gluon, autograd as ag
+    mx.random.seed(123)                  # identical init on all workers
+    net = gluon.nn.Dense(1, use_bias=False, in_units=3)
+    net.initialize()
+    w0 = net.weight.data().asnumpy().copy()          # (1, 3)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5}, kvstore="dist_sync")
+    x_np = np.full((2, 3), float(rank + 1), np.float32)
+    x = nd.array(x_np)
+    with ag.record():
+        loss = (net(x) ** 2).sum()
+        loss.backward()
+    trainer.step(2)
+    # expected: w1 = w0 - lr/2 * sum_r grad_r,  grad_r = 2 Σ_b pred_b x_b
+    grad_sum = np.zeros_like(w0)
+    for r in range(nw):
+        xr = np.full((2, 3), float(r + 1), np.float32)
+        pred = xr.dot(w0.T)                          # (2, 1)
+        grad_sum += 2.0 * (pred * xr).sum(axis=0, keepdims=True)
+    w_expect = w0 - 0.5 / 2.0 * grad_sum
+    w_got = net.weight.data().asnumpy()
+    assert np.allclose(w_got, w_expect, rtol=1e-5, atol=1e-6), \
+        (w_got, w_expect)
+
+    kv._barrier()
+    print("dist_sync_kvstore ok: rank %d/%d" % (rank, nw))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -71,9 +71,25 @@ def test_row_sparse_pull():
 
 
 def test_gradient_compression_api():
+    # in-process stores transfer nothing — compression must refuse, not
+    # silently record (ref: compression is a ps-lite push-path feature)
     store = kv.create("device")
-    store.set_gradient_compression({"type": "2bit", "threshold": 0.5})
-    assert store._compression["type"] == "2bit"
+    with pytest.raises(mx.MXNetError):
+        store.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+
+    dstore = kv.create("dist_sync")      # 1-process dist: honest fallback
+    dstore.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    assert dstore._compression["type"] == "2bit"
+    dstore.init(0, nd.zeros((3,)))
+    dstore.push(0, nd.array(np.array([0.7, 0.3, -0.7], np.float32)))
+    out = nd.zeros((3,))
+    dstore.pull(0, out=out)
+    # quantized to {-thr, 0, +thr}
+    assert np.allclose(out.asnumpy(), [0.5, 0.0, -0.5])
+    # error feedback: residual [0.2, 0.3, -0.2] carries into the next push
+    dstore.push(0, nd.array(np.array([0.2, 0.3, 0.0], np.float32)))
+    dstore.pull(0, out=out)
+    assert np.allclose(out.asnumpy(), [0.0, 0.5, 0.0])
 
 
 def test_rank_single_process():

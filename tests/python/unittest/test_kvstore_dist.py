@@ -1,0 +1,59 @@
+"""Launcher for the multi-process dist kvstore test: fakes multi-node as
+multi-PROCESS on localhost, exactly the reference's strategy
+(ref: tools/launch.py -n 2 --launcher local tests/nightly/
+dist_sync_kvstore.py; SURVEY §4 'distributed tests as multi-process
+localhost')."""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "..", "..", "nightly", "dist_sync_kvstore.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.parametrize("nworkers", [2, 3])
+def test_dist_sync_kvstore_multiprocess(nworkers):
+    port = _free_port()
+    procs = []
+    for rank in range(nworkers):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)          # children are 1-device CPU
+        repo = os.path.abspath(os.path.join(os.path.dirname(_WORKER),
+                                            "..", ".."))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "DMLC_ROLE": "worker",
+            "DMLC_NUM_WORKER": str(nworkers),
+            "DMLC_WORKER_ID": str(rank),
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    fails = []
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            fails.append((rank, "timeout", out))
+            continue
+        if p.returncode != 0:
+            fails.append((rank, p.returncode, out))
+    assert not fails, "\n\n".join(
+        "worker %s rc=%s\n%s" % (r, rc, o.decode(errors="replace")[-3000:])
+        for r, rc, o in fails)
