@@ -158,6 +158,28 @@ def _requires_tracking(nd) -> bool:
                                nd._grad_req not in (None, "null"))
 
 
+def _is_rsp(x):
+    from .ndarray.sparse import RowSparseNDArray
+    return isinstance(x, RowSparseNDArray)
+
+
+def _accum_cot(a, b):
+    """Accumulate two cotangents, either of which may be a
+    RowSparseNDArray (sparse Embedding grads) or a jax array."""
+    if _is_rsp(a) or _is_rsp(b):
+        from .ndarray.sparse import add as sparse_add
+        if _is_rsp(a) and _is_rsp(b):
+            return sparse_add(a, b)
+        dense = a if not _is_rsp(a) else b
+        rsp = a if _is_rsp(a) else b
+        return rsp.tostype("default")._data + dense
+    return a + b
+
+
+def _densify_cot(c):
+    return c.tostype("default")._data if _is_rsp(c) else c
+
+
 def record_op(vjp_fn, input_nds, output_nds, name="", out_is_tuple=False):
     """Attach a tape node linking inputs → outputs. Called by the NDArray
     dispatch layer when recording is on and ≥1 input is tracked."""
@@ -260,16 +282,21 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
                 continue
             pn = inp._tape_node
             if pn is not None:
+                # only leaves keep sparse grads; interior flow densifies
+                # (ref: storage-type inference falls back to dense)
                 key = (id(pn), inp._out_index)
-                cot[key] = cot[key] + ic if key in cot else ic
+                icd = _densify_cot(ic)
+                cot[key] = cot[key] + icd if key in cot else icd
             if var_ids is not None:
                 if id(inp) in var_ids and pn is None:
                     k = id(inp)
-                    var_grads[k] = var_grads[k] + ic if k in var_grads else ic
+                    var_grads[k] = _accum_cot(var_grads[k], ic) \
+                        if k in var_grads else ic
             if pn is None and inp._grad_req not in (None, "null"):
                 k = id(inp)
                 if k in leaf_updates:
-                    leaf_updates[k] = (inp, leaf_updates[k][1] + ic)
+                    leaf_updates[k] = (inp, _accum_cot(leaf_updates[k][1],
+                                                       ic))
                 else:
                     leaf_updates[k] = (inp, ic)
 
@@ -284,12 +311,28 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
             g = var_grads.get(id(v))
             if g is None:
                 g = jnp.zeros(v.shape, v.dtype)
-            out.append(NDArray(g, ctx=v.context))
+            out.append(g if _is_rsp(g) else NDArray(g, ctx=v.context))
         return out
 
     # accumulate into leaf .grad per grad_req
     for nd, g in leaf_updates.values():
         if nd._grad is None:
+            continue
+        grad_is_sparse = _is_rsp(nd._grad)
+        if _is_rsp(g) and not grad_is_sparse:
+            g = g.tostype("default")._data       # dense grad buffer
+        if grad_is_sparse:
+            # row_sparse grad container (grad_stype='row_sparse'):
+            # 'write' replaces the stored rows, 'add' merges them
+            if not _is_rsp(g):
+                from .ndarray.sparse import cast_storage
+                from .ndarray import NDArray as _ND
+                g = cast_storage(_ND(g, ctx=nd.context), "row_sparse")
+            if nd._grad_req == "add" and nd._grad.indices.shape[0] > 0:
+                from .ndarray.sparse import add as sparse_add
+                nd._grad = sparse_add(nd._grad, g)
+            else:
+                nd._grad = g
             continue
         if nd._grad_req == "add":
             nd._grad._data = nd._grad._data + g.astype(nd._grad._data.dtype)

@@ -153,7 +153,7 @@ class Parameter:
         import jax.numpy as jnp
         self._grad = OrderedDict()
         for ctx, arr in self._data.items():
-            arr.attach_grad(self._grad_req)
+            arr.attach_grad(self._grad_req, stype=self._grad_stype)
             self._grad[ctx] = arr._grad
 
     # ------------------------------------------------------------------
@@ -183,16 +183,21 @@ class Parameter:
         return list(self._data.values())
 
     def grad(self, ctx=None):
-        self._check_initialized(ctx)
+        self._check_initialized()
         if self._grad is None:
             raise MXNetError("parameter %s has grad_req='null'" % self.name)
-        if ctx is None:
-            return next(iter(self._grad.values()))
-        return self._grad[ctx]
+        # read the LIVE container from the array: sparse backward rebinds
+        # arr._grad to a fresh RowSparseNDArray each step
+        if ctx is None or ctx not in self._data:
+            return next(iter(self._data.values()))._grad
+        return self._data[ctx]._grad
 
     def list_grad(self):
         self._check_initialized()
-        return list(self._grad.values()) if self._grad else []
+        if self._grad is None:
+            return []
+        return [a._grad for a in self._data.values()
+                if a._grad is not None]
 
     def list_ctx(self):
         if self._data is None and self._deferred_init:
@@ -204,8 +209,16 @@ class Parameter:
         if self._grad is None:
             return
         import jax.numpy as jnp
-        for g in self._grad.values():
-            g._data = jnp.zeros_like(g._data)
+        from ..ndarray.sparse import RowSparseNDArray, zeros_row_sparse
+        for arr in self._data.values():
+            g = arr._grad
+            if g is None:
+                continue
+            if isinstance(g, RowSparseNDArray):
+                arr._grad = zeros_row_sparse(g.shape, g.data._data.dtype,
+                                             ctx=arr.context)
+            else:
+                g._data = jnp.zeros_like(g._data)
 
     def set_data(self, data):
         self.shape = data.shape

@@ -82,10 +82,19 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError("key %r not initialised" % (k,))
             agg = self._reduce(v)
+            from ..ndarray.sparse import RowSparseNDArray
             if self._updater is not None:
                 # server-side optimizer (ref: kvstore_dist_server.h
-                # DataHandleEx → updater(key, grad, weight))
+                # DataHandleEx → updater(key, grad, weight)); row_sparse
+                # grads dispatch to the optimizer's FComputeEx-style path
                 self._updater(self._int_key(k), agg, self._store[k])
+            elif isinstance(agg, RowSparseNDArray):
+                # sparse push without updater: the pushed rows replace the
+                # stored rows (ref: kvstore row_sparse aggregation)
+                rows = agg.indices._data.astype(jnp.int32)
+                dst = self._store[k]
+                dst._data = dst._data.at[rows].set(
+                    agg.data._data.astype(dst._data.dtype))
             else:
                 # reference semantics: push REPLACES the stored value with
                 # the aggregate (init 2, push 8 → pull 8), it does not
@@ -97,6 +106,27 @@ class KVStore:
                     jnp.array(agg._data, dtype=self._store[k]._data.dtype,
                               copy=True),
                     self._store[k].context.jax_device)
+
+    @staticmethod
+    def _write_out(dst, src):
+        """Write an aggregate (NDArray or RowSparseNDArray) into `dst`,
+        converting storage types as needed."""
+        from ..ndarray.sparse import RowSparseNDArray, cast_storage
+        if isinstance(src, RowSparseNDArray):
+            if isinstance(dst, RowSparseNDArray):
+                dst.indices = src.indices.copy()
+                dst.data = src.data.copy()
+                dst._shape = src.shape
+                return
+            KVStore._copy_into(dst, src.tostype("default")._data)
+            return
+        if isinstance(dst, RowSparseNDArray):
+            rsp = cast_storage(src, "row_sparse")
+            dst.indices = rsp.indices
+            dst.data = rsp.data
+            dst._shape = rsp.shape
+            return
+        KVStore._copy_into(dst, src._data)
 
     @staticmethod
     def _copy_into(dst, src_data):
@@ -116,7 +146,7 @@ class KVStore:
                 raise MXNetError("key %r not initialised" % (k,))
             src = self._store[k]
             for dst in (o if _is_list(o) else [o]):
-                self._copy_into(dst, src._data)
+                self._write_out(dst, src)
 
     def pushpull(self, key, value, out=None, priority=0):
         """Fused allreduce (ref: KVStoreNCCL::PushPull — grouped
@@ -128,7 +158,7 @@ class KVStore:
         for k, v, o in zip(keys, values, outs):
             agg = self._reduce(v)
             for dst in (o if _is_list(o) else [o]):
-                self._copy_into(dst, agg._data)
+                self._write_out(dst, agg)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the rows in `row_ids` (ref: sparse kvstore pull for
@@ -200,6 +230,12 @@ class KVStore:
             return v
         if len(v) == 1:
             return v[0]
+        from ..ndarray.sparse import RowSparseNDArray, add as sparse_add
+        if any(isinstance(x, RowSparseNDArray) for x in v):
+            acc = v[0]
+            for x in v[1:]:
+                acc = sparse_add(acc, x)
+            return acc
         dev = v[0]._data.sharding.device_set if hasattr(
             v[0]._data, "sharding") else None
         acc = v[0]._data
@@ -294,17 +330,63 @@ class DistKVStore(KVStore):
             self._residuals[k] = res
         return payload
 
+    def _dist_aggregate(self, k, local):
+        """local (NDArray or RowSparseNDArray) → cross-worker aggregate.
+        RowSparse payloads densify for the wire (variable-nnz allgather
+        is a follow-up); single-worker runs skip the wire entirely."""
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(local, RowSparseNDArray):
+            if self.num_workers == 1:
+                return local
+            local = local.tostype("default")
+        if self.num_workers == 1:
+            return NDArray(self._maybe_compress(k, local._data),
+                           ctx=local.context)
+        if self._compression.get("type") == "2bit":
+            thr = float(self._compression.get("threshold", 0.5))
+            agg = self._allreduce_2bit(k, local._data, thr)
+        else:
+            agg = self._allreduce_sum(local._data)
+        return NDArray(agg, ctx=local.context)
+
+    def _allreduce_2bit(self, k, payload, thr):
+        """Quantise to {-thr, 0, +thr}, PACK to 2-bit codes (4 elements
+        per byte), allgather the packed bytes, decode+sum — the wire
+        carries 1/16 of the f32 payload (ref: gradient_compression.cc
+        packing into uint32 words)."""
+        import numpy as _np
+        from jax.experimental import multihost_utils
+        q = self._maybe_compress(k, payload)            # {-thr, 0, thr}
+        codes = (_np.sign(_np.asarray(q)) + 1).astype(_np.uint8)  # {0,1,2}
+        n = codes.size
+        pad = (-n) % 4
+        codes = _np.concatenate([codes.ravel(),
+                                 _np.ones(pad, _np.uint8)])  # 1 == zero
+        packed = (codes[0::4] | (codes[1::4] << 2) |
+                  (codes[2::4] << 4) | (codes[3::4] << 6))
+        gathered = multihost_utils.process_allgather(packed)
+        total = _np.zeros(n + pad, _np.float32)
+        for row in gathered.reshape(self.num_workers, -1):
+            u = _np.stack([row & 3, (row >> 2) & 3,
+                           (row >> 4) & 3, (row >> 6) & 3], axis=1).ravel()
+            total += (u.astype(_np.float32) - 1.0) * thr
+        return jnp.asarray(total[:n].reshape(payload.shape)
+                           .astype(_np.asarray(payload).dtype))
+
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             if k not in self._store:
                 raise MXNetError("key %r not initialised" % (k,))
-            local = self._reduce(v)                # intra-host first
-            agg_data = self._allreduce_sum(self._maybe_compress(
-                k, local._data))
-            agg = NDArray(agg_data, ctx=local.context)
+            agg = self._dist_aggregate(k, self._reduce(v))
+            from ..ndarray.sparse import RowSparseNDArray
             if self._updater is not None:
                 self._updater(self._int_key(k), agg, self._store[k])
+            elif isinstance(agg, RowSparseNDArray):
+                rows = agg.indices._data.astype(jnp.int32)
+                dst = self._store[k]
+                dst._data = dst._data.at[rows].set(
+                    agg.data._data.astype(dst._data.dtype))
             else:
                 self._store[k]._data = jax.device_put(
                     jnp.array(agg._data,
@@ -319,11 +401,9 @@ class DistKVStore(KVStore):
             out = value
         _, outs = self._normalize(key, out)
         for k, v, o in zip(keys, values, outs):
-            local = self._reduce(v)
-            agg_data = self._allreduce_sum(self._maybe_compress(
-                k, local._data))
+            agg = self._dist_aggregate(k, self._reduce(v))
             for dst in (o if _is_list(o) else [o]):
-                self._copy_into(dst, agg_data)
+                self._write_out(dst, agg)
 
     def set_gradient_compression(self, compression_params):
         params = dict(compression_params)

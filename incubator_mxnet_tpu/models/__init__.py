@@ -2,6 +2,8 @@
 from . import transformer
 from .transformer import (BERTModel, TransformerEncoder, bert_base,
                           bert_small)
+from . import wide_deep as wide_deep_mod
+from .wide_deep import WideDeep, wide_deep
 
 __all__ = ["transformer", "BERTModel", "TransformerEncoder", "bert_base",
-           "bert_small"]
+           "bert_small", "WideDeep", "wide_deep"]

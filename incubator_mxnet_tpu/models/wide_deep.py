@@ -1,0 +1,75 @@
+"""Wide & Deep recommender (BASELINE config 5).
+
+Parity target: the reference's sparse example
+(ref: example/sparse/wide_deep/{model.py,train.py} — wide linear term
+over one-hot/libsvm features with row_sparse weight, deep MLP over
+embeddings; fed by LibSVMIter; row_sparse gradients flow through the
+sparse optimizer updates and kvstore.row_sparse_pull).
+
+TPU-first notes: sparse features arrive as a fixed number of fields
+(padded indices + values) so every shape is static under jit; the
+embedding gathers ride the MXU-adjacent gather units; the sparse part
+is the GRADIENT (row_sparse via ops in ndarray/sparse.py), which is the
+part that matters for million-row vocabularies.
+"""
+from __future__ import annotations
+
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+
+__all__ = ["WideDeep", "wide_deep"]
+
+
+class WideDeep(HybridBlock):
+    """fields-format input: `indices` (B, F) int feature ids and
+    `values` (B, F) float feature values (0-padded)."""
+
+    def __init__(self, num_features, embed_dim=16, hidden=(64, 32),
+                 classes=2, sparse_grad=True, **kwargs):
+        super().__init__(**kwargs)
+        self._num_features = num_features
+        # wide: per-feature scalar weight — a (vocab, 1) embedding whose
+        # gradient is row_sparse (ref: wide_deep model.py `wide` Embedding
+        # with sparse_grad + Ftrl/SGD lazy update)
+        self.wide = nn.Embedding(num_features, 1, sparse_grad=sparse_grad)
+        self.deep_embed = nn.Embedding(num_features, embed_dim,
+                                       sparse_grad=sparse_grad)
+        self.mlp = nn.HybridSequential()
+        for h in hidden:
+            self.mlp.add(nn.Dense(h, activation="relu", flatten=False))
+        self.out = nn.Dense(classes, flatten=False)
+
+    def forward(self, indices, values):
+        from .. import ndarray as F
+        B, Fn = indices.shape
+        vals = values.reshape((B, Fn, 1))
+        wide_term = (self.wide(indices) * vals).sum(axis=1)     # (B, 1)
+        emb = self.deep_embed(indices) * vals                   # (B, F, E)
+        deep_in = emb.reshape((B, -1))
+        deep_term = self.out(self.mlp(deep_in))                 # (B, C)
+        return deep_term + wide_term
+
+
+def wide_deep(num_features=1000, **kwargs):
+    return WideDeep(num_features, **kwargs)
+
+
+def csr_to_fields(csr, num_fields):
+    """Convert a CSRNDArray batch (LibSVMIter output) to the padded
+    (indices, values) fields format the model consumes.  Rows with fewer
+    than `num_fields` entries pad with (0, 0.0); extra entries truncate.
+    """
+    import numpy as np
+    from .. import ndarray as nd
+    indptr = csr.indptr.asnumpy()
+    indices = csr.indices.asnumpy()
+    values = csr.data.asnumpy()
+    B = len(indptr) - 1
+    out_i = np.zeros((B, num_fields), np.int32)
+    out_v = np.zeros((B, num_fields), np.float32)
+    for b in range(B):
+        lo, hi = indptr[b], min(indptr[b + 1], indptr[b] + num_fields)
+        n = hi - lo
+        out_i[b, :n] = indices[lo:hi]
+        out_v[b, :n] = values[lo:hi]
+    return nd.array(out_i, dtype="int32"), nd.array(out_v)

@@ -121,6 +121,12 @@ def invoke(opname, *args, **kwargs):
     if any(isinstance(a, _Sym) for a in args) or \
             any(isinstance(v, _Sym) for v in kwargs.values()):
         return apply_stub_args(opname, args, kwargs)
+    if od.sparse_invoke is not None:
+        # FComputeEx analogue: ops with a registered sparse path get
+        # first refusal; NotImplemented falls through to dense dispatch
+        res = od.sparse_invoke(args, kwargs)
+        if res is not NotImplemented:
+            return res
     ctx = _resolve_ctx(args, kwargs)
     if od.needs_rng and "_rng_key" not in kwargs:
         kwargs["_rng_key"] = _rnd.split_key(ctx)
@@ -271,8 +277,13 @@ class NDArray:
         # recorded history no longer flows through it
         self._tape_node = None
         self._out_index = 0
-        self._grad = NDArray(jnp.zeros(self.shape, self._data.dtype),
-                             ctx=self._ctx)
+        if stype == "row_sparse":
+            from .sparse import zeros_row_sparse
+            self._grad = zeros_row_sparse(self.shape, self._data.dtype,
+                                          ctx=self._ctx)
+        else:
+            self._grad = NDArray(jnp.zeros(self.shape, self._data.dtype),
+                                 ctx=self._ctx)
         self._grad_req = grad_req
 
     def detach(self):

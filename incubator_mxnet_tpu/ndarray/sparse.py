@@ -178,20 +178,74 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     raise MXNetError("unsupported sparse dot combination")
 
 
-def embedding_grad(indices, out_grad, vocab_size):
-    """Build the row_sparse gradient of an Embedding lookup
-    (ref: EmbeddingOpBackwardEx row_sparse path): unique rows + summed
-    per-row cotangents."""
-    from .ndarray import NDArray as _ND
-    idx = _np.asarray(indices.asnumpy() if hasattr(indices, "asnumpy")
-                      else indices).astype(_np.int64).reshape(-1)
-    g = out_grad.asnumpy() if hasattr(out_grad, "asnumpy") else \
-        _np.asarray(out_grad)
-    g = g.reshape(-1, g.shape[-1])
+def zeros_row_sparse(shape, dtype, ctx=None):
+    """Empty row_sparse gradient container (no stored rows).  int32
+    indices: x64 is off, and every consumer casts to int32 anyway."""
+    return RowSparseNDArray(
+        NDArray(jnp.zeros((0,), jnp.int32)),
+        NDArray(jnp.zeros((0,) + tuple(shape[1:]), dtype)),
+        tuple(shape), ctx=ctx)
+
+
+def embedding_grad_rsp(idx_np, cot, vocab_size, ctx=None):
+    """RowSparse cotangent of an Embedding lookup: unique touched rows +
+    segment-summed per-row cotangents (ref: EmbeddingOpBackwardEx,
+    kRowSparseStorage path).  idx_np: host numpy indices (any shape);
+    cot: jax array of shape idx.shape + (dim,)."""
+    idx = _np.asarray(idx_np).astype(_np.int64).reshape(-1)
     uniq, inv = _np.unique(idx, return_inverse=True)
-    vals = _np.zeros((len(uniq), g.shape[1]), g.dtype)
-    _np.add.at(vals, inv, g)
-    return RowSparseNDArray(uniq, vals, (vocab_size, g.shape[1]))
+    dim = cot.shape[-1]
+    flat = cot.reshape(-1, dim)
+    vals = jnp.zeros((len(uniq), dim), flat.dtype).at[
+        jnp.asarray(inv)].add(flat)
+    return RowSparseNDArray(NDArray(jnp.asarray(uniq)), NDArray(vals),
+                            (int(vocab_size), int(dim)), ctx=ctx)
+
+
+def _embedding_sparse_invoke(args, kwargs):
+    """OpDef.sparse_invoke hook for Embedding: active only when
+    sparse_grad=True, recording, and the weight is a tracked NDArray
+    passed positionally; otherwise defers to the dense path."""
+    from .. import autograd as _ag
+    if not (kwargs.get("sparse_grad") and _ag.is_recording()
+            and len(args) >= 2 and isinstance(args[1], NDArray)
+            and _ag._requires_tracking(args[1])):
+        return NotImplemented
+    return sparse_embedding_invoke(args[0], args[1], **kwargs)
+
+
+def sparse_embedding_invoke(data, weight, **kwargs):
+    """Imperative Embedding with a row_sparse weight gradient.  Bypasses
+    jax.vjp (whose weight cotangent is a dense vocab×dim scatter) and
+    records a custom tape node that emits a RowSparseNDArray on backward
+    — the whole point of sparse_grad for million-row vocabularies
+    (ref: indexing_op.h EmbeddingOpBackwardEx FComputeEx)."""
+    from .. import autograd as _ag
+    out_data = jnp.take(weight._data, data._data.astype(jnp.int32), axis=0)
+    out = NDArray(out_data, ctx=weight.context)
+    if _ag.is_recording() and _ag._requires_tracking(weight):
+        idx_np = _np.asarray(data._data)        # host copy for backward
+        vocab = weight.shape[0]
+        ctx = weight.context
+
+        def vjp_fn(cot):
+            return (embedding_grad_rsp(idx_np, cot, vocab, ctx=ctx),)
+
+        _ag.record_op(vjp_fn, [weight], [out], name="Embedding_sparse_grad")
+    return out
+
+
+def embedding_grad(indices, out_grad, vocab_size):
+    """Build the row_sparse gradient of an Embedding lookup — thin
+    array-like front over embedding_grad_rsp (one kernel, one impl)."""
+    idx = indices.asnumpy() if hasattr(indices, "asnumpy") else indices
+    g = out_grad._data if isinstance(out_grad, NDArray) else \
+        jnp.asarray(out_grad)
+    return embedding_grad_rsp(idx, g, vocab_size)
+
+# hook registration (kept next to the kernel it dispatches to)
+from ..ops import registry as _op_registry                 # noqa: E402
+_op_registry.get("Embedding").sparse_invoke = _embedding_sparse_invoke
 
 
 def sparse_sgd_update(weight, grad_rsp, lr, wd=0.0, rescale_grad=1.0,

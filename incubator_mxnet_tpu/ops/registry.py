@@ -30,7 +30,7 @@ class OpDef:
 
     __slots__ = ("name", "fn", "ndarray_inputs", "differentiable",
                  "num_outputs", "doc", "needs_rng", "needs_training",
-                 "nograd_argnums")
+                 "nograd_argnums", "sparse_invoke")
 
     def __init__(self, name: str, fn: Callable, *,
                  ndarray_inputs: Optional[Sequence[str]] = None,
@@ -51,6 +51,11 @@ class OpDef:
         self.needs_rng = needs_rng or "_rng_key" in params
         self.needs_training = "_training" in params
         self.nograd_argnums = tuple(nograd_argnums)
+        # optional FComputeEx-style imperative override: called as
+        # sparse_invoke(args, kwargs); returns NotImplemented to fall
+        # through to the dense path (ref: FComputeEx dispatch on
+        # storage type, src/imperative/imperative_utils.h)
+        self.sparse_invoke = None
         self.doc = fn.__doc__
 
     def __repr__(self):

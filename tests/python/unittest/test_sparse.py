@@ -138,3 +138,140 @@ def test_rand_ndarray_sparse():
     assert csr.stype == "csr"
     rsp = rand_ndarray((10, 4), stype="row_sparse", density=0.3)
     assert rsp.stype == "row_sparse"
+
+
+# ---------------------------------------------------------------------------
+# sparse autograd integration (ref: test_sparse_operator.py sparse
+# Embedding grad + test_module.py sparse pull; VERDICT round-1 item 8)
+# ---------------------------------------------------------------------------
+
+
+def test_embedding_sparse_grad_flow():
+    """Embedding(sparse_grad=True) backward yields a RowSparseNDArray on
+    the weight — not a dense vocab-size scatter."""
+    from incubator_mxnet_tpu import autograd as ag
+    from incubator_mxnet_tpu import gluon
+    vocab, dim = 50, 4
+    emb = gluon.nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize()
+    idx = nd.array(np.array([[1, 3], [3, 7]], np.float32))
+    with ag.record():
+        out = emb(idx)
+        loss = (out * out).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert isinstance(g, sparse.RowSparseNDArray), type(g)
+    assert sorted(g.indices.asnumpy().tolist()) == [1, 3, 7]
+    # values match the dense computation: dL/dW[r] = sum over uses of 2*W[r]
+    w = emb.weight.data().asnumpy()
+    dense_expect = np.zeros((vocab, dim), np.float32)
+    for r in [1, 3, 3, 7]:
+        dense_expect[r] += 2 * w[r]
+    assert_almost_equal(g.asnumpy(), dense_expect)
+
+
+def test_sparse_trainer_lazy_update():
+    """Trainer.step with a row_sparse grad updates ONLY the touched rows
+    (ref: sgd_update FComputeEx lazy_update)."""
+    from incubator_mxnet_tpu import autograd as ag
+    from incubator_mxnet_tpu import gluon
+    vocab, dim = 30, 4
+    emb = gluon.nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize()
+    w_before = emb.weight.data().asnumpy().copy()
+    trainer = gluon.Trainer(emb.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "wd": 0.0})
+    idx = nd.array(np.array([[2, 5]], np.float32))
+    with ag.record():
+        loss = emb(idx).sum()
+        loss.backward()
+    trainer.step(1)
+    w_after = emb.weight.data().asnumpy()
+    touched = [2, 5]
+    untouched = [r for r in range(vocab) if r not in touched]
+    assert np.allclose(w_after[untouched], w_before[untouched])
+    assert_almost_equal(w_after[touched], w_before[touched] - 1.0)
+
+
+def test_sparse_adam_trainer():
+    from incubator_mxnet_tpu import autograd as ag
+    from incubator_mxnet_tpu import gluon
+    emb = gluon.nn.Embedding(20, 3, sparse_grad=True)
+    emb.initialize()
+    w_before = emb.weight.data().asnumpy().copy()
+    trainer = gluon.Trainer(emb.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+    idx = nd.array(np.array([[4]], np.float32))
+    with ag.record():
+        loss = (emb(idx) ** 2).sum()
+        loss.backward()
+    trainer.step(1)
+    w_after = emb.weight.data().asnumpy()
+    assert not np.allclose(w_after[4], w_before[4])
+    untouched = [r for r in range(20) if r != 4]
+    assert np.allclose(w_after[untouched], w_before[untouched])
+
+
+def test_kvstore_sparse_push_and_row_sparse_pull():
+    from incubator_mxnet_tpu import kvstore as kv
+    store = kv.create("local")
+    store.init("w", nd.zeros((6, 2)))
+    rsp = sparse.RowSparseNDArray(
+        np.array([1, 4], np.int64),
+        np.array([[1.0, 2.0], [3.0, 4.0]], np.float32), (6, 2))
+    store.push("w", rsp)
+    out = nd.zeros((6, 2))
+    store.row_sparse_pull("w", out=out,
+                          row_ids=nd.array(np.array([1, 4], np.float32)))
+    got = out.asnumpy()
+    assert np.allclose(got[1], [1.0, 2.0])
+    assert np.allclose(got[4], [3.0, 4.0])
+    assert np.allclose(got[[0, 2, 3, 5]], 0)
+
+
+def test_wide_deep_libsvm_convergence(tmp_path):
+    """Config 5 end-to-end: LibSVMIter -> WideDeep -> sparse grads ->
+    sparse optimizer; loss must halve on a learnable synthetic set."""
+    from incubator_mxnet_tpu import autograd as ag
+    from incubator_mxnet_tpu import gluon, io as mxio
+    from incubator_mxnet_tpu.models.wide_deep import (WideDeep,
+                                                      csr_to_fields)
+    rs = np.random.RandomState(0)
+    vocab, fields, B, N = 100, 4, 16, 64
+    # synthetic: label = 1 iff any feature id < vocab//2
+    lines = []
+    for _ in range(N):
+        ids = sorted(rs.choice(vocab, fields, replace=False))
+        label = 1 if min(ids) < vocab // 2 else 0
+        lines.append("%d %s" % (label,
+                                " ".join("%d:%.3f" % (i, 1.0)
+                                         for i in ids)))
+    path = tmp_path / "train.libsvm"
+    path.write_text("\n".join(lines))
+
+    it = mxio.LibSVMIter(data_libsvm=str(path), data_shape=(vocab,),
+                         batch_size=B)
+    net = WideDeep(vocab, embed_dim=8, hidden=(16,), classes=2)
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    first = last = None
+    for epoch in range(12):
+        it.reset()
+        for batch in it:
+            csr = batch.data[0]
+            idxs, vals = csr_to_fields(csr, fields)
+            y = batch.label[0]
+            with ag.record():
+                logits = net(idxs, vals)
+                l = loss_fn(logits, y)
+                l.backward()
+            trainer.step(B)
+            last = float(l.asnumpy().mean())
+            if first is None:
+                first = last
+    assert last < first * 0.5, (first, last)
+    # the sparse path must actually be in use
+    g = net.deep_embed.weight.grad()
+    assert isinstance(g, sparse.RowSparseNDArray)
