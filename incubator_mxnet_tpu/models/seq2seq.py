@@ -1,0 +1,105 @@
+"""GNMT-style LSTM seq2seq NMT (BASELINE config 4).
+
+Parity target: Sockeye's GNMT config on the reference — multi-layer
+LSTM encoder, LSTM decoder with dot attention over encoder states,
+trained with the bucketing executor (ref: the reference provides the
+fused RNN op src/operator/rnn.cc + BucketingModule
+python/mxnet/module/bucketing_module.py; Sockeye assembles them).
+
+Two assemblies here:
+- `Seq2Seq` (Gluon): imperative/hybridizable encoder-decoder with
+  attention; bucketing happens naturally through the jit cache (one
+  executable per padded length — the TPU realisation of per-bucket
+  executors sharing memory).
+- `gnmt_sym_gen`: a Symbol generator for the legacy BucketingModule
+  path (the literal Sockeye mechanism), used by tests to exercise
+  switch_bucket.
+"""
+from __future__ import annotations
+
+from ..gluon.block import HybridBlock
+from ..gluon import nn, rnn
+
+__all__ = ["Seq2Seq", "gnmt_sym_gen"]
+
+
+class Seq2Seq(HybridBlock):
+    """Encoder-decoder with dot attention, teacher-forced training.
+
+    src/tgt: (B, T) int token ids ((B, Ts) and (B, Tt) may differ).
+    Returns logits (B, Tt, vocab)."""
+
+    def __init__(self, src_vocab, tgt_vocab, embed_dim=32, hidden=64,
+                 num_layers=2, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden = hidden
+        self.src_embed = nn.Embedding(src_vocab, embed_dim)
+        self.tgt_embed = nn.Embedding(tgt_vocab, embed_dim)
+        # TNC layout matches the fused RNN op's native layout
+        self.encoder = rnn.LSTM(hidden, num_layers=num_layers,
+                                layout="TNC")
+        self.decoder = rnn.LSTM(hidden, num_layers=num_layers,
+                                layout="TNC")
+        self.att_dense = nn.Dense(hidden, flatten=False, use_bias=False)
+        self.proj = nn.Dense(tgt_vocab, flatten=False)
+
+    def forward(self, src, tgt):
+        from .. import ndarray as F
+        enc_in = self.src_embed(src).transpose((1, 0, 2))     # (Ts, B, E)
+        B = src.shape[0]
+        enc_out, enc_states = self.encoder(
+            enc_in, self.encoder.begin_state(batch_size=B))   # (Ts, B, H)
+        dec_in = self.tgt_embed(tgt).transpose((1, 0, 2))     # (Tt, B, E)
+        # GNMT: the decoder recurrence starts from the encoder's final
+        # (h, c) so source information flows through the state path,
+        # not only through the attention readout
+        dec_out, _ = self.decoder(dec_in, enc_states)         # (Tt, B, H)
+        # dot attention: every decoder step attends over encoder states
+        q = dec_out.transpose((1, 0, 2))                      # (B, Tt, H)
+        k = enc_out.transpose((1, 0, 2))                      # (B, Ts, H)
+        scores = F.batch_dot(q, k, transpose_b=True)          # (B, Tt, Ts)
+        attn = F.softmax(scores, axis=-1)
+        ctx = F.batch_dot(attn, k)                            # (B, Tt, H)
+        mix = self.att_dense(ctx) + q
+        return self.proj(mix)                                 # (B, Tt, V)
+
+
+def gnmt_sym_gen(vocab, embed_dim=32, hidden=64, num_layers=1):
+    """Symbol generator for BucketingModule (ref: example/rnn/bucketing
+    sym_gen + Sockeye's bucketing executor): bucket_key = sequence
+    length; graph = Embedding → fused RNN(LSTM) → FC → SoftmaxOutput."""
+    from .. import symbol as sym
+    from ..ops.rnn import rnn_param_size
+
+    def sym_gen(seq_len):
+        data = sym.var("data")            # (B, T) ids
+        label = sym.var("softmax_label")  # (B, T) next-token ids
+        embed_w = sym.var("embed_weight", shape=(vocab, embed_dim))
+        emb = sym.Embedding(data, embed_w, input_dim=vocab,
+                            output_dim=embed_dim)
+        tnc = sym.transpose(emb, axes=(1, 0, 2))       # (T, B, E)
+        params = sym.var("rnn_params",
+                         shape=(rnn_param_size("lstm", num_layers,
+                                               embed_dim, hidden),))
+        # batch-size-agnostic zero initial states built from the data
+        # (the bucketing executor rebinds per bucket, so no var can
+        # carry a batch dimension)
+        zeros_tb1 = sym.slice_axis(sym.sum(emb, axis=2, keepdims=True)
+                                   * 0.0, axis=1, begin=0, end=1)
+        z1 = sym.transpose(zeros_tb1, axes=(1, 0, 2))  # (1, B, 1)
+        init = sym.broadcast_axis(z1, axis=(2,), size=(hidden,))
+        if num_layers > 1:
+            init = sym.tile(init, reps=(num_layers, 1, 1))
+        rnn_out = sym.RNN(tnc, params, init, init, mode="lstm",
+                          state_size=hidden, num_layers=num_layers)
+        btc = sym.transpose(rnn_out[0], axes=(1, 0, 2))
+        fc_w = sym.var("fc_weight", shape=(vocab, hidden))
+        fc_b = sym.var("fc_bias", shape=(vocab,))
+        logits = sym.FullyConnected(
+            sym.reshape(btc, shape=(-1, hidden)), fc_w, fc_b,
+            num_hidden=vocab)
+        out_sym = sym.SoftmaxOutput(logits,
+                                    sym.reshape(label, shape=(-1,)))
+        return out_sym, ["data"], ["softmax_label"]
+
+    return sym_gen

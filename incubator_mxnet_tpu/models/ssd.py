@@ -1,0 +1,103 @@
+"""SSD single-shot detector (BASELINE config 3).
+
+Parity target: GluonCV SSD-512 built on this framework's contrib box ops
+(ref: the reference carries the op layer — src/operator/contrib/
+multibox_prior.cc / multibox_target.cc / multibox_detection.cc — and the
+model assembly lives in example/ssd + GluonCV ssd.py; this module is the
+in-tree assembly of those ops).
+
+TPU-first notes: every stage is static-shape — anchors are computed from
+feature-map shapes at trace time, targets are vmapped matching (no
+dynamic boolean indexing), and NMS is the padded mask-based box_nms — so
+the whole train step jits into one executable.
+"""
+from __future__ import annotations
+
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+
+__all__ = ["SSD", "ssd_300", "ssd_512", "ssd_toy", "ssd_training_targets"]
+
+
+def _down_block(channels):
+    blk = nn.HybridSequential()
+    for _ in range(2):
+        blk.add(nn.Conv2D(channels, kernel_size=3, padding=1))
+        blk.add(nn.BatchNorm(in_channels=channels))
+        blk.add(nn.Activation("relu"))
+    blk.add(nn.MaxPool2D(pool_size=2))
+    return blk
+
+
+class SSD(HybridBlock):
+    """Multi-scale one-shot detector.
+
+    Returns (anchors (1, N, 4), cls_preds (B, N, classes+1),
+    box_preds (B, N*4)) — the exact tensors MultiBoxTarget /
+    MultiBoxDetection consume."""
+
+    def __init__(self, classes, base_channels=(16, 32, 64),
+                 sizes=((0.2, 0.272), (0.37, 0.447), (0.54, 0.619)),
+                 ratios=((1, 2, 0.5),) * 3, **kwargs):
+        super().__init__(**kwargs)
+        assert len(base_channels) == len(sizes) == len(ratios)
+        self._classes = classes
+        self._sizes = sizes
+        self._ratios = ratios
+        self.blocks = nn.HybridSequential()
+        self.cls_preds = nn.HybridSequential()
+        self.box_preds = nn.HybridSequential()
+        for i, ch in enumerate(base_channels):
+            self.blocks.add(_down_block(ch))
+            a = len(sizes[i]) + len(ratios[i]) - 1
+            self.cls_preds.add(nn.Conv2D(a * (classes + 1), kernel_size=3,
+                                         padding=1))
+            self.box_preds.add(nn.Conv2D(a * 4, kernel_size=3, padding=1))
+
+    def forward(self, x):
+        from .. import ndarray as F
+        B = x.shape[0]
+        anchors, cls_outs, box_outs = [], [], []
+        feat = x
+        for i in range(len(self._sizes)):
+            feat = self.blocks[i](feat)
+            anchors.append(F.MultiBoxPrior(feat, sizes=self._sizes[i],
+                                           ratios=self._ratios[i]))
+            c = self.cls_preds[i](feat)
+            cls_outs.append(c.transpose((0, 2, 3, 1)).reshape(
+                (B, -1, self._classes + 1)))
+            b = self.box_preds[i](feat)
+            box_outs.append(b.transpose((0, 2, 3, 1)).reshape((B, -1)))
+        anchors = F.concat(*anchors, dim=1)             # (1, N, 4)
+        cls_preds = F.concat(*cls_outs, dim=1)          # (B, N, C+1)
+        box_preds = F.concat(*box_outs, dim=1)          # (B, N*4)
+        return anchors, cls_preds, box_preds
+
+
+def ssd_training_targets(anchors, cls_preds, labels):
+    """MultiBoxTarget front (ref: example/ssd training_targets)."""
+    from .. import ndarray as F
+    return F.MultiBoxTarget(anchors, labels,
+                            cls_preds.transpose((0, 2, 1)))
+
+
+def ssd_toy(classes=1, **kwargs):
+    """Small config for tests/smokes (32×32 inputs)."""
+    return SSD(classes, base_channels=(8, 16), sizes=((0.2, 0.3),
+                                                      (0.5, 0.6)),
+               ratios=((1, 2, 0.5),) * 2, **kwargs)
+
+
+def ssd_300(classes=20, **kwargs):
+    return SSD(classes, base_channels=(32, 64, 128, 128),
+               sizes=((0.1, 0.141), (0.2, 0.272), (0.37, 0.447),
+                      (0.54, 0.619)),
+               ratios=((1, 2, 0.5),) * 4, **kwargs)
+
+
+def ssd_512(classes=20, **kwargs):
+    """Config-3 headline geometry (512×512 input)."""
+    return SSD(classes, base_channels=(32, 64, 128, 128, 256),
+               sizes=((0.07, 0.1), (0.15, 0.222), (0.3, 0.367),
+                      (0.45, 0.519), (0.6, 0.671)),
+               ratios=((1, 2, 0.5),) * 5, **kwargs)

@@ -327,7 +327,7 @@ _bn_train.defvjp(_bn_train_core_fwd, _bn_train_core_bwd)
 @register("BatchNorm",
           ndarray_inputs=("data", "gamma", "beta", "moving_mean",
                           "moving_var"),
-          num_outputs=3)
+          num_outputs=3, visible_outputs=1)
 def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                momentum=0.9, fix_gamma=True, use_global_stats=False,
                output_mean_var=False, axis=1, cudnn_off=False,
@@ -445,16 +445,71 @@ def embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
 # ---------------------------------------------------------------------------
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8))
+def _softmax_output_cvjp(data, label, grad_scale, ignore_label,
+                         use_ignore, normalization, multi_output,
+                         smooth_alpha, out_grad):
+    return jax.nn.softmax(data, axis=1 if multi_output else -1)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label,
+                        use_ignore, normalization, multi_output,
+                        smooth_alpha, out_grad):
+    p = jax.nn.softmax(data, axis=1 if multi_output else -1)
+    return p, (p, label)
+
+
+def _softmax_output_bwd(grad_scale, ignore_label, use_ignore,
+                        normalization, multi_output, smooth_alpha,
+                        out_grad, res, cot):
+    # reference semantics: the head IS the loss — the data gradient is
+    # (softmax − one_hot(label)) * grad_scale, independent of the
+    # incoming cotangent unless out_grad=True scales by it
+    # (ref: SoftmaxOutputOp::Backward)
+    p, label = res
+    axis = 1 if multi_output else -1
+    C = p.shape[axis]
+    oh = jax.nn.one_hot(label.astype(jnp.int32), C, dtype=p.dtype,
+                        axis=axis)
+    if smooth_alpha:
+        # label smoothing: true class 1−α, the rest α/(C−1)
+        oh = oh * (1.0 - smooth_alpha) \
+            + (1.0 - oh) * (smooth_alpha / max(C - 1, 1))
+    g = (p - oh) * grad_scale
+    if use_ignore:
+        keep = (label != ignore_label).astype(p.dtype)
+        g = g * jnp.expand_dims(keep, axis)
+    if normalization == "batch":
+        g = g / p.shape[0]
+    elif normalization == "valid":
+        if use_ignore:
+            n = jnp.maximum(jnp.sum(label != ignore_label), 1)
+        else:
+            n = label.size
+        g = g / jnp.asarray(n, p.dtype)
+    if out_grad:
+        g = g * cot
+    return g, jnp.zeros_like(label)
+
+
+_softmax_output_cvjp.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
 @register("SoftmaxOutput", ndarray_inputs=("data", "label"),
           nograd_argnums=(1,))
 def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
                    multi_output=False, use_ignore=False, preserve_shape=False,
                    normalization="null", out_grad=False,
                    smooth_alpha=0.0):
-    """ref: src/operator/softmax_output-inl.h.  Forward = softmax; the
-    custom backward (softmax − one_hot(label)) is registered via the
-    autograd layer's custom-grad hook in the NDArray stub."""
-    return jax.nn.softmax(data, axis=-1)
+    """ref: src/operator/softmax_output-inl.h.  Forward = softmax
+    (axis 1 when multi_output else last axis); backward is the op's own
+    rule (softmax − smoothed one_hot), attached via jax.custom_vjp so
+    EVERY consumer — imperative tape, executor vjp, hybridized graphs —
+    gets the reference gradient."""
+    return _softmax_output_cvjp(data, label, float(grad_scale),
+                                float(ignore_label), bool(use_ignore),
+                                str(normalization), bool(multi_output),
+                                float(smooth_alpha), bool(out_grad))
 
 
 @register("smooth_l1", ndarray_inputs=("data",))

@@ -29,13 +29,16 @@ class OpDef:
     """Metadata record for one operator."""
 
     __slots__ = ("name", "fn", "ndarray_inputs", "differentiable",
-                 "num_outputs", "doc", "needs_rng", "needs_training",
-                 "nograd_argnums", "sparse_invoke")
+                 "num_outputs", "visible_outputs", "num_outputs_fn",
+                 "doc", "needs_rng", "needs_training", "nograd_argnums",
+                 "sparse_invoke")
 
     def __init__(self, name: str, fn: Callable, *,
                  ndarray_inputs: Optional[Sequence[str]] = None,
                  differentiable: bool = True,
                  num_outputs: int = 1,
+                 visible_outputs: Optional[int] = None,
+                 num_outputs_fn: Optional[Callable] = None,
                  needs_rng: bool = False,
                  nograd_argnums: Sequence[int] = ()):
         import inspect
@@ -44,6 +47,15 @@ class OpDef:
         self.ndarray_inputs = tuple(ndarray_inputs) if ndarray_inputs else None
         self.differentiable = differentiable
         self.num_outputs = num_outputs
+        # NNVM FNumVisibleOutputs analogue: outputs beyond this count
+        # are aux-only (e.g. BatchNorm mean/var) — a bare symbol with
+        # ONE visible output composes as that output
+        self.visible_outputs = (num_outputs if visible_outputs is None
+                                else visible_outputs)
+        # variadic ops (num_outputs == -1) whose count is statically
+        # derivable from attrs provide a resolver attrs -> int so the
+        # Symbol layer can build output views (nnvm FNumOutputs)
+        self.num_outputs_fn = num_outputs_fn
         try:
             params = inspect.signature(fn).parameters
         except (TypeError, ValueError):

@@ -136,8 +136,19 @@ def _run_layer(x, h0, c0, wx, wh, bx, bh, mode, reverse=False):
     return ys, hT, cT
 
 
+def _rnn_num_outputs(attrs):
+    """y always; +h (and +c for lstm) when state_outputs (default on,
+    as gluon.rnn_layer calls it)."""
+    so = attrs.get("state_outputs", True)
+    if isinstance(so, str):
+        so = so.lower() not in ("false", "0")
+    if not so:
+        return 1
+    return 3 if str(attrs.get("mode", "lstm")) == "lstm" else 2
+
+
 @register("RNN", ndarray_inputs=("data", "parameters", "state", "state_cell"),
-          num_outputs=-1, needs_rng=True)
+          num_outputs=-1, num_outputs_fn=_rnn_num_outputs, needs_rng=True)
 def rnn(data, parameters, state, state_cell=None, state_size=0,
         num_layers=1, bidirectional=False, mode="lstm", p=0.0,
         state_outputs=True, projection_size=None, use_sequence_length=False,

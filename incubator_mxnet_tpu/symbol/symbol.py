@@ -162,8 +162,8 @@ class Symbol:
                 in_shapes = []
                 for i in node.inputs:
                     s = node_shape.get(id(i))
-                    if i._out_index is not None and isinstance(s, list):
-                        s = s[i._out_index]
+                    if isinstance(s, list):
+                        s = _select_input(node, i, s)
                     in_shapes.append(s)
                 if any(s is None for s in in_shapes):
                     rule = _PARAM_SHAPE_RULES.get(node.op)
@@ -398,8 +398,47 @@ def _auto_name(op):
 def _apply(opname, inputs, attrs, name=None):
     od = _registry.get(opname)
     n_out = od.num_outputs
+    if n_out == -1:
+        # variadic: the op's resolver (RNN) or its own num_outputs attr
+        # (split/SliceChannel) names the count; otherwise the node
+        # stays single-output and composes via its first output
+        if od.num_outputs_fn is not None:
+            n_out = int(od.num_outputs_fn(attrs))
+        else:
+            try:
+                n_out = int(attrs.get("num_outputs", 1))
+            except (TypeError, ValueError):
+                n_out = 1
     return Symbol(opname, inputs, attrs, name or _auto_name(opname),
-                  num_outputs=n_out if n_out > 0 else 1)
+                  num_outputs=max(n_out, 1))
+
+
+def _select_input(consumer, producer, value):
+    """Pick the single value `consumer` receives from a multi-valued
+    `producer`: a view selects its output; a bare variadic node
+    (num_outputs known only at eval, e.g. RNN) or a node with ONE
+    visible output (aux-only extras, e.g. BatchNorm mean/var — NNVM
+    FNumVisibleOutputs) feeds output 0; any other bare multi-output
+    node is a user error and fails loudly."""
+    if producer._out_index is not None:
+        return value[producer._out_index]
+    if producer.op is not None and producer.op != "_group":
+        try:
+            od = _registry.get(producer.op)
+        except Exception:
+            od = None
+        if od is not None and (od.visible_outputs == 1
+                               or (od.num_outputs == -1
+                                   and producer.num_outputs == 1)):
+            # aux-only extras (BatchNorm mean/var) or an unresolved
+            # variadic whose main output is 0 (RNN) — feed output 0;
+            # resolved variadics (split, num_outputs attr) fall through
+            # to the loud failure like any visible multi-output node
+            return value[0]
+    raise MXNetError(
+        "op %s (%s): multi-output symbol %s used as a single input; "
+        "select an output explicitly (e.g. sym[0])"
+        % (consumer.op, consumer.name, producer.name))
 
 
 def apply_stub_args(opname, args, kwargs):
@@ -497,6 +536,7 @@ def _eval_symbol(sym, feed, raw=False):
         return x._data if isinstance(x, NDArray) else x
 
     cache: Dict[int, object] = {}
+    comp_cache: Dict[tuple, object] = {}  # one execution per base node
     order = sym._topo()
     for node in order:
         if node.op is None:
@@ -506,11 +546,31 @@ def _eval_symbol(sym, feed, raw=False):
         elif node.op == "_group":
             continue
         else:
+            # output VIEWS carry their base node's (op, name, input
+            # symbols, attrs), so this key identifies the base
+            # computation: each multi-output producer executes ONCE and
+            # every view reads the same result — essential for RNG ops
+            # (RNN dropout), where per-view re-execution would hand the
+            # consumer states from different stochastic passes.  The
+            # name keeps two distinct-but-identical nodes (e.g. two
+            # Dropout(x) calls, auto-named apart) from collapsing.
+            # Single-output nodes have no views and are skipped — both
+            # to avoid the key-build overhead and so two same-named
+            # single-output RNG nodes keep independent draws.
+            ckey = None
+            if node.num_outputs > 1:
+                ckey = (node.op, node.name,
+                        tuple(id(i) for i in node.inputs),
+                        tuple(sorted((k, str(v))
+                                     for k, v in node.attrs.items())))
+                if ckey in comp_cache:
+                    cache[id(node)] = comp_cache[ckey]
+                    continue
             ins = []
             for i in node.inputs:
                 v = cache[id(i)]
-                if i._out_index is not None and isinstance(v, tuple):
-                    v = v[i._out_index]
+                if isinstance(v, (tuple, list)):
+                    v = _select_input(node, i, v)
                 ins.append(v)
             attrs = dict(node.attrs)
             if raw:
@@ -520,6 +580,8 @@ def _eval_symbol(sym, feed, raw=False):
             else:
                 out = invoke(node.op, *ins, **attrs)
             cache[id(node)] = out
+            if ckey is not None:
+                comp_cache[ckey] = out
 
     def fetch(node):
         v = cache[id(node)]

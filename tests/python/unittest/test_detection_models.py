@@ -1,0 +1,83 @@
+"""SSD detector (BASELINE config 3) — training + detection smokes
+(ref test model: example/ssd train/evaluate flow + GluonCV ssd tests)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon, autograd as ag
+from incubator_mxnet_tpu.models.ssd import ssd_toy, ssd_training_targets
+
+
+def _toy_batch(rs, B=8, size=32):
+    """Images with one bright axis-aligned square; label = its box."""
+    x = rs.rand(B, 3, size, size).astype(np.float32) * 0.1
+    labels = np.full((B, 1, 5), -1, np.float32)
+    for b in range(B):
+        w = rs.randint(8, 16)
+        x0 = rs.randint(0, size - w)
+        y0 = rs.randint(0, size - w)
+        x[b, :, y0:y0 + w, x0:x0 + w] = 1.0
+        labels[b, 0] = [0, x0 / size, y0 / size, (x0 + w) / size,
+                       (y0 + w) / size]
+    return nd.array(x), nd.array(labels)
+
+
+def test_ssd_forward_shapes():
+    net = ssd_toy(classes=1)
+    net.initialize()
+    x = nd.array(np.random.rand(2, 3, 32, 32).astype(np.float32))
+    anchors, cls_preds, box_preds = net(x)
+    N = anchors.shape[1]
+    assert anchors.shape == (1, N, 4)
+    assert cls_preds.shape == (2, N, 2)
+    assert box_preds.shape == (2, N * 4)
+    # anchors cover multiple scales: 16x16*4 + 8x8*4
+    assert N == 16 * 16 * 4 + 8 * 8 * 4
+
+
+def test_ssd_training_targets_and_convergence():
+    mx.random.seed(7)   # unseeded init + sgd momentum diverges for rare draws
+    rs = np.random.RandomState(0)
+    net = ssd_toy(classes=1)
+    net.initialize()
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.02, "momentum": 0.9})
+    x, labels = _toy_batch(rs)
+    first = last = None
+    for step in range(25):
+        with ag.record():
+            anchors, cls_preds, box_preds = net(x)
+            loc_t, loc_m, cls_t = ssd_training_targets(anchors, cls_preds,
+                                                       labels)
+            B, N = cls_t.shape
+            l_cls = cls_loss(cls_preds.reshape((B * N, -1)),
+                             cls_t.reshape((-1,)))
+            l_box = (nd.smooth_l1(box_preds - loc_t) * loc_m).mean()
+            l = l_cls + l_box
+            l.backward()
+        trainer.step(x.shape[0])
+        last = float(l.asnumpy().mean())
+        if first is None:
+            first = last
+    assert last < first * 0.7, (first, last)
+    # positive anchors exist for every image (force matching)
+    assert (cls_t.asnumpy() > 0).sum() >= x.shape[0]
+
+
+def test_ssd_detection_output():
+    net = ssd_toy(classes=1)
+    net.initialize()
+    x = nd.array(np.random.rand(2, 3, 32, 32).astype(np.float32))
+    anchors, cls_preds, box_preds = net(x)
+    cls_prob = nd.softmax(cls_preds, axis=-1).transpose((0, 2, 1))
+    det = nd.MultiBoxDetection(cls_prob, box_preds, anchors,
+                               nms_threshold=0.5, threshold=0.01)
+    B, N, C = det.shape
+    assert C == 6                       # [cls, score, x1, y1, x2, y2]
+    d = det.asnumpy()
+    # a surviving detection has BOTH a class id and a score; NMS marks
+    # suppressed rows with score -1
+    valid = d[(d[:, :, 0] >= 0) & (d[:, :, 1] >= 0)]
+    assert len(valid), "no detections survived NMS on random scores"
+    assert (valid[:, 1] >= 0).all() and (valid[:, 1] <= 1).all()
+    assert (valid[:, 2:] >= 0).all() and (valid[:, 2:] <= 1).all()

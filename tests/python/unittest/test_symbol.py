@@ -131,3 +131,82 @@ def test_bucketing_module():
     b10 = DataBatch([nd.ones((2, 10))], bucket_key=10)
     bm.forward(b10, is_train=False)
     assert bm.get_outputs()[0].shape == (2, 4)
+
+
+def test_multi_output_composition_rules():
+    """A bare BatchNorm (aux mean/var outputs, visible_outputs=1)
+    composes as its first output — the reference idiom
+    Activation(BatchNorm(x)); a bare VISIBLE multi-output symbol
+    (bipartite_matching) fails loudly instead of silently feeding
+    output 0 (ref: nnvm FNumVisibleOutputs)."""
+    import pytest
+    from incubator_mxnet_tpu.base import MXNetError
+
+    data = sym.var("data", shape=(2, 4))
+    bn = sym.BatchNorm(data, sym.var("g"), sym.var("b"),
+                       sym.var("m"), sym.var("v"))
+    act = sym.relu(bn)
+    out = act.eval(data=nd.ones((2, 4)), g=nd.ones((4,)),
+                   b=nd.zeros((4,)), m=nd.zeros((4,)), v=nd.ones((4,)))
+    out = out[0] if isinstance(out, list) else out
+    assert out.shape == (2, 4)
+    shapes, _, _ = act.infer_shape(data=(2, 4))
+    assert (2, 4) in [tuple(s) for s in shapes]
+
+    match = sym.bipartite_matching(sym.var("q"), threshold=0.5)
+    bad = sym.relu(match)
+    with pytest.raises(MXNetError, match="multi-output"):
+        bad.eval(q=nd.ones((1, 3, 3)))
+
+    # variadic split resolves its count from the num_outputs attr:
+    # views select, bare composition fails loudly, json round-trips
+    x = sym.var("x")
+    s = sym.split(x, num_outputs=2, axis=1)
+    xa = nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    h1 = sym.relu(s[1]).eval(x=xa)[0]
+    assert_almost_equal(h1, xa.asnumpy()[:, 2:])
+    with pytest.raises(MXNetError, match="multi-output"):
+        sym.relu(s).eval(x=xa)
+    h2 = sym.load_json(sym.relu(s[1]).tojson()).eval(x=xa)[0]
+    assert_almost_equal(h2, xa.asnumpy()[:, 2:])
+
+    # RNN resolves its output count from mode/state_outputs, so the
+    # state outputs are reachable as views (ref: nnvm FNumOutputs)
+    from incubator_mxnet_tpu.ops.rnn import rnn_param_size
+    xr = sym.var("xr")
+    pr = sym.var("pr")
+    h0 = sym.var("h0")
+    c0 = sym.var("c0")
+    r = sym.RNN(xr, pr, h0, c0, mode="lstm", state_size=5, num_layers=1)
+    assert r.num_outputs == 3
+    feed = dict(xr=nd.ones((3, 2, 4)),
+                pr=nd.ones((rnn_param_size("lstm", 1, 4, 5),)),
+                h0=nd.zeros((1, 2, 5)), c0=nd.zeros((1, 2, 5)))
+    assert sym.relu(r[1]).eval(**feed)[0].shape == (1, 2, 5)
+    with pytest.raises(MXNetError, match="multi-output"):
+        sym.relu(r).eval(**feed)
+
+
+def test_multi_output_single_execution():
+    """Every view of a multi-output node reads ONE execution of the op
+    (nnvm graph semantics) — critical for RNG ops, where re-running per
+    view would pair outputs from different stochastic passes."""
+    import incubator_mxnet_tpu.ops.registry as reg
+
+    od = reg.get("split")
+    orig, calls = od.fn, {"n": 0}
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    od.fn = counting
+    try:
+        x = sym.var("x")
+        s = sym.split(x, num_outputs=2, axis=1)
+        outs = sym.Group([sym.relu(s[0]), sym.relu(s[1])]).eval(
+            x=nd.ones((2, 4)))
+    finally:
+        od.fn = orig
+    assert calls["n"] == 1, calls
+    assert [o.shape for o in outs] == [(2, 2), (2, 2)]
