@@ -45,6 +45,10 @@ from .symbol import Symbol
 from . import module as mod
 from . import module
 from . import parallel
+from . import contrib
+from . import callback
+from . import monitor
+from .monitor import Monitor
 from .util import is_np_array, set_np, reset_np
 
 __all__ = ["MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",

@@ -28,7 +28,7 @@ from .base import MXNetError
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "backward", "grad", "mark_variables",
-           "set_recording", "set_training", "get_symbol"]
+           "set_recording", "set_training", "get_symbol", "Function"]
 
 
 class _State(threading.local):
@@ -110,9 +110,10 @@ class Node:
     """
 
     __slots__ = ("vjp_fn", "inputs", "n_out", "out_shapes", "out_dtypes",
-                 "name", "out_is_tuple")
+                 "name", "out_is_tuple", "raw_fn")
 
-    def __init__(self, vjp_fn, inputs, outputs, name="", out_is_tuple=False):
+    def __init__(self, vjp_fn, inputs, outputs, name="", out_is_tuple=False,
+                 raw_fn=None):
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)          # NDArray refs (graph edges)
         self.n_out = len(outputs)
@@ -120,6 +121,10 @@ class Node:
         self.out_dtypes = [o.dtype for o in outputs]
         self.name = name
         self.out_is_tuple = out_is_tuple
+        # the pure forward fn on raw arrays (attrs closed over): kept so
+        # create_graph backward can RE-RECORD the pullback application
+        # as a differentiable op (jax re-linearizes at the saved inputs)
+        self.raw_fn = raw_fn
 
 
 def _is_float0(x):
@@ -180,10 +185,12 @@ def _densify_cot(c):
     return c.tostype("default")._data if _is_rsp(c) else c
 
 
-def record_op(vjp_fn, input_nds, output_nds, name="", out_is_tuple=False):
+def record_op(vjp_fn, input_nds, output_nds, name="", out_is_tuple=False,
+              raw_fn=None):
     """Attach a tape node linking inputs → outputs. Called by the NDArray
     dispatch layer when recording is on and ≥1 input is tracked."""
-    node = Node(vjp_fn, input_nds, output_nds, name, out_is_tuple)
+    node = Node(vjp_fn, input_nds, output_nds, name, out_is_tuple,
+                raw_fn=raw_fn)
     for i, o in enumerate(output_nds):
         o._tape_node = node
         o._out_index = i
@@ -193,6 +200,35 @@ def record_op(vjp_fn, input_nds, output_nds, name="", out_is_tuple=False):
 # ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
+
+
+def _seed_cotangents(heads, head_grads, default_grad, unwrap, api):
+    """Normalise heads/head_grads, validate lengths, and build the root
+    node list plus the initial cotangent map keyed by
+    (id(node), out_index). `default_grad(h)` makes the ones-cotangent
+    for a bare head; `unwrap(hg)` adapts a user-given gradient."""
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+    if len(head_grads) != len(heads):
+        raise MXNetError(
+            "%s: %d head gradients for %d heads"
+            % (api, len(head_grads), len(heads)))
+    root_nodes, cot = [], {}
+    for h, hg in zip(heads, head_grads):
+        node = h._tape_node
+        if node is None:
+            raise MXNetError(
+                "cannot differentiate: output was not computed while "
+                "recording (is autograd.record() active?)")
+        root_nodes.append(node)
+        g = default_grad(h) if hg is None else unwrap(hg)
+        key = (id(node), h._out_index)
+        cot[key] = cot[key] + g if key in cot else g
+    return root_nodes, cot
 
 
 def _topo_order(root_nodes):
@@ -223,25 +259,10 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
     accumulates into leaves' `.grad` per their grad_req.
     """
     import jax.numpy as jnp
-    if not isinstance(heads, (list, tuple)):
-        heads = [heads]
-    if head_grads is None:
-        head_grads = [None] * len(heads)
-    elif not isinstance(head_grads, (list, tuple)):
-        head_grads = [head_grads]
-
-    root_nodes = []
-    cot = {}               # (id(node), out_idx) -> jax array cotangent
-    for h, hg in zip(heads, head_grads):
-        node = h._tape_node
-        if node is None:
-            raise MXNetError(
-                "cannot differentiate: output was not computed while "
-                "recording (is autograd.record() active?)")
-        root_nodes.append(node)
-        g = _ones_const(h.shape, h.dtype) if hg is None else hg._data
-        key = (id(node), h._out_index)
-        cot[key] = cot[key] + g if key in cot else g
+    root_nodes, cot = _seed_cotangents(
+        heads, head_grads,
+        default_grad=lambda h: _ones_const(h.shape, h.dtype),
+        unwrap=lambda hg: hg._data, api="backward")
 
     order = _topo_order(root_nodes)
 
@@ -341,15 +362,116 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
     return None
 
 
+def _backward_create_graph(heads, head_grads, variables, train_mode,
+                           retain_graph=True):
+    """Differentiable backward (ref: autograd.grad(create_graph=True)).
+
+    The pullback of each tape node is RE-APPLIED as a recorded op: the
+    node's saved `raw_fn` is re-linearised (jax.vjp) at its original
+    inputs inside a fresh dispatch, so the returned gradients are
+    themselves tape-tracked NDArrays whose graph reaches back through
+    BOTH the cotangent path and the original inputs — exactly what a
+    second `backward()` needs."""
+    import jax.numpy as jnp
+    from .ndarray import NDArray
+    from .ndarray.ndarray import apply_fn
+
+    if not isinstance(variables, (list, tuple)):
+        variables = [variables]
+    root_nodes, cot = _seed_cotangents(
+        heads, head_grads,
+        default_grad=lambda h: NDArray(_ones_const(h.shape, h.dtype)),
+        unwrap=lambda hg: hg, api="grad")
+
+    order = _topo_order(root_nodes)
+    var_ids = {id(v) for v in variables}
+    var_grads = {}
+
+    with _RecordingStateScope(True, train_mode):
+        for node in reversed(order):
+            active = [i for i in range(node.n_out)
+                      if (id(node), i) in cot and
+                      jnp.issubdtype(node.out_dtypes[i], jnp.inexact)]
+            if not active:
+                for i in range(node.n_out):
+                    cot.pop((id(node), i), None)
+                continue
+            if node.raw_fn is None:
+                raise NotImplementedError(
+                    "create_graph=True through %r: this node recorded "
+                    "only an opaque pullback (hybridized block or custom "
+                    "Function); run the forward unhybridized" % node.name)
+            active_cots = [cot.pop((id(node), i)) for i in active]
+            float_in = [k for k, inp in enumerate(node.inputs)
+                        if jnp.issubdtype(inp.dtype, jnp.inexact)]
+            raw_fn = node.raw_fn
+            n_in = len(node.inputs)
+            n_out, shapes, dtypes = (node.n_out, node.out_shapes,
+                                     node.out_dtypes)
+            multi = node.out_is_tuple
+
+            def bwd_composite(*arrs, _raw=raw_fn, _n_in=n_in,
+                              _n_out=n_out, _shapes=shapes,
+                              _dtypes=dtypes, _active=tuple(active),
+                              _float_in=tuple(float_in), _multi=multi):
+                xs, cs = arrs[:_n_in], arrs[_n_in:]
+                _, pb = jax.vjp(_raw, *xs)
+                full, j = [], 0
+                for i in range(_n_out):
+                    if i in _active:
+                        full.append(cs[j])
+                        j += 1
+                    elif not jnp.issubdtype(_dtypes[i], jnp.inexact):
+                        full.append(_np.zeros(_shapes[i],
+                                              jax.dtypes.float0))
+                    else:
+                        full.append(jnp.zeros(_shapes[i], _dtypes[i]))
+                in_cots = pb(tuple(full) if _multi else full[0])
+                return tuple(in_cots[k] for k in _float_in)
+
+            outs = apply_fn(bwd_composite,
+                            list(node.inputs) + active_cots, {},
+                            name=(node.name or "op") + "_backward")
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            for k, icnd in zip(float_in, outs):
+                inp = node.inputs[k]
+                pn = inp._tape_node
+                if pn is not None:
+                    key = (id(pn), inp._out_index)
+                    cot[key] = cot[key] + icnd if key in cot else icnd
+                elif id(inp) in var_ids:
+                    key = id(inp)
+                    var_grads[key] = (var_grads[key] + icnd
+                                      if key in var_grads else icnd)
+
+    if not retain_graph:
+        # honour an explicit retain_graph=False: free the forward
+        # residuals now; a later backward() through the returned grads
+        # will fail loudly instead of silently pinning device memory
+        for node in order:
+            node.vjp_fn = None
+            node.raw_fn = None
+
+    out = []
+    for v in variables:
+        g = var_grads.get(id(v))
+        if g is None:
+            g = NDArray(_zeros_const(v.shape, v.dtype))
+        out.append(g)
+    return out
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
-    """ref: python/mxnet/autograd.py grad(). Higher-order (create_graph)
-    is deferred to a later round — the jax machinery supports it but the
-    tape would need to record pullback applications."""
-    if create_graph:
-        raise NotImplementedError("create_graph=True not yet supported")
+    """ref: python/mxnet/autograd.py grad(). With create_graph=True the
+    returned gradients are tape-tracked, so a second backward() through
+    them yields higher-order gradients."""
     if retain_graph is None:
         retain_graph = create_graph
+    if create_graph:
+        return _backward_create_graph(heads, head_grads, variables,
+                                      train_mode, retain_graph)
     return backward(heads, head_grads, retain_graph=retain_graph,
                     train_mode=train_mode, variables=variables)
 
@@ -367,3 +489,75 @@ def get_symbol(x):
     raise NotImplementedError(
         "autograd.get_symbol: the TPU build records jax pullbacks, not nnvm "
         "graphs; use HybridBlock.export for a serialisable graph")
+
+
+class Function:
+    """User-defined differentiable operation (ref: python/mxnet/
+    autograd.py Function + src/operator/custom/custom.cc CustomOp).
+
+    Subclass, implement `forward(*inputs)` and
+    `backward(*output_grads)`, then call the instance like a function::
+
+        class sigmoid(autograd.Function):
+            def forward(self, x):
+                y = 1 / (1 + nd.exp(-x))
+                self.save_for_backward(y)
+                return y
+            def backward(self, dy):
+                y, = self.saved_tensors
+                return dy * y * (1 - y)
+
+    Both methods run with autograd paused (the reference runs CustomOp
+    bodies outside the recording scope); the instance is recorded on the
+    tape as ONE node whose pullback calls `backward`.  `backward` must
+    return one gradient per NDArray input (None for non-differentiable
+    inputs)."""
+
+    def __init__(self):
+        self.saved_tensors = ()
+
+    def save_for_backward(self, *args):
+        self.saved_tensors = args
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+        nd_inputs = [a for a in inputs if isinstance(a, NDArray)]
+        with pause():
+            outputs = self.forward(*inputs)
+        multi = isinstance(outputs, (list, tuple))
+        outs = tuple(outputs) if multi else (outputs,)
+        if is_recording() and any(_requires_tracking(a)
+                                  for a in nd_inputs):
+            ctx = nd_inputs[0].context if nd_inputs else None
+
+            def vjp_fn(cot, _self=self, _n=len(nd_inputs)):
+                cots = cot if isinstance(cot, tuple) else (cot,)
+                ograds = [NDArray(c, ctx=ctx) for c in cots]
+                with pause():
+                    igrads = _self.backward(*ograds)
+                if not isinstance(igrads, (list, tuple)):
+                    igrads = [igrads]
+                if len(igrads) != _n:
+                    raise MXNetError(
+                        "%s.backward returned %d gradients for %d "
+                        "array inputs" % (type(_self).__name__,
+                                          len(igrads), _n))
+                raw = []
+                for g, inp in zip(igrads, nd_inputs):
+                    if g is None:       # non-differentiable input
+                        raw.append(_np.zeros(inp.shape,
+                                             jax.dtypes.float0))
+                    else:
+                        raw.append(g._data if isinstance(g, NDArray)
+                                   else g)
+                return raw
+
+            record_op(vjp_fn, nd_inputs, outs,
+                      name=type(self).__name__, out_is_tuple=multi)
+        return outputs

@@ -1,0 +1,122 @@
+"""Training callbacks (ref: python/mxnet/callback.py).
+
+Batch-end callbacks receive a `BatchEndParam`-shaped object with
+`.epoch`, `.nbatch`, `.eval_metric`; epoch-end checkpoint callbacks
+receive `(epoch, symbol, arg_params, aux_params)` — both contracts
+match `Module.fit`'s call sites.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+__all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
+           "Speedometer", "ProgressBar", "LogValidationMetricsCallback"]
+
+
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    """Epoch-end callback saving the module's checkpoint every `period`
+    epochs (ref: callback.module_checkpoint)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+    return _callback
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end callback saving (symbol, params) via
+    `module.save_checkpoint`-compatible files (ref: callback.do_checkpoint)."""
+    from .module.module import save_checkpoint_params
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym, arg_params, aux_params):
+        if (iter_no + 1) % period == 0:
+            save_checkpoint_params(prefix, iter_no + 1, sym, arg_params,
+                                   aux_params)
+    return _callback
+
+
+def log_train_metric(period, auto_reset=False):
+    """Batch-end callback logging the metric every `period` batches
+    (ref: callback.log_train_metric)."""
+    def _callback(param):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            for name, value in name_value:
+                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                             param.epoch, param.nbatch, name, value)
+            if auto_reset:
+                param.eval_metric.reset()
+    return _callback
+
+
+class Speedometer:
+    """Log samples/sec (and metric) every `frequent` batches
+    (ref: callback.Speedometer)."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
+        self.batch_size = batch_size
+        self.frequent = int(frequent)
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+        self.last_speed = 0.0       # exposed for tests/driver scraping
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False       # new epoch
+        self.last_count = count
+
+        if not self.init:
+            self.init = True
+            self.tic = time.time()
+            return
+        if count % self.frequent != 0:
+            return
+        elapsed = time.time() - self.tic
+        speed = (self.frequent * self.batch_size / elapsed
+                 if elapsed > 0 else float("inf"))
+        self.last_speed = speed
+        if param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            if self.auto_reset:
+                param.eval_metric.reset()
+            msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s" % (
+                param.epoch, count, speed,
+                "\t".join("%s=%f" % nv for nv in name_value))
+        else:
+            msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec" % (
+                param.epoch, count, speed)
+        logging.info(msg)
+        self.tic = time.time()
+
+
+class ProgressBar:
+    """Text progress bar for a known batch count (ref: callback.ProgressBar)."""
+
+    def __init__(self, total, length=80):
+        self.bar_len = length
+        self.total = total
+
+    def __call__(self, param):
+        count = param.nbatch
+        filled_len = int(round(self.bar_len * count / float(self.total)))
+        percents = int(round(100.0 * count / float(self.total)))
+        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
+        logging.info("[%s] %s%s", prog_bar, percents, "%")
+
+
+class LogValidationMetricsCallback:
+    """Eval-end callback logging validation metrics
+    (ref: callback.LogValidationMetricsCallback)."""
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f",
+                         param.epoch, name, value)

@@ -1,0 +1,8 @@
+"""mx.contrib namespace (ref: python/mxnet/contrib/__init__.py).
+
+Subpackages land as they are built: `amp` (automatic mixed precision),
+`quantization` (int8 inference).
+"""
+from . import amp
+
+__all__ = ["amp"]

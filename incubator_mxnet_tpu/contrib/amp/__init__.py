@@ -1,0 +1,209 @@
+"""Automatic mixed precision (ref: python/mxnet/contrib/amp/amp.py).
+
+TPU-first redesign: the reference rewrites the symbol graph, inserting
+`amp_cast`/`amp_multicast` nodes around listed ops.  Here the cast
+policy lives at the ONE dispatch point every consumer shares — the op
+registry: `init()` wraps each listed op's pure function so float32
+inputs are cast to the target dtype (TARGET_DTYPE_OPS feed the MXU in
+bfloat16) or low-precision floats are cast up (FP32_OPS).  Because the
+wrap happens below `invoke`, the imperative path, symbol eval, AND
+hybridized jit traces all see the same policy, and XLA folds the casts
+into the surrounding fusions — zero extra HBM traffic.
+
+Default target is bfloat16 (TPU-native: same exponent range as f32, so
+no loss scaling needed); float16 is supported for parity, paired with
+the dynamic `LossScaler`.
+"""
+from __future__ import annotations
+
+import functools
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from . import lists
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_hybrid_block", "convert_model", "LossScaler", "lists"]
+
+_CURRENT = {"target": None, "orig": {}}   # opname -> original fn
+
+
+def _is_float_array(a, dtypes):
+    dt = getattr(a, "dtype", None)
+    if dt is None:
+        return False
+    try:
+        return any(dt == d for d in dtypes)
+    except TypeError:
+        return False
+
+
+def _wrap_cast(fn, to_dtype, from_dtypes):
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        args = tuple(jnp.asarray(a, to_dtype)
+                     if _is_float_array(a, from_dtypes) else a
+                     for a in args)
+        return fn(*args, **kwargs)
+    return wrapped
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Turn AMP on process-wide (ref: amp.init()).
+
+    target_precision_ops / fp32_ops extend (not replace) the built-in
+    lists; conditional_fp32_ops is accepted for API parity and folded
+    into fp32_ops (the TPU build has no per-attr conditions yet)."""
+    from ...ops import registry as _reg
+
+    target = jnp.dtype(target_dtype)
+    if _CURRENT["target"] is not None:
+        if jnp.dtype(_CURRENT["target"]) == target:
+            return
+        _restore()
+
+    target_list = list(lists.TARGET_DTYPE_OPS) + list(
+        target_precision_ops or [])
+    f32_list = list(lists.FP32_OPS) + list(fp32_ops or [])
+    for cond in (conditional_fp32_ops or []):
+        f32_list.append(cond[0] if isinstance(cond, (tuple, list)) else cond)
+
+    f32 = jnp.dtype("float32")
+    low_floats = [jnp.dtype("bfloat16"), jnp.dtype("float16")]
+    for name in target_list:
+        od = _try_get(_reg, name)
+        if od is None:
+            continue
+        _CURRENT["orig"][name] = od.fn
+        od.fn = _wrap_cast(od.fn, target, [f32])
+    for name in f32_list:
+        od = _try_get(_reg, name)
+        if od is None or name in _CURRENT["orig"]:
+            continue
+        _CURRENT["orig"][name] = od.fn
+        od.fn = _wrap_cast(od.fn, f32, low_floats)
+    _CURRENT["target"] = str(target_dtype)
+
+
+def _try_get(reg, name):
+    try:
+        return reg.get(name)
+    except Exception:
+        return None
+
+
+def _restore():
+    from ...ops import registry as _reg
+    for name, fn in _CURRENT["orig"].items():
+        od = _try_get(_reg, name)
+        if od is not None:
+            od.fn = fn
+    _CURRENT["orig"].clear()
+    _CURRENT["target"] = None
+
+
+def turn_off():
+    """Undo init() (test/bench hook; the reference has no public off
+    switch, but a process-wide monkeypatch needs one)."""
+    _restore()
+
+
+def init_trainer(trainer, loss_scaler=None):
+    """Attach a dynamic loss scaler to a gluon Trainer (ref:
+    amp.init_trainer). No-op scaling for bfloat16 targets."""
+    if loss_scaler is None:
+        needs_scaling = _CURRENT["target"] == "float16"
+        loss_scaler = LossScaler(init_scale=2.0 ** 16 if needs_scaling
+                                 else 1.0)
+    trainer._amp_loss_scaler = loss_scaler
+    # the user's configured rescale_grad must compose with (not be
+    # clobbered by) the loss scale: step() sees original/loss_scale
+    trainer._amp_original_scale = trainer._scale
+    return trainer
+
+
+@contextmanager
+def scale_loss(loss, trainer):
+    """``with amp.scale_loss(loss, trainer) as l: backward(l)`` —
+    multiplies the loss by the current scale and sets the trainer's
+    rescale so `trainer.step()` unscales gradients; on exit checks the
+    gradients for overflow, zeroing them (step becomes a no-op update
+    of zero grads) and backing the scale off when found."""
+    import numpy as _np
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        scaler = init_trainer(trainer)._amp_loss_scaler
+    scale = scaler.loss_scale
+    trainer._scale = trainer._amp_original_scale / scale
+    if isinstance(loss, (list, tuple)):
+        yield [l * scale for l in loss]
+    else:
+        yield loss * scale
+    if scale == 1.0:
+        return
+    # ONE device scalar accumulated across all grads, ONE host sync
+    # (the reference fuses this as multi_all_finite for the same reason)
+    finite = None
+    for p in trainer._params:
+        if p.grad_req == "null" or p._data is None or p._grad is None:
+            continue
+        for g in p.list_grad():
+            f = jnp.isfinite(g._data).all()
+            finite = f if finite is None else jnp.logical_and(finite, f)
+    overflow = finite is not None and not bool(_np.asarray(finite))
+    if overflow:
+        for p in trainer._params:
+            if p.grad_req != "null" and p._data is not None \
+                    and p._grad is not None:
+                for g in p.list_grad():
+                    g._data = jnp.zeros_like(g._data)
+    scaler.update(overflow)
+
+
+def unscale(trainer):
+    """Divide current gradients by the loss scale (for callers that
+    inspect/clip grads between backward and step — ref: amp.unscale)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None or scaler.loss_scale == 1.0:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req == "null" or p._data is None or p._grad is None:
+            continue
+        for g in p.list_grad():
+            g._data = g._data * inv
+    trainer._scale = getattr(trainer, "_amp_original_scale", 1.0)
+
+
+_KEEP_F32_FRAGMENTS = ("gamma", "beta", "moving_mean", "moving_var",
+                       "running_mean", "running_var", "mean", "var")
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16"):
+    """Cast a HybridBlock's parameters to the target dtype, keeping
+    normalisation statistics/affines in float32 (ref:
+    amp.convert_hybrid_block; pair with `amp.init()` so activations are
+    cast at the listed ops)."""
+    for name, param in block.collect_params().items():
+        if any(f in name for f in _KEEP_F32_FRAGMENTS):
+            continue
+        param.cast(target_dtype)
+    if hasattr(block, "_cached_graph"):
+        block._cached_graph = None
+    return block
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16"):
+    """Symbolic-API analogue: cast arg params (aux stats stay float32)
+    and return the triple (ref: amp.convert_model). The symbol itself
+    is unchanged — dtype policy is applied at op dispatch by init()."""
+    new_args = {}
+    for k, v in arg_params.items():
+        if any(f in k for f in _KEEP_F32_FRAGMENTS):
+            new_args[k] = v
+        else:
+            new_args[k] = v.astype(target_dtype)
+    return sym, new_args, dict(aux_params)

@@ -1,0 +1,40 @@
+"""AMP op lists (ref: python/mxnet/contrib/amp/lists/symbol_fp16.py).
+
+The reference classifies every operator into fp16-safe (matmul/conv —
+the tensor-core set), fp32-required (reductions, exp/log, norms), and
+widest-type-cast.  The TPU translation: TARGET ops feed the MXU and
+run in bfloat16; FP32 ops are numerically sensitive and are computed in
+float32 regardless of input dtype.  Ops in neither list run in whatever
+dtype reaches them (XLA type promotion).
+"""
+
+# matmul/conv-heavy: cast float32 inputs DOWN to the target dtype
+# (ref list: FP16_FUNCS)
+TARGET_DTYPE_OPS = [
+    "Convolution", "Deconvolution", "FullyConnected", "RNN",
+    "dot", "batch_dot",
+    "_contrib_interleaved_matmul_selfatt_qk",
+    "_contrib_interleaved_matmul_selfatt_valatt",
+    "_contrib_interleaved_matmul_encdec_qk",
+    "_contrib_interleaved_matmul_encdec_valatt",
+]
+
+# numerically sensitive: cast low-precision float inputs UP to float32
+# (ref list: FP32_FUNCS — norms, softmaxes, exponentials, losses)
+FP32_OPS = [
+    "softmax", "log_softmax", "SoftmaxActivation", "SoftmaxOutput",
+    "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm", "L2Normalization",
+    "exp", "log", "log2", "log10", "log1p", "expm1", "power",
+    "mean", "sum", "nansum", "prod", "nanprod", "norm",
+    "smooth_l1", "MakeLoss", "CTCLoss", "ctc_loss",
+    "linalg_gemm", "linalg_gemm2", "linalg_potrf", "linalg_trsm",
+]
+
+# ops that must see a single common dtype across inputs; XLA's type
+# promotion already implements the reference's widest-type-cast rule,
+# so this list is documentation-only on TPU (ref: WIDEST_TYPE_CASTS)
+WIDEST_TYPE_CASTS = [
+    "Concat", "add_n", "broadcast_add", "broadcast_sub", "broadcast_mul",
+    "broadcast_div", "elemwise_add", "elemwise_sub", "elemwise_mul",
+    "where", "stack",
+]

@@ -1,0 +1,32 @@
+"""Dynamic loss scaler (ref: python/mxnet/contrib/amp/loss_scaler.py).
+
+Needed for float16 training (gradients underflow below ~6e-8); bfloat16
+— the TPU-native target — shares float32's exponent range, so scaling
+is a no-op there and `amp.scale_loss` with the default bf16 target
+simply passes the loss through with scale 1.
+"""
+from __future__ import annotations
+
+
+class LossScaler:
+    """Multiply the loss by `loss_scale`; after each backward, check
+    gradients for inf/nan — on overflow halve the scale and skip the
+    step, after `scale_window` clean steps double it (ref: LossScaler
+    in the reference amp, itself the standard dynamic-scaling recipe)."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = float(scale_factor)
+        self._scale_window = int(scale_window)
+        self._unskipped = 0
+
+    def update(self, overflow: bool) -> None:
+        if overflow:
+            self.loss_scale = max(1.0, self.loss_scale / self._scale_factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

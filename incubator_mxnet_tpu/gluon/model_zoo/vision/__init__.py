@@ -10,6 +10,7 @@ from . import vgg as _m3
 from . import mobilenet as _m4
 from . import densenet as _m5
 from . import squeezenet as _m6
+from . import inception as _m7
 
 # star-import AFTER module refs: `alexnet`/`vgg` factory functions shadow
 # the submodule names in this namespace (reference behaves the same way)
@@ -19,9 +20,10 @@ from .vgg import *           # noqa: F401,F403,E402
 from .mobilenet import *     # noqa: F401,F403,E402
 from .densenet import *      # noqa: F401,F403,E402
 from .squeezenet import *    # noqa: F401,F403,E402
+from .inception import *     # noqa: F401,F403,E402
 
 _models = {}
-for _mod in (_m1, _m2, _m3, _m4, _m5, _m6):
+for _mod in (_m1, _m2, _m3, _m4, _m5, _m6, _m7):
     for _name in _mod.__all__:
         _obj = getattr(_mod, _name)
         if callable(_obj) and _name[0].islower() and \

@@ -1,0 +1,156 @@
+"""Inception v3
+(ref: python/mxnet/gluon/model_zoo/vision/inception.py — the Gluon
+assembly of Szegedy et al.'s architecture; 299×299 inputs).
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ...nn import (HybridSequential, Conv2D, BatchNorm, Activation,
+                   MaxPool2D, AvgPool2D, GlobalAvgPool2D, Flatten,
+                   Dropout, Dense)
+
+__all__ = ["Inception3", "inception_v3"]
+
+
+def _conv(channels, kernel_size, strides=1, padding=0):
+    out = HybridSequential()
+    out.add(Conv2D(channels, kernel_size=kernel_size, strides=strides,
+                   padding=padding, use_bias=False),
+            BatchNorm(epsilon=0.001),
+            Activation("relu"))
+    return out
+
+
+class _Concurrent(HybridBlock):
+    """Run children on the same input, concat along channels
+    (ref: gluon.contrib.nn.HybridConcurrent used by the upstream file)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._n = 0
+
+    def add(self, *blocks):
+        for b in blocks:
+            setattr(self, "branch%d" % self._n, b)
+            self._n += 1
+
+    def forward(self, x):
+        from .... import ndarray as F
+        outs = [getattr(self, "branch%d" % i)(x) for i in range(self._n)]
+        return F.concat(*outs, dim=1)
+
+
+def _branch(*specs):
+    seq = HybridSequential()
+    for channels, kernel, stride, pad in specs:
+        seq.add(_conv(channels, kernel, stride, pad))
+    return seq
+
+
+def _pool_branch(pool, *specs):
+    seq = HybridSequential()
+    seq.add(pool)
+    for channels, kernel, stride, pad in specs:
+        seq.add(_conv(channels, kernel, stride, pad))
+    return seq
+
+
+def _make_A(pool_features):
+    out = _Concurrent()
+    out.add(_branch((64, 1, 1, 0)),
+            _branch((48, 1, 1, 0), (64, 5, 1, 2)),
+            _branch((64, 1, 1, 0), (96, 3, 1, 1), (96, 3, 1, 1)),
+            _pool_branch(AvgPool2D(pool_size=3, strides=1, padding=1),
+                         (pool_features, 1, 1, 0)))
+    return out
+
+
+def _make_B():
+    out = _Concurrent()
+    out.add(_branch((384, 3, 2, 0)),
+            _branch((64, 1, 1, 0), (96, 3, 1, 1), (96, 3, 2, 0)),
+            _pool_branch(MaxPool2D(pool_size=3, strides=2)))
+    return out
+
+
+def _make_C(channels_7x7):
+    c = channels_7x7
+    out = _Concurrent()
+    out.add(_branch((192, 1, 1, 0)),
+            _branch((c, 1, 1, 0), (c, (1, 7), 1, (0, 3)),
+                    (192, (7, 1), 1, (3, 0))),
+            _branch((c, 1, 1, 0), (c, (7, 1), 1, (3, 0)),
+                    (c, (1, 7), 1, (0, 3)), (c, (7, 1), 1, (3, 0)),
+                    (192, (1, 7), 1, (0, 3))),
+            _pool_branch(AvgPool2D(pool_size=3, strides=1, padding=1),
+                         (192, 1, 1, 0)))
+    return out
+
+
+def _make_D():
+    out = _Concurrent()
+    out.add(_branch((192, 1, 1, 0), (320, 3, 2, 0)),
+            _branch((192, 1, 1, 0), (192, (1, 7), 1, (0, 3)),
+                    (192, (7, 1), 1, (3, 0)), (192, 3, 2, 0)),
+            _pool_branch(MaxPool2D(pool_size=3, strides=2)))
+    return out
+
+
+class _SplitBranch(HybridBlock):
+    """1×1 reduce, then parallel (1,3)/(3,1) convs concatenated —
+    the E-block's expanded branches."""
+
+    def __init__(self, reduce_spec, **kwargs):
+        super().__init__(**kwargs)
+        self.reduce = HybridSequential()
+        for channels, kernel, stride, pad in reduce_spec:
+            self.reduce.add(_conv(channels, kernel, stride, pad))
+        self.a = _conv(384, (1, 3), 1, (0, 1))
+        self.b = _conv(384, (3, 1), 1, (1, 0))
+
+    def forward(self, x):
+        from .... import ndarray as F
+        x = self.reduce(x)
+        return F.concat(self.a(x), self.b(x), dim=1)
+
+
+def _make_E():
+    out = _Concurrent()
+    out.add(_branch((320, 1, 1, 0)),
+            _SplitBranch([(384, 1, 1, 0)]),
+            _SplitBranch([(448, 1, 1, 0), (384, 3, 1, 1)]),
+            _pool_branch(AvgPool2D(pool_size=3, strides=1, padding=1),
+                         (192, 1, 1, 0)))
+    return out
+
+
+class Inception3(HybridBlock):
+    """ref: model_zoo/vision/inception.py Inception3 (299×299)."""
+
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        f = HybridSequential()
+        f.add(_conv(32, 3, 2, 0), _conv(32, 3, 1, 0), _conv(64, 3, 1, 1),
+              MaxPool2D(pool_size=3, strides=2),
+              _conv(80, 1, 1, 0), _conv(192, 3, 1, 0),
+              MaxPool2D(pool_size=3, strides=2),
+              _make_A(32), _make_A(64), _make_A(64),
+              _make_B(),
+              _make_C(128), _make_C(160), _make_C(160), _make_C(192),
+              _make_D(),
+              _make_E(), _make_E(),
+              AvgPool2D(pool_size=8), Dropout(0.5))
+        self.features = f
+        self.output = Dense(classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+def inception_v3(pretrained=False, classes=1000, **kwargs):
+    """ref: vision.inception_v3 factory."""
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled in the TPU build")
+    return Inception3(classes=classes, **kwargs)

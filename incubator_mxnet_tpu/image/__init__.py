@@ -1,2 +1,4 @@
 """mx.image namespace (ref: python/mxnet/image/)."""
 from .image import *     # noqa: F401,F403
+from . import detection  # noqa: F401
+from .detection import *  # noqa: F401,F403

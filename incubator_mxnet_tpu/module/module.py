@@ -57,13 +57,20 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        if monitor is not None:
+            monitor.install(self)
+
         for epoch in range(begin_epoch, num_epoch):
             eval_metric.reset()
             nbatch = 0
             train_data.reset()
             for data_batch in train_data:
+                if monitor is not None:
+                    monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
+                if monitor is not None:
+                    monitor.toc_print()
                 self.update_metric(eval_metric, data_batch.label)
                 if batch_end_callback is not None:
                     for cb in _as_list(batch_end_callback):
@@ -139,6 +146,19 @@ class _BatchEndParam:
 
 def _as_list(x):
     return x if isinstance(x, (list, tuple)) else [x]
+
+
+def save_checkpoint_params(prefix, epoch, symbol, arg_params,
+                           aux_params=None):
+    """Free-function checkpoint writer (ref: mx.model.save_checkpoint)
+    used by `callback.do_checkpoint`; format-compatible with
+    `Module.load_checkpoint`."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    data = {("arg:%s" % k): v for k, v in arg_params.items()}
+    data.update({("aux:%s" % k): v
+                 for k, v in (aux_params or {}).items()})
+    nd.save("%s-%04d.params" % (prefix, epoch), data)
 
 
 class Module(BaseModule):
@@ -284,10 +304,9 @@ class Module(BaseModule):
                          force_init=force_init)
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        self._symbol.save("%s-symbol.json" % prefix)
-        arg_params, _ = self.get_params()
-        nd.save("%s-%04d.params" % (prefix, epoch),
-                {("arg:%s" % k): v for k, v in arg_params.items()})
+        arg_params, aux_params = self.get_params()
+        save_checkpoint_params(prefix, epoch, self._symbol, arg_params,
+                               aux_params)
 
     @staticmethod
     def load_checkpoint(prefix, epoch):

@@ -1,0 +1,93 @@
+"""Training monitor (ref: python/mxnet/monitor.py Monitor).
+
+The reference installs a per-op output callback on every executor
+(`MXExecutorSetMonitorCallback`) and stats every intermediate tensor.
+On TPU the forward is ONE fused XLA executable — materialising every
+intermediate would defeat the fusion the whole design rides on — so
+this Monitor stats the tensors that exist at executable boundaries:
+module outputs, arguments (weights) and their gradients, name-filtered
+by the same regex `pattern` contract.  `stat_func` defaults to
+mean(|x|), as upstream.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):          # mean absolute value (ref default)
+                return x.abs().mean()
+        self.stat_func = stat_func
+        self.interval = int(interval)
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.activated = False
+        self.queue = []                # (step, name, stat NDArray)
+        self._module = None
+
+    # -- wiring --------------------------------------------------------
+    def install(self, module):
+        """Register the module whose tensors are statted (the analogue
+        of installing the executor callback)."""
+        self._module = module
+        return self
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        self.activated = False
+        mod = self._module
+        if mod is not None:
+            step = self.step
+            try:
+                outs = mod.get_outputs()
+            except Exception:
+                outs = []
+            for i, o in enumerate(outs):
+                name = "output%d" % i
+                if self.re_pattern.match(name):
+                    self.queue.append((step, name, self.stat_func(o)))
+            try:
+                arg_params, aux_params = mod.get_params()
+            except Exception:
+                arg_params, aux_params = {}, {}
+            for name, v in list(arg_params.items()) + \
+                    list(aux_params.items()):
+                if self.re_pattern.match(name):
+                    self.queue.append((step, name, self.stat_func(v)))
+            grads = getattr(mod, "grad_dict", None) or \
+                getattr(getattr(mod, "_exec", None), "grad_dict", None)
+            if callable(grads):
+                grads = grads()
+            if isinstance(grads, dict):
+                for name, g in grads.items():
+                    gname = name + "_grad"
+                    if g is not None and self.re_pattern.match(gname):
+                        self.queue.append((step, gname,
+                                           self.stat_func(g)))
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            res.append((n, k, str(v_list.asnumpy())
+                        if hasattr(v_list, "asnumpy") else str(v_list)))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)
+        return res

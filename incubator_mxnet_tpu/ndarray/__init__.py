@@ -23,7 +23,40 @@ from .ndarray import NDArray, invoke, apply_fn, array, from_jax
 
 __all__ = ["NDArray", "array", "invoke", "zeros", "ones", "full", "empty",
            "arange", "linspace", "eye", "save", "load", "waitall",
-           "from_jax", "concat", "stack", "random"]
+           "from_jax", "concat", "stack", "random", "to_dlpack_for_read",
+           "to_dlpack_for_write", "from_dlpack"]
+
+
+# ---------------------------------------------------------------------------
+# DLPack interop (ref: python/mxnet/dlpack.py to_dlpack_for_read/
+# from_dlpack): zero-copy exchange with torch/numpy/cupy.  The PJRT
+# buffer itself is the exported tensor; jax.dlpack handles the capsule.
+# ---------------------------------------------------------------------------
+
+def to_dlpack_for_read(data):
+    """Export for DLPack consumers (shared, read-only use).
+
+    Returns the protocol-bearing array (implements `__dlpack__` /
+    `__dlpack_device__`) rather than a raw PyCapsule: modern consumers
+    (torch.from_dlpack, np.from_dlpack, our own from_dlpack) take the
+    protocol object, and jax 0.9 no longer accepts bare capsules."""
+    data.wait_to_read()
+    return data._data
+
+
+def to_dlpack_for_write(data):
+    """ref parity: MXNet distinguishes read/write dependencies in its
+    engine; PJRT buffers are immutable, so writes through the capsule
+    are not observable — exported like the read variant."""
+    return to_dlpack_for_read(data)
+
+
+def from_dlpack(capsule):
+    """Wrap a DLPack capsule (or any object with __dlpack__) as an
+    NDArray, zero-copy when the producer is on the same device."""
+    from jax import dlpack as _jdl
+    arr = _jdl.from_dlpack(capsule)
+    return NDArray(arr)
 
 
 # ---------------------------------------------------------------------------

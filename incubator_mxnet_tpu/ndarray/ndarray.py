@@ -92,7 +92,7 @@ def apply_fn(fn, nd_args, kwargs, *, name="", differentiable=True,
 
     if record:
         _ag.record_op(vjp_fn, arr_nds, wrapped, name=name,
-                      out_is_tuple=multi)
+                      out_is_tuple=multi, raw_fn=pure)
 
     if out_nd is not None:
         if multi:

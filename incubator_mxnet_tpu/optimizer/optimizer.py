@@ -35,7 +35,8 @@ __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta",
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def _jit_update(opname: str, static_kv: tuple, donate_idx: tuple = ()):
+def _jit_update(opname: str, static_kv: tuple, donate_idx: tuple = (),
+                out_ref_idx: tuple = None):
     """Jit a fused update op with per-position donation.  Arrays are
     passed as separate positional args (scalars dict last) so
     `donate_argnums` can donate weight/state buffers while leaving the
@@ -46,17 +47,33 @@ def _jit_update(opname: str, static_kv: tuple, donate_idx: tuple = ()):
 
     def f(*args):
         arrs, scalars = args[:-1], args[-1]
-        return fn(*arrs, **scalars, **dict(static_kv))
+        out = fn(*arrs, **scalars, **dict(static_kv))
+        # scalars ride in as f32 arrays (avoids per-value recompiles),
+        # which promotes low-precision weights — cast each output back
+        # to its buffer's dtype (reference updates are dtype-preserving,
+        # and donation needs matching dtypes to reuse the buffer).
+        # out_ref_idx maps output position -> input position; the
+        # default fits weight-first update ops fn(w, g, *states) ->
+        # (new_w, *new_states)
+        if out_ref_idx is not None:
+            refs = tuple(arrs[i] for i in out_ref_idx)
+        else:
+            refs = (arrs[0],) + tuple(arrs[2:])  # weight, *states
+        if isinstance(out, tuple):
+            return tuple(o.astype(r.dtype) for o, r in zip(out, refs))
+        return out.astype(refs[0].dtype)
     return jax.jit(f, donate_argnums=donate_idx)
 
 
-def _fused(opname, arrays, scalars, static, donate=True):
+def _fused(opname, arrays, scalars, static, donate=True,
+           out_ref_idx=None):
     """Run a fused update op `fn(weight, grad, *states, ...)`: donates the
     weight/state buffers (positions != 1), never the grad, returns new
     buffers."""
     donate_idx = tuple(i for i in range(len(arrays)) if i != 1) \
         if donate else ()
-    jf = _jit_update(opname, tuple(sorted(static.items())), donate_idx)
+    jf = _jit_update(opname, tuple(sorted(static.items())), donate_idx,
+                     out_ref_idx)
     scal = {k: jnp.asarray(v, jnp.float32) for k, v in scalars.items()}
     return jf(*(a._data for a in arrays), scal)
 
@@ -90,12 +107,15 @@ def _jit_multi_update(opname: str, static_kv: tuple, nparam: int,
             sargs = tuple(states[j][i] for j in range(nstates))
             out = fn(ws[i], gs[i], *sargs, lr=lrs[i], wd=wds[i],
                      **scalars, **dict(static_kv))
+            # dtype-preserving like _jit_update: f32 hyper arrays must
+            # not promote low-precision weight/state buffers
             if nstates:
-                new_ws.append(out[0])
+                new_ws.append(out[0].astype(ws[i].dtype))
                 for j in range(nstates):
-                    new_states[j].append(out[1 + j])
+                    new_states[j].append(out[1 + j].astype(
+                        states[j][i].dtype))
             else:
-                new_ws.append(out)
+                new_ws.append(out.astype(ws[i].dtype))
         return tuple(new_ws), tuple(tuple(s) for s in new_states)
     return jax.jit(f, donate_argnums=(0, 2))
 
@@ -624,10 +644,12 @@ class LAMB(Optimizer):
         static = dict(t=t, bias_correction=self.bias_correction,
                       clip_gradient=self.clip_gradient
                       if self.clip_gradient is not None else -1.0)
-        # no donation: the weight buffer is read again in phase2
+        # no donation: the weight buffer is read again in phase2.
+        # outputs are (g', m, v) — g' mirrors the GRAD's dtype (f32
+        # phase-1 math feeds phase 2's trust ratio), not the weight's
         g, new_m, new_v = _fused("lamb_update_phase1",
                                  (weight, grad, mean, var), scal, static,
-                                 donate=False)
+                                 donate=False, out_ref_idx=(1, 2, 3))
         mean._data, var._data = new_m, new_v
         r1 = jnp.linalg.norm(weight._data)
         r2 = jnp.linalg.norm(g)

@@ -1,0 +1,103 @@
+"""AMP (ref: tests/python/unittest/test_amp.py + contrib amp tests)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon, autograd as ag
+from incubator_mxnet_tpu.contrib import amp
+
+
+@pytest.fixture(autouse=True)
+def _amp_off():
+    yield
+    amp.turn_off()
+
+
+def test_amp_init_casts_target_ops():
+    """After init(), FullyConnected computes in bfloat16 even on f32
+    inputs; softmax stays f32."""
+    amp.init("bfloat16")
+    x = nd.array(np.random.rand(4, 8).astype(np.float32))
+    w = nd.array(np.random.rand(16, 8).astype(np.float32))
+    out = nd.FullyConnected(x, w, None, num_hidden=16, no_bias=True)
+    assert out.dtype == np.dtype("bfloat16") or str(out.dtype) == "bfloat16"
+    s = nd.softmax(out)
+    assert str(s.dtype) == "float32"   # FP32 op casts back up
+    amp.turn_off()
+    out2 = nd.FullyConnected(x, w, None, num_hidden=16, no_bias=True)
+    assert str(out2.dtype) == "float32"
+
+
+def test_amp_training_bf16_converges():
+    """End-to-end: init() + convert_hybrid_block + scale_loss (no-op
+    scale for bf16) trains a small net."""
+    amp.init("bfloat16")
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    amp.convert_hybrid_block(net)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    amp.init_trainer(trainer)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.randn(16, 8).astype(np.float32))
+    y = nd.array(rs.randint(0, 4, (16,)).astype(np.float32))
+    first = last = None
+    for _ in range(25):
+        with ag.record():
+            out = net(x)
+            l = loss_fn(out, y)
+            with amp.scale_loss(l, trainer) as scaled:
+                scaled.backward()
+        trainer.step(16)
+        last = float(l.asnumpy().mean())
+        if first is None:
+            first = last
+    assert last < first * 0.7, (first, last)
+    # weights really are bf16
+    w = net[0].weight.data()
+    assert str(w.dtype) == "bfloat16"
+
+
+def test_amp_dynamic_loss_scaler_backoff():
+    """fp16-style dynamic scaling: overflowed grads are zeroed and the
+    scale halves; clean steps grow it after the window."""
+    sc = amp.LossScaler(init_scale=1024.0, scale_factor=2.0,
+                        scale_window=2)
+    sc.update(overflow=True)
+    assert sc.loss_scale == 512.0
+    sc.update(False)
+    sc.update(False)
+    assert sc.loss_scale == 1024.0
+
+
+def test_amp_scale_loss_overflow_zeroes_grads():
+    net = gluon.nn.Dense(1)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.0})
+    amp.init_trainer(trainer, amp.LossScaler(init_scale=4.0))
+    x = nd.array(np.full((2, 3), 1e38, np.float32))   # overflows when scaled
+    y = nd.array(np.ones((2,), np.float32))
+    loss_fn = gluon.loss.L2Loss()
+    with ag.record():
+        l = loss_fn(net(x), y)
+        with amp.scale_loss(l, trainer) as scaled:
+            scaled.backward()
+    g = net.weight.grad().asnumpy()
+    assert np.all(g == 0.0), g
+    assert trainer._amp_loss_scaler.loss_scale == 2.0   # backed off
+
+
+def test_amp_convert_model_keeps_norm_stats_f32():
+    sym = None
+    args = {"fc_weight": nd.ones((4, 4)),
+            "bn_gamma": nd.ones((4,))}
+    aux = {"bn_moving_mean": nd.zeros((4,))}
+    _, new_args, new_aux = amp.convert_model(sym, args, aux,
+                                             target_dtype="bfloat16")
+    assert str(new_args["fc_weight"].dtype) == "bfloat16"
+    assert str(new_args["bn_gamma"].dtype) == "float32"
+    assert str(new_aux["bn_moving_mean"].dtype) == "float32"

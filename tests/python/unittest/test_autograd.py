@@ -171,3 +171,87 @@ def test_attach_grad_detaches_from_graph():
     z.backward()
     assert_almost_equal(y.grad.asnumpy(), np.full((2,), 3.0))
     assert_almost_equal(x.grad.asnumpy(), np.zeros((2,)))
+
+
+def test_autograd_function():
+    """Custom Function (ref: test_autograd.py test_function): forward/
+    backward overrides flow through the tape like any op."""
+    class sigmoid(ag.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1.0 - y)
+
+    x = nd.array(np.random.uniform(-2, 2, (3, 4)).astype("float32"))
+    x.attach_grad()
+    with ag.record():
+        y = sigmoid()(x)
+        z = (y * 3.0).sum()
+    z.backward()
+    xn = x.asnumpy()
+    sn = 1.0 / (1.0 + np.exp(-xn))
+    assert_almost_equal(y.asnumpy(), sn, rtol=1e-5)
+    assert_almost_equal(x.grad.asnumpy(), 3.0 * sn * (1 - sn), rtol=1e-4)
+
+
+def test_autograd_function_multi_io():
+    """Function with two inputs / two outputs, None grad for one input."""
+    class scale_pair(ag.Function):
+        def forward(self, a, b):
+            return a * 2.0, b * 3.0
+
+        def backward(self, da, db):
+            return da * 2.0, db * 3.0
+
+    a = nd.array(np.ones((2, 2), np.float32))
+    b = nd.array(np.ones((2, 2), np.float32) * 4)
+    a.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        u, v = scale_pair()(a, b)
+        l = u.sum() + (v * v).sum()
+    l.backward()
+    assert_almost_equal(a.grad.asnumpy(), np.full((2, 2), 2.0))
+    # d/db (3b)^2 = 2*3b*3 = 18b = 72
+    assert_almost_equal(b.grad.asnumpy(), np.full((2, 2), 72.0))
+
+
+def test_higher_order_grad():
+    """create_graph=True (ref: test_higher_order_grad.py): grad-of-grad
+    for x**3 and sin."""
+    x = nd.array(np.array([0.5, 1.0, 2.0], np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x
+        dx, = ag.grad(y, [x], create_graph=True, retain_graph=True)
+        dl = dx.sum()
+    dl.backward()
+    xn = x.asnumpy()
+    assert_almost_equal(x.grad.asnumpy(), 6 * xn, rtol=1e-4)
+
+    x2 = nd.array(np.array([0.3, 1.2], np.float32))
+    x2.attach_grad()
+    with ag.record():
+        y2 = nd.sin(x2)
+        dx2, = ag.grad(y2, [x2], create_graph=True, retain_graph=True)
+        dl2 = dx2.sum()
+    dl2.backward()
+    assert_almost_equal(x2.grad.asnumpy(), -np.sin(x2.asnumpy()),
+                        rtol=1e-4)
+
+
+def test_third_order_grad():
+    """d3/dx3 of x^4 = 24x via nested create_graph."""
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x * x
+        d1, = ag.grad(y, [x], create_graph=True, retain_graph=True)
+        d2, = ag.grad(d1, [x], create_graph=True, retain_graph=True)
+        d3s = d2.sum()
+    d3s.backward()
+    assert_almost_equal(x.grad.asnumpy(), 24 * x.asnumpy(), rtol=1e-4)

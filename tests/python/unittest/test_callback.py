@@ -1,0 +1,80 @@
+"""Callbacks + Monitor (ref: python/mxnet/callback.py, monitor.py usage
+in tests/python/unittest/test_monitor.py)."""
+import logging
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym
+from incubator_mxnet_tpu.callback import (Speedometer, do_checkpoint,
+                                          log_train_metric)
+
+
+class _Param:
+    def __init__(self, epoch, nbatch, eval_metric=None):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+
+
+def test_speedometer_reports_speed():
+    sp = Speedometer(batch_size=32, frequent=2, auto_reset=False)
+    m = mx.metric.Accuracy()
+    m.update([nd.array([1.0, 0.0])], [nd.array([[0.1, 0.9], [0.9, 0.1]])])
+    for i in range(5):
+        sp(_Param(0, i, m))
+    assert sp.last_speed > 0
+
+
+def test_fit_with_callbacks_and_monitor(tmp_path, caplog):
+    """Module.fit drives batch/epoch callbacks and the Monitor."""
+    from incubator_mxnet_tpu.io import NDArrayIter
+
+    n = 40
+    rs = np.random.RandomState(0)
+    x_np = rs.randn(n, 3).astype("float32")
+    y_np = (x_np.sum(axis=1) > 0).astype("float32")
+    it = NDArrayIter(x_np, y_np, batch_size=10)
+
+    x = sym.var("data")
+    w = sym.var("fc_weight")
+    b = sym.var("fc_bias")
+    out = sym.SoftmaxOutput(
+        sym.FullyConnected(x, w, b, num_hidden=2),
+        sym.var("softmax_label"))
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",))
+
+    seen = {"batches": 0, "epochs": 0}
+
+    def batch_cb(param):
+        seen["batches"] += 1
+        assert hasattr(param, "eval_metric")
+
+    def epoch_cb(epoch, symbol, arg_params, aux_params):
+        seen["epochs"] += 1
+        assert "fc_weight" in arg_params
+
+    mon = mx.Monitor(interval=2, pattern="fc_.*")
+    prefix = str(tmp_path / "cbmodel")
+    with caplog.at_level(logging.INFO):
+        mod.fit(it, num_epoch=2,
+                batch_end_callback=[batch_cb, Speedometer(10, frequent=2)],
+                epoch_end_callback=[epoch_cb, do_checkpoint(prefix)],
+                monitor=mon,
+                optimizer="sgd",
+                optimizer_params=(("learning_rate", 0.1),))
+    assert seen["batches"] == 8     # 4 batches × 2 epochs
+    assert seen["epochs"] == 2
+    # do_checkpoint wrote loadable files for both epochs
+    symbol, arg_params, aux_params = mx.mod.Module.load_checkpoint(prefix, 2)
+    assert "fc_weight" in arg_params
+    # monitor produced stats for fc params
+    assert mon.step > 0
+
+
+def test_log_train_metric_runs():
+    m = mx.metric.Accuracy()
+    m.update([nd.array([1.0])], [nd.array([[0.1, 0.9]])])
+    cb = log_train_metric(period=1)
+    cb(_Param(0, 1, m))     # must not raise

@@ -1,0 +1,66 @@
+"""Detection augmenters + DLPack + inception_v3
+(ref: tests/python/unittest/test_image.py TestImage.test_det_augmenters,
+test_dlpack, model zoo tests)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def test_det_horizontal_flip_boxes():
+    from incubator_mxnet_tpu.image import DetHorizontalFlipAug
+    img = nd.array(np.random.rand(8, 6, 3).astype(np.float32))
+    label = np.array([[0, 0.2, 0.3, 0.6, 0.7]], np.float32)
+    img2, lab2 = DetHorizontalFlipAug(p=1.0)(img, label)
+    assert abs(lab2[0, 1] - 0.4) < 1e-6
+    assert abs(lab2[0, 3] - 0.8) < 1e-6
+    # image flipped
+    np.testing.assert_allclose(img2.asnumpy(), img.asnumpy()[:, ::-1])
+
+
+def test_det_random_crop_keeps_and_renormalises():
+    from incubator_mxnet_tpu.image import DetRandomCropAug
+    np.random.seed(0)
+    img = nd.array(np.random.rand(64, 48, 3).astype(np.float32))
+    label = np.array([[0, 0.2, 0.3, 0.6, 0.7], [-1, 0, 0, 0, 0]],
+                     np.float32)
+    ci, cl = DetRandomCropAug(min_object_covered=0.5)(img, label)
+    assert cl.shape == label.shape           # padded to same row count
+    valid = cl[cl[:, 0] >= 0]
+    assert len(valid) >= 1
+    assert (valid[:, 1:5] >= -1e-6).all() and (valid[:, 1:5] <= 1 + 1e-6).all()
+
+
+def test_det_augmenter_pipeline():
+    from incubator_mxnet_tpu.image import CreateDetAugmenter
+    np.random.seed(1)
+    img = nd.array(np.random.rand(50, 70, 3).astype(np.float32) * 255)
+    label = np.array([[1, 0.1, 0.1, 0.9, 0.9]], np.float32)
+    augs = CreateDetAugmenter((3, 32, 32), rand_crop=0.5, rand_pad=0.5,
+                              rand_mirror=True)
+    for a in augs:
+        img, label = a(img, label)
+    assert img.shape == (32, 32, 3)
+    assert label.shape[1] == 5
+
+
+def test_dlpack_roundtrip_torch():
+    import torch
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    t = torch.from_dlpack(nd.to_dlpack_for_read(x))
+    np.testing.assert_allclose(np.asarray(t), x.asnumpy())
+    back = nd.from_dlpack(torch.arange(4, dtype=torch.float32).reshape(2, 2))
+    np.testing.assert_allclose(back.asnumpy(),
+                               np.arange(4, dtype=np.float32).reshape(2, 2))
+    # mx -> mx roundtrip through the protocol object
+    r = nd.from_dlpack(nd.to_dlpack_for_read(x))
+    np.testing.assert_allclose(r.asnumpy(), x.asnumpy())
+
+
+def test_inception_v3_forward():
+    from incubator_mxnet_tpu.gluon.model_zoo.vision import get_model
+    net = get_model("inception_v3", classes=7)
+    net.initialize()
+    x = nd.array(np.random.rand(1, 3, 299, 299).astype(np.float32))
+    out = net(x)
+    assert out.shape == (1, 7)
