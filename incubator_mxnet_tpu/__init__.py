@@ -49,11 +49,13 @@ from . import contrib
 from . import callback
 from . import monitor
 from .monitor import Monitor
-from .util import is_np_array, set_np, reset_np
+from . import numpy as np              # mx.np — NumPy-semantics front-end
+from . import numpy_extension as npx   # mx.npx — NN extensions + set_np
+from .util import is_np_array, set_np, reset_np, use_np
 
 __all__ = ["MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",
            "nd", "ndarray", "NDArray", "autograd", "engine", "random",
            "gluon", "optimizer", "Optimizer", "metric", "initializer",
            "kvstore", "kv", "io", "image", "profiler", "runtime",
            "test_utils", "symbol", "sym", "Symbol", "module", "mod",
-           "parallel", "__version__"]
+           "parallel", "np", "npx", "__version__"]

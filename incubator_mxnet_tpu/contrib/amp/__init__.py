@@ -85,7 +85,8 @@ def init(target_dtype="bfloat16", target_precision_ops=None,
             continue
         _CURRENT["orig"][name] = od.fn
         od.fn = _wrap_cast(od.fn, f32, low_floats)
-    _CURRENT["target"] = str(target_dtype)
+    _CURRENT["target"] = str(target)   # normalized name ("float16"), not
+    # str(raw arg) — init_trainer's float16 check and re-init compare it
 
 
 def _try_get(reg, name):
@@ -151,7 +152,8 @@ def scale_loss(loss, trainer):
         if p.grad_req == "null" or p._data is None or p._grad is None:
             continue
         for g in p.list_grad():
-            f = jnp.isfinite(g._data).all()
+            leaf = _grad_leaf(g)
+            f = jnp.isfinite(leaf._data).all()
             finite = f if finite is None else jnp.logical_and(finite, f)
     overflow = finite is not None and not bool(_np.asarray(finite))
     if overflow:
@@ -159,7 +161,8 @@ def scale_loss(loss, trainer):
             if p.grad_req != "null" and p._data is not None \
                     and p._grad is not None:
                 for g in p.list_grad():
-                    g._data = jnp.zeros_like(g._data)
+                    leaf = _grad_leaf(g)
+                    leaf._data = jnp.zeros_like(leaf._data)
     scaler.update(overflow)
 
 
@@ -174,8 +177,15 @@ def unscale(trainer):
         if p.grad_req == "null" or p._data is None or p._grad is None:
             continue
         for g in p.list_grad():
-            g._data = g._data * inv
+            leaf = _grad_leaf(g)
+            leaf._data = leaf._data * inv
     trainer._scale = getattr(trainer, "_amp_original_scale", 1.0)
+
+
+def _grad_leaf(g):
+    """The dense NDArray holding a gradient's values — for row_sparse
+    grads (Embedding sparse_grad path) that is the `.data` values array."""
+    return g.data if getattr(g, "stype", "default") == "row_sparse" else g
 
 
 _KEEP_F32_FRAGMENTS = ("gamma", "beta", "moving_mean", "moving_var",

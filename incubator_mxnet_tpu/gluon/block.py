@@ -271,7 +271,7 @@ class Block:
         out = self.forward(*args, **kwargs)
         for hook in self._forward_hooks:
             hook(self, args, out)
-        return out
+        return _np_mode_out(out)
 
     def forward(self, *args, **kwargs):
         raise NotImplementedError
@@ -288,6 +288,16 @@ class Block:
 
 def _indent(s):
     return s.replace("\n", "\n  ")
+
+
+def _np_mode_out(out):
+    """np mode (npx.set_np()): blocks hand back mx.np ndarrays (ref:
+    gluon blocks return np arrays when the np flag is on)."""
+    from ..util import is_np_array
+    if is_np_array():
+        from ..numpy.multiarray import from_nd
+        return from_nd(out)
+    return out
 
 
 def _flat_symbols(out):
@@ -598,7 +608,7 @@ class HybridBlock(Block):
                         with _ag.pause():
                             Block.__call__(self, *args)
                 self._cached_graph = _CachedGraph(self, self._flags)
-            return self._cached_graph(list(args))
+            return _np_mode_out(self._cached_graph(list(args)))
         return Block.__call__(self, *args, **kwargs)
 
     def forward(self, x, *args):

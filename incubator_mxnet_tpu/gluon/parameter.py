@@ -21,6 +21,27 @@ from ..context import Context, cpu, current_context
 from ..ndarray.ndarray import NDArray
 from .. import initializer as init_mod
 
+
+def _sync_np_class(out):
+    """Align a STORED array's class with the current front-end mode.
+
+    np mode (npx.set_np()): hand back the SAME object viewed as an
+    mx.np ndarray — identity must be preserved because backward grads
+    and trainer updates bind to this instance; ndarray has empty
+    __slots__, so the class switch is layout-compatible.  When np mode
+    is off again, switch back so legacy semantics (hashability, strict
+    operator dispatch) are restored."""
+    if out is None:
+        return out
+    from ..util import is_np_array
+    from ..numpy.multiarray import ndarray as _np_ndarray
+    if is_np_array():
+        if type(out) is NDArray:
+            out.__class__ = _np_ndarray
+    elif type(out) is _np_ndarray:
+        out.__class__ = NDArray
+    return out
+
 __all__ = ["Parameter", "Constant", "ParameterDict",
            "DeferredInitializationError", "tensor_types"]
 
@@ -175,8 +196,10 @@ class Parameter:
         if ctx is None or ctx not in self._data:
             # lenient fallback to the primary copy: tracer-backed calls
             # carry a default ctx that need not match the storage ctx
-            return next(iter(self._data.values()))
-        return self._data[ctx]
+            out = next(iter(self._data.values()))
+        else:
+            out = self._data[ctx]
+        return _sync_np_class(out)
 
     def list_data(self):
         self._check_initialized()
@@ -189,8 +212,10 @@ class Parameter:
         # read the LIVE container from the array: sparse backward rebinds
         # arr._grad to a fresh RowSparseNDArray each step
         if ctx is None or ctx not in self._data:
-            return next(iter(self._data.values()))._grad
-        return self._data[ctx]._grad
+            out = next(iter(self._data.values()))._grad
+        else:
+            out = self._data[ctx]._grad
+        return _sync_np_class(out)
 
     def list_grad(self):
         self._check_initialized()

@@ -1,0 +1,47 @@
+"""mx.np — NumPy-semantics front-end (ref: python/mxnet/numpy/).
+
+Usage mirrors the reference:
+
+    import incubator_mxnet_tpu as mx
+    mx.npx.set_np()                 # optional: flips Gluon to np arrays
+    a = mx.np.arange(6).reshape(2, 3)
+    b = mx.np.ones((3, 4))
+    c = mx.np.matmul(a, b)          # NumPy broadcasting/promotion
+    c.attach_grad()                 # same autograd as the legacy front-end
+
+Design note: the reference needed a parallel `_np_*` operator universe in
+C++ to get NumPy semantics; here jax.numpy *is* that universe, so this
+package is a thin tape-recording lift (see multiarray.py) — same buffers,
+same autograd, zero-copy views to/from mx.nd."""
+from __future__ import annotations
+
+import numpy as _onp
+
+from .multiarray import (ndarray, array, asarray, zeros, ones, empty,
+                         full, zeros_like, ones_like, full_like,
+                         empty_like, arange, linspace, logspace,
+                         geomspace, eye, identity, tril, triu, meshgrid,
+                         indices, frombuffer, copy, from_nd)
+from ._op import *          # noqa: F401,F403 — the function catalog
+from . import random        # noqa: F401
+from . import linalg        # noqa: F401
+
+# constants / dtypes, NumPy names
+pi = _onp.pi
+e = _onp.e
+euler_gamma = _onp.euler_gamma
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+dtype = _onp.dtype
+
+_FLOAT_TYPES = (float16, float32, float64)

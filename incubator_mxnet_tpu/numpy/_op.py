@@ -1,0 +1,353 @@
+"""mx.np namespace functions (ref: python/mxnet/numpy/multiarray.py
+function surface + src/operator/numpy/* `_np_*` kernels).
+
+Each function is a tape-recorded lift of the matching jax.numpy function
+(see multiarray.np_op): NumPy semantics come from jnp, autograd comes
+from the shared imperative dispatch layer.  Non-differentiable results
+(int/bool outputs, data-dependent shapes) skip the tape.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+import jax.numpy as jnp
+
+from .multiarray import (np_op, nondiff_np_op, from_nd, array, asarray,
+                         ndarray)
+from ..ndarray.ndarray import NDArray, apply_fn
+
+# ---------------------------------------------------------------------------
+# elementwise math
+# ---------------------------------------------------------------------------
+
+_DIFF_UNARY = [
+    "negative", "reciprocal", "absolute", "fabs", "sign", "exp", "expm1",
+    "log", "log2", "log10", "log1p", "sqrt", "cbrt", "square", "sin",
+    "cos", "tan", "arcsin", "arccos", "arctan", "sinh", "cosh", "tanh",
+    "arcsinh", "arccosh", "arctanh", "degrees", "radians", "deg2rad",
+    "rad2deg", "rint", "floor", "ceil", "trunc", "sinc",
+    "nan_to_num", "i0",
+]
+_DIFF_BINARY = [
+    "add", "subtract", "multiply", "divide", "true_divide", "power",
+    "float_power", "maximum", "minimum", "fmax", "fmin", "hypot",
+    "arctan2", "copysign", "nextafter", "ldexp", "logaddexp",
+    "logaddexp2", "heaviside",
+]
+_NONDIFF_UNARY = [
+    "signbit", "isnan", "isinf", "isfinite", "isposinf", "isneginf",
+    "invert", "logical_not", "iscomplex", "isreal",
+]
+_NONDIFF_BINARY = [
+    "floor_divide", "mod", "remainder", "fmod", "gcd", "lcm",
+    "logical_and", "logical_or", "logical_xor", "equal", "not_equal",
+    "less", "less_equal", "greater", "greater_equal", "bitwise_and",
+    "bitwise_or", "bitwise_xor", "left_shift", "right_shift",
+]
+
+_g = globals()
+for _n in _DIFF_UNARY + _DIFF_BINARY:
+    if hasattr(jnp, _n):
+        _g[_n] = np_op(getattr(jnp, _n), name="np_" + _n)
+for _n in _NONDIFF_UNARY + _NONDIFF_BINARY:
+    if hasattr(jnp, _n):
+        _g[_n] = nondiff_np_op(getattr(jnp, _n), name="np_" + _n)
+
+abs = np_op(jnp.abs, name="np_abs")                      # noqa: A001
+fix = np_op(jnp.trunc, name="np_fix")    # jnp.fix deprecated → trunc
+bitwise_not = nondiff_np_op(jnp.invert, name="np_bitwise_not")
+
+
+def around(a, decimals=0):
+    return np_op(jnp.round, name="np_around")(a, decimals=decimals)
+
+
+round = around                                           # noqa: A001
+round_ = around
+
+
+def clip(a, a_min=None, a_max=None):
+    return np_op(jnp.clip, name="np_clip")(a, a_min, a_max)
+
+
+def mod_op_note():   # pragma: no cover - doc anchor
+    """mod/floor_divide are listed non-diff to match reference behavior
+    (integer-style ops); float use still computes, just isn't recorded."""
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+for _n in ["sum", "prod", "mean", "std", "var", "max", "min", "amax",
+           "amin", "ptp", "cumsum", "cumprod", "nansum", "nanprod",
+           "nanmean", "nanmax", "nanmin", "median", "nanmedian",
+           "quantile", "percentile", "average", "trapz", "trapezoid"]:
+    if hasattr(jnp, _n):
+        _g[_n] = np_op(getattr(jnp, _n), name="np_" + _n)
+if "trapz" not in _g and "trapezoid" in _g:
+    trapz = _g["trapezoid"]
+
+for _n in ["argmax", "argmin", "nanargmax", "nanargmin", "count_nonzero",
+           "all", "any"]:
+    _g[_n] = nondiff_np_op(getattr(jnp, _n), name="np_" + _n)
+
+
+# ---------------------------------------------------------------------------
+# linear algebra (np namespace part)
+# ---------------------------------------------------------------------------
+
+for _n in ["dot", "vdot", "inner", "outer", "matmul", "tensordot", "kron",
+           "trace", "cross", "convolve", "correlate"]:
+    _g[_n] = np_op(getattr(jnp, _n), name="np_" + _n)
+
+
+def einsum(*operands, **kwargs):
+    subscripts = operands[0]
+    arrays = operands[1:]
+
+    def _einsum(*arrs):
+        return jnp.einsum(subscripts, *arrs, **kwargs)
+    _einsum.__name__ = "np_einsum"
+    return from_nd(apply_fn(_einsum, list(arrays), {}, name="np_einsum"))
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+for _n in ["reshape", "ravel", "squeeze", "expand_dims", "transpose",
+           "swapaxes", "moveaxis", "rollaxis", "broadcast_to", "tile",
+           "repeat", "flip", "flipud", "fliplr", "roll", "rot90",
+           "atleast_1d", "atleast_2d", "atleast_3d", "diag", "diagonal",
+           "diagflat", "tril", "triu", "vander", "ediff1d", "diff",
+           "pad", "take_along_axis", "insert", "append", "resize",
+           "interp", "extract", "compress"]:
+    if hasattr(jnp, _n):
+        _g[_n] = np_op(getattr(jnp, _n), name="np_" + _n)
+
+
+def flatten(a, order="C"):
+    return asarray(a).flatten(order=order)
+
+
+def concatenate(seq, axis=0, out=None):
+    def _cat(*arrs):
+        return jnp.concatenate(arrs, axis=axis)
+    _cat.__name__ = "np_concatenate"
+    r = from_nd(apply_fn(_cat, list(seq), {}, name="np_concatenate"))
+    if out is not None:
+        out._data = r._data
+        out._tape_node = r._tape_node
+        out._out_index = r._out_index
+        return out
+    return r
+
+
+def _stack_family(jfn, name):
+    def f(seq, axis=0):
+        def _s(*arrs):
+            if jfn in (jnp.vstack, jnp.hstack, jnp.dstack,
+                       jnp.column_stack):
+                return jfn(arrs)
+            return jfn(arrs, axis=axis)
+        _s.__name__ = name
+        return from_nd(apply_fn(_s, list(seq), {}, name=name))
+    f.__name__ = name
+    return f
+
+
+stack = _stack_family(jnp.stack, "np_stack")
+
+
+def vstack(seq):
+    return _stack_family(jnp.vstack, "np_vstack")(seq)
+
+
+def hstack(seq):
+    return _stack_family(jnp.hstack, "np_hstack")(seq)
+
+
+def dstack(seq):
+    return _stack_family(jnp.dstack, "np_dstack")(seq)
+
+
+def column_stack(seq):
+    return _stack_family(jnp.column_stack, "np_column_stack")(seq)
+
+
+def _split_family(jfn, name):
+    def f(ary, indices_or_sections, axis=0):
+        def _s(d):
+            if jfn in (jnp.hsplit, jnp.vsplit, jnp.dsplit):
+                return tuple(jfn(d, indices_or_sections))
+            return tuple(jfn(d, indices_or_sections, axis=axis))
+        _s.__name__ = name
+        out = apply_fn(_s, [ary], {}, name=name)
+        return [from_nd(o) for o in out]
+    f.__name__ = name
+    return f
+
+
+split = _split_family(jnp.split, "np_split")
+array_split = _split_family(jnp.array_split, "np_array_split")
+hsplit = _split_family(jnp.hsplit, "np_hsplit")
+vsplit = _split_family(jnp.vsplit, "np_vsplit")
+dsplit = _split_family(jnp.dsplit, "np_dsplit")
+
+
+def broadcast_arrays(*args):
+    outs = apply_fn(lambda *a: tuple(jnp.broadcast_arrays(*a)),
+                    list(args), {}, name="np_broadcast_arrays")
+    return [from_nd(o) for o in outs]
+
+
+def delete(arr, obj, axis=None):
+    if isinstance(obj, NDArray):
+        obj = obj.asnumpy()
+    return array(_onp.delete(asarray(arr).asnumpy(), obj, axis=axis),
+                 ctx=asarray(arr)._ctx)
+
+
+# ---------------------------------------------------------------------------
+# sorting / searching / logic
+# ---------------------------------------------------------------------------
+
+sort = np_op(jnp.sort, name="np_sort")
+for _n in ["argsort", "searchsorted", "digitize", "bincount"]:
+    _g[_n] = nondiff_np_op(getattr(jnp, _n), name="np_" + _n)
+
+
+def partition(a, kth, axis=-1):
+    return np_op(jnp.partition, name="np_partition")(a, kth, axis=axis)
+
+
+def argpartition(a, kth, axis=-1):
+    return nondiff_np_op(jnp.argpartition,
+                         name="np_argpartition")(a, kth, axis=axis)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition)
+    return np_op(jnp.where, name="np_where")(condition, x, y)
+
+
+def nonzero(a):
+    return asarray(a).nonzero()
+
+
+def argwhere(a):
+    return array(_onp.argwhere(asarray(a).asnumpy()), dtype="int64",
+                 ctx=asarray(a)._ctx)
+
+
+def flatnonzero(a):
+    return array(_onp.flatnonzero(asarray(a).asnumpy()), dtype="int64",
+                 ctx=asarray(a)._ctx)
+
+
+def unique(ar, return_index=False, return_inverse=False,
+           return_counts=False, axis=None):
+    # data-dependent output shape: host-evaluated, not traced/recorded
+    res = _onp.unique(asarray(ar).asnumpy(), return_index=return_index,
+                      return_inverse=return_inverse,
+                      return_counts=return_counts, axis=axis)
+    ctx = asarray(ar)._ctx
+    if isinstance(res, tuple):
+        return tuple(array(r, ctx=ctx) for r in res)
+    return array(res, ctx=ctx)
+
+
+def take(a, indices, axis=None, mode="clip"):
+    return asarray(a).take(indices, axis=axis, mode=mode)
+
+
+def isclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return nondiff_np_op(jnp.isclose, name="np_isclose")(
+        a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return bool(_onp.allclose(asarray(a).asnumpy(), asarray(b).asnumpy(),
+                              rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def array_equal(a1, a2):
+    return bool(_onp.array_equal(asarray(a1).asnumpy(),
+                                 asarray(a2).asnumpy()))
+
+
+def array_equiv(a1, a2):
+    return bool(_onp.array_equiv(asarray(a1).asnumpy(),
+                                 asarray(a2).asnumpy()))
+
+
+def may_share_memory(a, b, max_work=None):
+    if isinstance(a, NDArray) and isinstance(b, NDArray):
+        return a._data is b._data
+    return False
+
+
+shares_memory = may_share_memory
+
+
+def histogram(a, bins=10, range=None, weights=None, density=None):
+    h, edges = _onp.histogram(asarray(a).asnumpy(), bins=bins, range=range,
+                              weights=None if weights is None
+                              else asarray(weights).asnumpy(),
+                              density=density)
+    ctx = asarray(a)._ctx
+    return array(h, ctx=ctx), array(edges, ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+def shape(a):
+    return asarray(a).shape
+
+
+def ndim(a):
+    return asarray(a).ndim
+
+
+def size(a, axis=None):
+    s = asarray(a).shape
+    if axis is None:
+        r = 1
+        for d in s:
+            r *= d
+        return r
+    return s[axis]
+
+
+def result_type(*arrays_and_dtypes):
+    conv = [a.dtype if isinstance(a, NDArray) else a
+            for a in arrays_and_dtypes]
+    return _onp.result_type(*conv)
+
+
+def can_cast(from_, to, casting="safe"):
+    if isinstance(from_, NDArray):
+        from_ = from_.dtype
+    return _onp.can_cast(from_, to, casting=casting)
+
+
+def polyval(p, x):
+    return np_op(jnp.polyval, name="np_polyval")(p, x)
+
+
+def apply_along_axis(func1d, axis, arr, *args, **kwargs):
+    out = _onp.apply_along_axis(
+        lambda row: _onp.asarray(func1d(array(row), *args, **kwargs)),
+        axis, asarray(arr).asnumpy())
+    return array(out, ctx=asarray(arr)._ctx)
+
+
+# export everything defined here except the implementation machinery
+__all__ = [_n for _n, _v in list(globals().items())
+           if not _n.startswith("_") and _n not in
+           ("jnp", "np_op", "nondiff_np_op", "from_nd", "array",
+            "asarray", "ndarray", "NDArray", "apply_fn",
+            "mod_op_note")]

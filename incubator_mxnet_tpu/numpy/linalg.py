@@ -1,0 +1,98 @@
+"""mx.np.linalg (ref: python/mxnet/numpy/linalg.py over
+src/operator/numpy/linalg/*: gesv/potrf/gelqf etc. LAPACK kernels).
+
+Lifted from jax.numpy.linalg — XLA lowers decompositions to its own
+blocked kernels; all differentiable members are tape-recorded like any
+other op."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .multiarray import np_op, nondiff_np_op, from_nd
+from ..ndarray.ndarray import apply_fn
+
+__all__ = ["norm", "svd", "svdvals", "inv", "pinv", "det", "slogdet",
+           "cholesky", "qr", "eig", "eigh", "eigvals", "eigvalsh",
+           "solve", "lstsq", "tensorinv", "tensorsolve", "matrix_power",
+           "matrix_rank", "multi_dot", "cond"]
+
+norm = np_op(jnp.linalg.norm, name="np_linalg_norm")
+inv = np_op(jnp.linalg.inv, name="np_linalg_inv")
+pinv = np_op(jnp.linalg.pinv, name="np_linalg_pinv")
+det = np_op(jnp.linalg.det, name="np_linalg_det")
+cholesky = np_op(jnp.linalg.cholesky, name="np_linalg_cholesky")
+solve = np_op(jnp.linalg.solve, name="np_linalg_solve")
+tensorinv = np_op(jnp.linalg.tensorinv, name="np_linalg_tensorinv")
+tensorsolve = np_op(jnp.linalg.tensorsolve, name="np_linalg_tensorsolve")
+matrix_power = np_op(jnp.linalg.matrix_power, name="np_linalg_matrix_power")
+matrix_rank = nondiff_np_op(jnp.linalg.matrix_rank,
+                            name="np_linalg_matrix_rank")
+eigvalsh = np_op(jnp.linalg.eigvalsh, name="np_linalg_eigvalsh")
+cond = nondiff_np_op(jnp.linalg.cond, name="np_linalg_cond")
+
+
+def svd(a, full_matrices=False, compute_uv=True):
+    def _svd(d):
+        return jnp.linalg.svd(d, full_matrices=full_matrices,
+                              compute_uv=compute_uv)
+    _svd.__name__ = "np_linalg_svd"
+    out = apply_fn(_svd, [a], {}, name="np_linalg_svd")
+    return from_nd(out)
+
+
+def svdvals(a):
+    return svd(a, compute_uv=False)
+
+
+def slogdet(a):
+    def _f(d):
+        s, ld = jnp.linalg.slogdet(d)
+        return s, ld
+    _f.__name__ = "np_linalg_slogdet"
+    return from_nd(apply_fn(_f, [a], {}, name="np_linalg_slogdet"))
+
+
+def qr(a, mode="reduced"):
+    def _f(d):
+        return jnp.linalg.qr(d, mode=mode)
+    _f.__name__ = "np_linalg_qr"
+    return from_nd(apply_fn(_f, [a], {}, name="np_linalg_qr"))
+
+
+def eig(a):
+    # general eig: CPU-only in XLA; evaluate on host
+    import numpy as _onp
+    from .multiarray import array, asarray
+    w, v = _onp.linalg.eig(asarray(a).asnumpy())
+    return array(w.real if _onp.isrealobj(w) or
+                 _onp.allclose(w.imag, 0) else w), \
+        array(v.real if _onp.isrealobj(v) or
+              _onp.allclose(v.imag, 0) else v)
+
+
+def eigvals(a):
+    return eig(a)[0]
+
+
+def eigh(a, UPLO="L"):
+    def _f(d):
+        return jnp.linalg.eigh(d, symmetrize_input=True)
+    _f.__name__ = "np_linalg_eigh"
+    return from_nd(apply_fn(_f, [a], {}, name="np_linalg_eigh"))
+
+
+def lstsq(a, b, rcond="warn"):
+    rc = None if rcond == "warn" else rcond
+
+    def _f(da, db):
+        return jnp.linalg.lstsq(da, db, rcond=rc)
+    _f.__name__ = "np_linalg_lstsq"
+    return from_nd(apply_fn(_f, [a, b], {}, name="np_linalg_lstsq"))
+
+
+def multi_dot(arrays):
+    def _f(*arrs):
+        return jnp.linalg.multi_dot(arrs)
+    _f.__name__ = "np_linalg_multi_dot"
+    return from_nd(apply_fn(_f, list(arrays), {},
+                            name="np_linalg_multi_dot"))

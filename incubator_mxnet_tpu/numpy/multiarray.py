@@ -1,0 +1,609 @@
+"""mx.np ndarray — the NumPy-semantics array type.
+
+TPU-native analogue of the reference numpy front-end
+(ref: python/mxnet/numpy/multiarray.py + src/operator/numpy/*: the
+`_np_*`/`_npi_*` op families give `mxnet.np` NumPy semantics — zero-dim
+arrays, boolean indexing, NumPy dtype promotion — on top of the same
+engine/NDArray machinery the legacy front-end uses).
+
+Here the design collapses: JAX *is* a NumPy-semantics array library, so
+`mx.np.ndarray` is a thin subclass of the legacy `NDArray` (same PJRT
+buffer, same autograd tape entry) whose operators and module functions
+dispatch straight to `jax.numpy` through the imperative `apply_fn` layer
+— every call is recorded on the tape exactly like a legacy op, so
+`attach_grad`/`backward`/`mx.autograd` work unchanged across both
+front-ends, and `as_np_ndarray()`/`as_nd_ndarray()` are zero-copy views.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+import jax
+import jax.numpy as jnp
+
+from ..base import numeric_types
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray, apply_fn
+from .. import autograd as _ag
+
+__all__ = ["ndarray", "array", "asarray", "zeros", "ones", "empty", "full",
+           "zeros_like", "ones_like", "full_like", "empty_like", "arange",
+           "linspace", "logspace", "geomspace", "eye", "identity", "tril",
+           "triu", "meshgrid", "indices", "frombuffer", "copy",
+           "from_nd", "wrap_np_out", "np_op", "nondiff_np_op"]
+
+# int/bool-valued (or otherwise non-differentiable) results must skip
+# jax.vjp — recording them would fail tracing / produce float0 cotangents
+_NONDIFF = True
+
+
+def from_nd(o):
+    """Zero-copy view of a legacy NDArray (or pytree of them) as mx.np
+    ndarray — shares the buffer AND the autograd tape entry."""
+    if isinstance(o, (tuple, list)):
+        return type(o)(from_nd(x) for x in o)
+    if isinstance(o, NDArray) and not isinstance(o, ndarray):
+        r = ndarray.__new__(ndarray)
+        r._data = o._data
+        r._ctx = o._ctx
+        r._grad = o._grad
+        r._grad_req = o._grad_req
+        r._tape_node = o._tape_node
+        r._out_index = o._out_index
+        return r
+    return o
+
+
+wrap_np_out = from_nd
+
+
+def _apply(jfn, args, kwargs, *, name=None, differentiable=True, ctx=None):
+    out = apply_fn(jfn, list(args), dict(kwargs),
+                   name=name or getattr(jfn, "__name__", "np_op"),
+                   differentiable=differentiable, ctx=ctx)
+    return from_nd(out)
+
+
+def np_op(jfn, name=None):
+    """Lift a jax.numpy function into an mx.np namespace function: ndarray
+    args are unwrapped to buffers, the call is tape-recorded, outputs are
+    wrapped as mx.np.ndarray."""
+    def f(*args, **kwargs):
+        return _apply(jfn, args, kwargs, name=name)
+    f.__name__ = name or getattr(jfn, "__name__", "np_op")
+    f.__doc__ = (jfn.__doc__ or "").split("\n\n")[0] or None
+    return f
+
+
+def nondiff_np_op(jfn, name=None):
+    """Same, for ops with int/bool outputs (never recorded on the tape)."""
+    def f(*args, **kwargs):
+        return _apply(jfn, args, kwargs, name=name, differentiable=False)
+    f.__name__ = name or getattr(jfn, "__name__", "np_op")
+    f.__doc__ = (jfn.__doc__ or "").split("\n\n")[0] or None
+    return f
+
+
+def _is_bool_key(k):
+    if isinstance(k, NDArray):
+        return k.dtype == _onp.bool_
+    if isinstance(k, _onp.ndarray):
+        return k.dtype == _onp.bool_
+    return False
+
+
+class ndarray(NDArray):
+    """NumPy-semantics array (ref: mxnet.numpy.ndarray).
+
+    Differences from the legacy NDArray surface:
+    - operators follow NumPy broadcasting + promotion (jnp semantics)
+    - zero-dim arrays are first-class (``arr[0]`` of a 1-d array is 0-d)
+    - boolean-mask and fancy indexing work
+    - ``repr`` prints ``array(...)`` NumPy-style
+    """
+
+    __slots__ = ()
+
+    # -- views ---------------------------------------------------------
+    def as_np_ndarray(self):
+        return self
+
+    def as_nd_ndarray(self):
+        r = NDArray.__new__(NDArray)
+        r._data = self._data
+        r._ctx = self._ctx
+        r._grad = self._grad
+        r._grad_req = self._grad_req
+        r._tape_node = self._tape_node
+        r._out_index = self._out_index
+        return r
+
+    # -- operators (NumPy promotion/broadcast via jnp) -----------------
+    def _binop(self, other, jfn, name, reverse=False):
+        if isinstance(other, (list, tuple, _onp.ndarray)):
+            other = array(other, ctx=self._ctx)
+        if not isinstance(other, (NDArray,) + numeric_types):
+            return NotImplemented
+        a, b = (other, self) if reverse else (self, other)
+        return _apply(jfn, (a, b), {}, name=name)
+
+    def __add__(self, o):
+        return self._binop(o, jnp.add, "np_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, jnp.subtract, "np_subtract")
+
+    def __rsub__(self, o):
+        return self._binop(o, jnp.subtract, "np_subtract", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, jnp.multiply, "np_multiply")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, jnp.true_divide, "np_true_divide")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, jnp.true_divide, "np_true_divide",
+                           reverse=True)
+
+    def __floordiv__(self, o):
+        return self._binop(o, jnp.floor_divide, "np_floor_divide")
+
+    def __rfloordiv__(self, o):
+        return self._binop(o, jnp.floor_divide, "np_floor_divide",
+                           reverse=True)
+
+    def __mod__(self, o):
+        return self._binop(o, jnp.mod, "np_mod")
+
+    def __rmod__(self, o):
+        return self._binop(o, jnp.mod, "np_mod", reverse=True)
+
+    def __pow__(self, o):
+        return self._binop(o, jnp.power, "np_power")
+
+    def __rpow__(self, o):
+        return self._binop(o, jnp.power, "np_power", reverse=True)
+
+    def __matmul__(self, o):
+        return self._binop(o, jnp.matmul, "np_matmul")
+
+    def __rmatmul__(self, o):
+        return self._binop(o, jnp.matmul, "np_matmul", reverse=True)
+
+    def __neg__(self):
+        return _apply(jnp.negative, (self,), {}, name="np_negative")
+
+    def __pos__(self):
+        return self
+
+    def __abs__(self):
+        return _apply(jnp.abs, (self,), {}, name="np_abs")
+
+    def __invert__(self):
+        return _apply(jnp.invert, (self,), {}, name="np_invert",
+                      differentiable=False)
+
+    def _cmp(self, other, jfn, name):
+        if isinstance(other, (list, tuple, _onp.ndarray)):
+            other = array(other, ctx=self._ctx)
+        if not isinstance(other, (NDArray,) + numeric_types):
+            return NotImplemented
+        return _apply(jfn, (self, other), {}, name=name,
+                      differentiable=False)
+
+    def __eq__(self, o):
+        return self._cmp(o, jnp.equal, "np_equal")
+
+    def __ne__(self, o):
+        return self._cmp(o, jnp.not_equal, "np_not_equal")
+
+    def __lt__(self, o):
+        return self._cmp(o, jnp.less, "np_less")
+
+    def __le__(self, o):
+        return self._cmp(o, jnp.less_equal, "np_less_equal")
+
+    def __gt__(self, o):
+        return self._cmp(o, jnp.greater, "np_greater")
+
+    def __ge__(self, o):
+        return self._cmp(o, jnp.greater_equal, "np_greater_equal")
+
+    __hash__ = None   # mutable container semantics, like numpy
+
+    # in-place: functional rebinding (buffer replaced, like legacy x += y)
+    def __iadd__(self, o):
+        r = self.__add__(o)
+        self._data, self._tape_node, self._out_index = \
+            r._data, r._tape_node, r._out_index
+        return self
+
+    def __isub__(self, o):
+        r = self.__sub__(o)
+        self._data, self._tape_node, self._out_index = \
+            r._data, r._tape_node, r._out_index
+        return self
+
+    def __imul__(self, o):
+        r = self.__mul__(o)
+        self._data, self._tape_node, self._out_index = \
+            r._data, r._tape_node, r._out_index
+        return self
+
+    def __itruediv__(self, o):
+        r = self.__truediv__(o)
+        self._data, self._tape_node, self._out_index = \
+            r._data, r._tape_node, r._out_index
+        return self
+
+    # -- indexing (NumPy semantics: 0-dim results, bool masks, fancy) --
+    def __getitem__(self, key):
+        jkey = self._conv_index(key)
+        has_bool = _is_bool_key(key) or (
+            isinstance(key, tuple) and any(_is_bool_key(k) for k in key))
+
+        def _index(d):
+            return d[jkey]
+        _index.__name__ = "np_getitem"
+        # boolean masks have data-dependent output shape → cannot trace
+        # under vjp; evaluate eagerly, not recorded (matches reference:
+        # boolean indexing is not differentiable there either)
+        return _apply(_index, (self,), {}, name="np_getitem",
+                      differentiable=not has_bool)
+
+    def __setitem__(self, key, value):
+        jkey = self._conv_index(key)
+        if isinstance(value, NDArray):
+            v = value._data
+        elif isinstance(value, numeric_types):
+            v = value
+        else:
+            v = _onp.asarray(value)
+        self._data = self._data.at[jkey].set(v)
+        self._tape_node = None
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of 0-d ndarray")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        try:
+            arr = self.asnumpy()
+        except Exception as e:   # pragma: no cover
+            return "<np.ndarray (unrealised: %s)>" % e
+        body = _onp.array2string(arr, separator=", ")
+        if self._ctx.device_typeid != 1:   # non-default-cpu: show ctx
+            return "array(%s, ctx=%r)" % (body, self._ctx)
+        return "array(%s)" % body
+
+    # -- numpy-style properties / methods ------------------------------
+    @property
+    def T(self):
+        return _apply(jnp.transpose, (self,), {}, name="np_transpose")
+
+    def copy(self):
+        r = ndarray.__new__(ndarray)
+        r._data = self._data
+        r._ctx = self._ctx
+        r._grad = None
+        r._grad_req = None
+        r._tape_node = None
+        r._out_index = 0
+        return r
+
+    def astype(self, dtype, copy=True):
+        from ..base import dtype_np
+        if not copy and self.dtype == dtype_np(dtype):
+            return self
+
+        def _cast(d):
+            return d.astype(dtype_np(dtype))
+        _cast.__name__ = "np_astype"
+        return _apply(_cast, (self,), {}, name="np_astype")
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        order = kwargs.pop("order", "C")
+
+        def _reshape(d):
+            return jnp.reshape(d, shape, order=order)
+        _reshape.__name__ = "np_reshape"
+        return _apply(_reshape, (self,), {}, name="np_reshape")
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        ax = axes if axes else None
+        return _apply(jnp.transpose, (self,), {"axes": ax},
+                      name="np_transpose")
+
+    def flatten(self, order="C"):
+        return self.reshape((-1,), order=order)
+
+    def ravel(self, order="C"):
+        return self.reshape((-1,), order=order)
+
+    def squeeze(self, axis=None):
+        return _apply(jnp.squeeze, (self,), {"axis": axis},
+                      name="np_squeeze")
+
+    def swapaxes(self, a1, a2):
+        return _apply(jnp.swapaxes, (self, a1, a2), {}, name="np_swapaxes")
+
+    def repeat(self, repeats, axis=None):
+        return _apply(jnp.repeat, (self,),
+                      {"repeats": repeats, "axis": axis}, name="np_repeat")
+
+    def clip(self, a_min=None, a_max=None):
+        return _apply(jnp.clip, (self, a_min, a_max), {}, name="np_clip")
+
+    def round(self, decimals=0):
+        return _apply(jnp.round, (self,), {"decimals": decimals},
+                      name="np_round")
+
+    def sum(self, axis=None, dtype=None, keepdims=False):
+        return _apply(jnp.sum, (self,),
+                      {"axis": axis, "dtype": dtype, "keepdims": keepdims},
+                      name="np_sum")
+
+    def prod(self, axis=None, dtype=None, keepdims=False):
+        return _apply(jnp.prod, (self,),
+                      {"axis": axis, "dtype": dtype, "keepdims": keepdims},
+                      name="np_prod")
+
+    def mean(self, axis=None, dtype=None, keepdims=False):
+        return _apply(jnp.mean, (self,),
+                      {"axis": axis, "dtype": dtype, "keepdims": keepdims},
+                      name="np_mean")
+
+    def std(self, axis=None, ddof=0, keepdims=False):
+        return _apply(jnp.std, (self,),
+                      {"axis": axis, "ddof": ddof, "keepdims": keepdims},
+                      name="np_std")
+
+    def var(self, axis=None, ddof=0, keepdims=False):
+        return _apply(jnp.var, (self,),
+                      {"axis": axis, "ddof": ddof, "keepdims": keepdims},
+                      name="np_var")
+
+    def max(self, axis=None, keepdims=False):
+        return _apply(jnp.max, (self,),
+                      {"axis": axis, "keepdims": keepdims}, name="np_max")
+
+    def min(self, axis=None, keepdims=False):
+        return _apply(jnp.min, (self,),
+                      {"axis": axis, "keepdims": keepdims}, name="np_min")
+
+    def argmax(self, axis=None):
+        return _apply(jnp.argmax, (self,), {"axis": axis},
+                      name="np_argmax", differentiable=False)
+
+    def argmin(self, axis=None):
+        return _apply(jnp.argmin, (self,), {"axis": axis},
+                      name="np_argmin", differentiable=False)
+
+    def argsort(self, axis=-1):
+        return _apply(jnp.argsort, (self,), {"axis": axis},
+                      name="np_argsort", differentiable=False)
+
+    def sort(self, axis=-1):
+        # numpy sorts in place; functional rebinding here
+        r = _apply(jnp.sort, (self,), {"axis": axis}, name="np_sort")
+        self._data, self._tape_node = r._data, None
+
+    def cumsum(self, axis=None, dtype=None):
+        return _apply(jnp.cumsum, (self,), {"axis": axis, "dtype": dtype},
+                      name="np_cumsum")
+
+    def dot(self, b):
+        return _apply(jnp.dot, (self, b), {}, name="np_dot")
+
+    def all(self, axis=None, keepdims=False):
+        return _apply(jnp.all, (self,),
+                      {"axis": axis, "keepdims": keepdims},
+                      name="np_all", differentiable=False)
+
+    def any(self, axis=None, keepdims=False):
+        return _apply(jnp.any, (self,),
+                      {"axis": axis, "keepdims": keepdims},
+                      name="np_any", differentiable=False)
+
+    def nonzero(self):
+        d = _onp.nonzero(self.asnumpy())
+        return tuple(array(x, ctx=self._ctx, dtype="int64") for x in d)
+
+    def take(self, indices, axis=None, mode="clip"):
+        if isinstance(indices, NDArray):
+            indices = indices
+        return _apply(jnp.take, (self, indices),
+                      {"axis": axis, "mode": mode}, name="np_take")
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def as_in_context(self, ctx):
+        return from_nd(NDArray.as_in_context(self, ctx))
+
+    as_in_ctx = as_in_context
+
+    def copyto(self, other):
+        return NDArray.copyto(self, other)
+
+    def __reduce__(self):
+        return (_rebuild, (self.asnumpy(), self._ctx))
+
+
+def _rebuild(data, ctx):
+    return array(data, ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def array(source, dtype=None, ctx=None):
+    """mx.np.array (ref: mxnet.numpy.array — default dtype float32)."""
+    if isinstance(source, NDArray):
+        if isinstance(source, ndarray):
+            r = source.copy()
+        else:
+            r = from_nd(source)
+        if dtype is not None:
+            r = r.astype(dtype)
+        if ctx is not None and ctx != r._ctx:
+            r = r.as_in_context(ctx)
+        return r
+    base = NDArray(source, ctx=ctx, dtype=dtype)
+    return from_nd(base)
+
+
+def asarray(source, dtype=None, ctx=None):
+    if isinstance(source, ndarray) and dtype is None and \
+            (ctx is None or ctx == source._ctx):
+        return source
+    return array(source, dtype=dtype, ctx=ctx)
+
+
+def _device_create(jfn_thunk, ctx, name):
+    ctx = ctx or current_context()
+    out = apply_fn(jfn_thunk, [], {}, name=name, ctx=ctx)
+    return from_nd(out)
+
+
+def zeros(shape, dtype="float32", ctx=None):
+    from ..base import dtype_np
+    return _device_create(lambda: jnp.zeros(shape, dtype_np(dtype or
+                                                            "float32")),
+                          ctx, "np_zeros")
+
+
+def ones(shape, dtype="float32", ctx=None):
+    from ..base import dtype_np
+    return _device_create(lambda: jnp.ones(shape, dtype_np(dtype or
+                                                           "float32")),
+                          ctx, "np_ones")
+
+
+def empty(shape, dtype="float32", ctx=None):
+    return zeros(shape, dtype=dtype, ctx=ctx)
+
+
+def full(shape, fill_value, dtype=None, ctx=None):
+    from ..base import dtype_np
+    d = dtype_np(dtype) if dtype is not None else None
+    return _device_create(lambda: jnp.full(shape, fill_value, dtype=d),
+                          ctx, "np_full")
+
+
+def zeros_like(a, dtype=None):
+    return _apply(jnp.zeros_like, (a,), {"dtype": dtype},
+                  name="np_zeros_like", differentiable=False)
+
+
+def ones_like(a, dtype=None):
+    return _apply(jnp.ones_like, (a,), {"dtype": dtype},
+                  name="np_ones_like", differentiable=False)
+
+
+def full_like(a, fill_value, dtype=None):
+    return _apply(jnp.full_like, (a,),
+                  {"fill_value": fill_value, "dtype": dtype},
+                  name="np_full_like", differentiable=False)
+
+
+def empty_like(a, dtype=None):
+    return zeros_like(a, dtype=dtype)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None):
+    from ..base import dtype_np
+    d = dtype_np(dtype) if dtype is not None else None
+    if d is None:
+        # mx.np default: float32 (NumPy would give int64)
+        d = _onp.float32
+    return _device_create(lambda: jnp.arange(start, stop, step, dtype=d),
+                          ctx, "np_arange")
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None):
+    from ..base import dtype_np
+    d = dtype_np(dtype) if dtype is not None else _onp.float32
+    if retstep:
+        vals, step = _onp.linspace(start, stop, num, endpoint=endpoint,
+                                   retstep=True, dtype=d, axis=axis)
+        return array(vals, ctx=ctx), step
+    return _device_create(
+        lambda: jnp.linspace(start, stop, num, endpoint=endpoint,
+                             dtype=d, axis=axis), ctx, "np_linspace")
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             ctx=None):
+    from ..base import dtype_np
+    d = dtype_np(dtype) if dtype is not None else _onp.float32
+    return _device_create(
+        lambda: jnp.logspace(start, stop, num, endpoint=endpoint,
+                             base=base, dtype=d), ctx, "np_logspace")
+
+
+def geomspace(start, stop, num=50, endpoint=True, dtype=None, ctx=None):
+    from ..base import dtype_np
+    d = dtype_np(dtype) if dtype is not None else _onp.float32
+    return _device_create(
+        lambda: jnp.geomspace(start, stop, num, endpoint=endpoint,
+                              dtype=d), ctx, "np_geomspace")
+
+
+def eye(N, M=None, k=0, dtype="float32", ctx=None):
+    from ..base import dtype_np
+    return _device_create(lambda: jnp.eye(N, M, k=k, dtype=dtype_np(dtype)),
+                          ctx, "np_eye")
+
+
+def identity(n, dtype="float32", ctx=None):
+    return eye(n, dtype=dtype, ctx=ctx)
+
+
+def tril(m, k=0):
+    return _apply(jnp.tril, (m,), {"k": k}, name="np_tril")
+
+
+def triu(m, k=0):
+    return _apply(jnp.triu, (m,), {"k": k}, name="np_triu")
+
+
+def meshgrid(*xi, indexing="xy"):
+    outs = _apply(lambda *a: jnp.meshgrid(*a, indexing=indexing), xi, {},
+                  name="np_meshgrid")
+    return list(outs) if isinstance(outs, (tuple, list)) else [outs]
+
+
+def indices(dimensions, dtype="int32", ctx=None):
+    from ..base import dtype_np
+    return _device_create(
+        lambda: jnp.indices(dimensions, dtype=dtype_np(dtype)),
+        ctx, "np_indices")
+
+
+def frombuffer(buffer, dtype=float, count=-1, offset=0):
+    return array(_onp.frombuffer(buffer, dtype=dtype, count=count,
+                                 offset=offset))
+
+
+def copy(a):
+    return asarray(a).copy()

@@ -1,0 +1,236 @@
+"""mx.np.random (ref: python/mxnet/numpy/random.py over
+src/operator/numpy/random/*).
+
+NumPy-style sampling API on the framework's per-context threefry key
+chain (same stateful facade the legacy mx.nd.random uses — one seed
+stream per Context, split per call; see ../random.py)."""
+from __future__ import annotations
+
+import numpy as _onp
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from ..context import current_context
+from .. import random as _rnd
+from ..ndarray.ndarray import NDArray, apply_fn
+from .multiarray import from_nd, array, asarray
+
+__all__ = ["seed", "uniform", "normal", "randn", "rand", "randint",
+           "choice", "shuffle", "permutation", "multinomial", "beta",
+           "gamma", "exponential", "laplace", "logistic", "gumbel",
+           "pareto", "power", "rayleigh", "weibull", "lognormal",
+           "chisquare", "multivariate_normal", "binomial", "poisson",
+           "geometric"]
+
+
+def seed(seed_state):
+    _rnd.seed(seed_state)
+
+
+def _sample(name, sampler, size, ctx=None, dtype="float32"):
+    ctx = ctx or current_context()
+    shape = () if size is None else (
+        (size,) if isinstance(size, int) else tuple(size))
+    key = _rnd.split_key(ctx)
+    d = dtype_np(dtype or "float32")
+
+    def _fn(*arrs):
+        return sampler(key, shape, d, *arrs)
+    _fn.__name__ = name
+    arrs = []
+    return from_nd(apply_fn(_fn, arrs, {}, name=name,
+                            differentiable=False, ctx=ctx))
+
+
+def _as_val(v):
+    return v._data if isinstance(v, NDArray) else v
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype="float32", ctx=None):
+    def s(key, shape, d):
+        lo, hi = _as_val(low), _as_val(high)
+        bshape = jnp.broadcast_shapes(jnp.shape(lo), jnp.shape(hi), shape)
+        return jax.random.uniform(key, bshape, dtype=d) * (hi - lo) + lo
+    return _sample("np_random_uniform", lambda k, sh, d: s(k, sh, d),
+                   size, ctx, dtype)
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype="float32", ctx=None):
+    def s(key, shape, d):
+        mu, sig = _as_val(loc), _as_val(scale)
+        bshape = jnp.broadcast_shapes(jnp.shape(mu), jnp.shape(sig), shape)
+        return jax.random.normal(key, bshape, dtype=d) * sig + mu
+    return _sample("np_random_normal", lambda k, sh, d: s(k, sh, d),
+                   size, ctx, dtype)
+
+
+def randn(*size):
+    return normal(0.0, 1.0, size=size or None)
+
+
+def rand(*size):
+    return uniform(0.0, 1.0, size=size or None)
+
+
+def randint(low, high=None, size=None, dtype="int32", ctx=None):
+    if high is None:
+        low, high = 0, low
+    return _sample("np_random_randint",
+                   lambda k, sh, d: jax.random.randint(k, sh, low, high,
+                                                       dtype=d),
+                   size, ctx, dtype)
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None):
+    ctx = ctx or current_context()
+    shape = () if size is None else (
+        (size,) if isinstance(size, int) else tuple(size))
+    key = _rnd.split_key(ctx)
+    if isinstance(a, NDArray):
+        pool = a._data
+    elif isinstance(a, int):
+        pool = jnp.arange(a)
+    else:
+        pool = jnp.asarray(a)
+    pp = None if p is None else (_as_val(p) if isinstance(p, NDArray)
+                                 else jnp.asarray(p))
+
+    def _fn():
+        return jax.random.choice(key, pool, shape, replace=replace, p=pp)
+    _fn.__name__ = "np_random_choice"
+    return from_nd(apply_fn(_fn, [], {}, name="np_random_choice",
+                            differentiable=False, ctx=ctx))
+
+
+def permutation(x, ctx=None):
+    ctx = ctx or (x._ctx if isinstance(x, NDArray) else current_context())
+    key = _rnd.split_key(ctx)
+    v = x._data if isinstance(x, NDArray) else (
+        jnp.arange(x) if isinstance(x, int) else jnp.asarray(x))
+
+    def _fn():
+        return jax.random.permutation(key, v)
+    _fn.__name__ = "np_random_permutation"
+    return from_nd(apply_fn(_fn, [], {}, name="np_random_permutation",
+                            differentiable=False, ctx=ctx))
+
+
+def shuffle(x):
+    """In-place shuffle along axis 0 (functional rebinding)."""
+    r = permutation(x)
+    x._data = r._data
+    x._tape_node = None
+
+
+def multinomial(n, pvals, size=None):
+    ctx = pvals._ctx if isinstance(pvals, NDArray) else current_context()
+    pv = asarray(pvals)._data
+    shape = () if size is None else (
+        (size,) if isinstance(size, int) else tuple(size))
+    key = _rnd.split_key(ctx)
+
+    def _fn():
+        k = len(pv)
+        draws = jax.random.categorical(
+            key, jnp.log(pv + 1e-30), shape=shape + (n,))
+        return jax.nn.one_hot(draws, k, dtype=jnp.int64).sum(axis=-2)
+    _fn.__name__ = "np_random_multinomial"
+    return from_nd(apply_fn(_fn, [], {}, name="np_random_multinomial",
+                            differentiable=False, ctx=ctx))
+
+
+def _simple(name, draw):
+    def f(*params, size=None, ctx=None, dtype="float32"):
+        ctx = ctx or current_context()
+        shape = () if size is None else (
+            (size,) if isinstance(size, int) else tuple(size))
+        key = _rnd.split_key(ctx)
+        vals = [_as_val(p) for p in params]
+        d = dtype_np(dtype)
+
+        def _fn():
+            bshape = jnp.broadcast_shapes(
+                *[jnp.shape(v) for v in vals], shape)
+            return draw(key, bshape, d, *vals)
+        _fn.__name__ = name
+        return from_nd(apply_fn(_fn, [], {}, name=name,
+                                differentiable=False, ctx=ctx))
+    f.__name__ = name.replace("np_random_", "")
+    return f
+
+
+beta = _simple("np_random_beta",
+               lambda k, sh, d, a, b: jax.random.beta(k, a, b, sh, d))
+gamma = _simple(
+    "np_random_gamma",
+    lambda k, sh, d, shp, scale=1.0:
+        jax.random.gamma(k, shp, sh, d) * scale)
+exponential = _simple(
+    "np_random_exponential",
+    lambda k, sh, d, scale=1.0: jax.random.exponential(k, sh, d) * scale)
+laplace = _simple(
+    "np_random_laplace",
+    lambda k, sh, d, loc=0.0, scale=1.0:
+        jax.random.laplace(k, sh, d) * scale + loc)
+logistic = _simple(
+    "np_random_logistic",
+    lambda k, sh, d, loc=0.0, scale=1.0:
+        jax.random.logistic(k, sh, d) * scale + loc)
+gumbel = _simple(
+    "np_random_gumbel",
+    lambda k, sh, d, loc=0.0, scale=1.0:
+        jax.random.gumbel(k, sh, d) * scale + loc)
+pareto = _simple(
+    "np_random_pareto",
+    lambda k, sh, d, a: jax.random.pareto(k, a, sh, d) - 1.0)
+power = _simple(
+    "np_random_power",
+    lambda k, sh, d, a:
+        jnp.power(jax.random.uniform(k, sh, d), 1.0 / a))
+rayleigh = _simple(
+    "np_random_rayleigh",
+    lambda k, sh, d, scale=1.0:
+        scale * jnp.sqrt(-2.0 * jnp.log(
+            1.0 - jax.random.uniform(k, sh, d))))
+weibull = _simple(
+    "np_random_weibull",
+    lambda k, sh, d, a:
+        jnp.power(-jnp.log(1.0 - jax.random.uniform(k, sh, d)), 1.0 / a))
+lognormal = _simple(
+    "np_random_lognormal",
+    lambda k, sh, d, mean=0.0, sigma=1.0:
+        jnp.exp(jax.random.normal(k, sh, d) * sigma + mean))
+chisquare = _simple(
+    "np_random_chisquare",
+    lambda k, sh, d, df: 2.0 * jax.random.gamma(k, df / 2.0, sh, d))
+poisson = _simple(
+    "np_random_poisson",
+    lambda k, sh, d, lam=1.0:
+        jax.random.poisson(k, lam, sh).astype(d))
+binomial = _simple(
+    "np_random_binomial",
+    lambda k, sh, d, n, p:
+        jnp.sum(jax.random.uniform(k, sh + (int(n),)) < p,
+                axis=-1).astype(d))
+geometric = _simple(
+    "np_random_geometric",
+    lambda k, sh, d, p:
+        jnp.floor(jnp.log(1.0 - jax.random.uniform(k, sh, jnp.float32)) /
+                  jnp.log(1.0 - p)).astype(d) + 1)
+
+
+def multivariate_normal(mean, cov, size=None, ctx=None):
+    mean = asarray(mean)
+    cov = asarray(cov)
+    ctx = ctx or mean._ctx
+    shape = () if size is None else (
+        (size,) if isinstance(size, int) else tuple(size))
+    key = _rnd.split_key(ctx)
+
+    def _fn(m, c):
+        return jax.random.multivariate_normal(key, m, c, shape or None)
+    _fn.__name__ = "np_random_mvn"
+    return from_nd(apply_fn(_fn, [mean, cov], {}, name="np_random_mvn",
+                            differentiable=False, ctx=ctx))

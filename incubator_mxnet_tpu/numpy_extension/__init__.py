@@ -1,0 +1,278 @@
+"""mx.npx — NumPy-extension namespace (ref: python/mxnet/numpy_extension/
++ the `_npx_*` op family in src/operator/numpy/).
+
+Neural-network ops that have no NumPy counterpart, exposed over np
+ndarrays: activation/norm/conv wrappers, set_np/reset_np mode switches,
+npx.save/load.  Every op routes through the SAME registry the legacy
+mx.nd front-end uses (one op universe, two array views — the collapse
+the reference couldn't make because its two universes were separate C++
+op families)."""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..util import set_np, reset_np, is_np_array, use_np  # noqa: F401
+from ..ndarray.ndarray import NDArray, invoke
+from ..numpy.multiarray import from_nd, array as _np_array, ndarray
+
+__all__ = ["set_np", "reset_np", "is_np_array", "use_np", "save", "load",
+           "relu", "sigmoid", "softmax", "log_softmax", "activation",
+           "leaky_relu", "batch_norm", "layer_norm", "group_norm",
+           "instance_norm", "l2_normalize", "convolution", "deconvolution",
+           "fully_connected", "pooling", "dropout", "embedding", "one_hot",
+           "pick", "topk", "batch_dot", "gamma", "gammaln", "erf",
+           "erfinv", "reshape_like", "broadcast_like", "sequence_mask",
+           "smooth_l1", "gather_nd", "scatter_nd", "rnn", "ctc_loss",
+           "multibox_prior", "multibox_detection", "multibox_target",
+           "box_nms", "box_iou", "roi_align", "roi_pooling", "shape_array",
+           "waitall", "cpu", "gpu", "num_gpus", "current_context"]
+
+from ..context import cpu, gpu, num_gpus, current_context  # noqa: F401,E402
+
+
+def waitall():
+    from .. import ndarray as nd
+    nd.waitall()
+
+
+def _op(opname, *args, **kwargs):
+    out = invoke(opname, *args, **kwargs)
+    return from_nd(out)
+
+
+def relu(data):
+    return _op("relu", data)
+
+
+def sigmoid(data):
+    return _op("sigmoid", data)
+
+
+def softmax(data, axis=-1, length=None, temperature=None):
+    kw = {"axis": axis}
+    if temperature is not None:
+        kw["temperature"] = temperature
+    return _op("softmax", data, **kw)
+
+
+def log_softmax(data, axis=-1):
+    return _op("log_softmax", data, axis=axis)
+
+
+def activation(data, act_type="relu"):
+    return _op("Activation", data, act_type=act_type)
+
+
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, **kw):
+    if gamma is not None:
+        return _op("LeakyReLU", data, gamma, act_type=act_type,
+                   slope=slope, **kw)
+    return _op("LeakyReLU", data, act_type=act_type, slope=slope, **kw)
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-3,
+               momentum=0.9, fix_gamma=False, use_global_stats=False,
+               axis=1, **kw):
+    return _op("BatchNorm", x, gamma, beta, running_mean, running_var,
+               eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+               use_global_stats=use_global_stats, axis=axis, **kw)
+
+
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
+    return _op("LayerNorm", data, gamma, beta, axis=axis, eps=eps)
+
+
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    return _op("GroupNorm", data, gamma, beta, num_groups=num_groups,
+               eps=eps)
+
+
+def instance_norm(data, gamma, beta, eps=1e-3):
+    return _op("InstanceNorm", data, gamma, beta, eps=eps)
+
+
+def l2_normalize(data, eps=1e-10, mode="instance"):
+    return _op("L2Normalization", data, eps=eps, mode=mode)
+
+
+def convolution(data=None, weight=None, bias=None, **kwargs):
+    args = [a for a in (data, weight, bias) if a is not None]
+    return _op("Convolution", *args, **kwargs)
+
+
+def deconvolution(data=None, weight=None, bias=None, **kwargs):
+    args = [a for a in (data, weight, bias) if a is not None]
+    return _op("Deconvolution", *args, **kwargs)
+
+
+def fully_connected(x, weight, bias=None, num_hidden=None,
+                    no_bias=False, flatten=True):
+    if bias is None:
+        return _op("FullyConnected", x, weight, num_hidden=num_hidden,
+                   no_bias=True, flatten=flatten)
+    return _op("FullyConnected", x, weight, bias, num_hidden=num_hidden,
+               no_bias=no_bias, flatten=flatten)
+
+
+def pooling(data, **kwargs):
+    return _op("Pooling", data, **kwargs)
+
+
+def dropout(data, p=0.5, mode="training", **kw):
+    return _op("Dropout", data, p=p, mode=mode, **kw)
+
+
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False):
+    kw = {}
+    if input_dim is not None:
+        kw["input_dim"] = input_dim
+    if output_dim is not None:
+        kw["output_dim"] = output_dim
+    if dtype is not None:
+        kw["dtype"] = dtype
+    return _op("Embedding", data, weight, sparse_grad=sparse_grad, **kw)
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    return _op("one_hot", data, depth=depth, on_value=on_value,
+               off_value=off_value, dtype=dtype)
+
+
+def pick(data, index, axis=-1, mode="clip", keepdims=False):
+    return _op("pick", data, index, axis=axis, mode=mode,
+               keepdims=keepdims)
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+         dtype="float32"):
+    return _op("topk", data, axis=axis, k=k, ret_typ=ret_typ,
+               is_ascend=is_ascend, dtype=dtype)
+
+
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    return _op("batch_dot", a, b, transpose_a=transpose_a,
+               transpose_b=transpose_b)
+
+
+def gamma(data):
+    return _op("gamma", data)
+
+
+def gammaln(data):
+    return _op("gammaln", data)
+
+
+def erf(data):
+    return _op("erf", data)
+
+
+def erfinv(data):
+    return _op("erfinv", data)
+
+
+def reshape_like(lhs, rhs):
+    return _op("reshape_like", lhs, rhs)
+
+
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    return _op("broadcast_like", lhs, rhs, lhs_axes=lhs_axes,
+               rhs_axes=rhs_axes)
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    if sequence_length is not None:
+        return _op("SequenceMask", data, sequence_length,
+                   use_sequence_length=True, value=value, axis=axis)
+    return _op("SequenceMask", data, use_sequence_length=False,
+               value=value, axis=axis)
+
+
+def smooth_l1(data, scalar=1.0):
+    return _op("smooth_l1", data, scalar=scalar)
+
+
+def gather_nd(data, indices):
+    return _op("gather_nd", data, indices)
+
+
+def scatter_nd(data, indices, shape):
+    return _op("scatter_nd", data, indices, shape=shape)
+
+
+def rnn(data, parameters, state, state_cell=None, **kwargs):
+    args = [data, parameters, state]
+    if state_cell is not None:
+        args.append(state_cell)
+    return _op("RNN", *args, **kwargs)
+
+
+def ctc_loss(data, label, data_lengths=None, label_lengths=None, **kw):
+    args = [data, label]
+    if data_lengths is not None:
+        args.append(data_lengths)
+    if label_lengths is not None:
+        args.append(label_lengths)
+    return _op("ctc_loss", *args, **kw)
+
+
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    return _op("MultiBoxPrior", data, sizes=sizes, ratios=ratios,
+               clip=clip, steps=steps, offsets=offsets)
+
+
+def multibox_detection(cls_prob, loc_pred, anchor, **kw):
+    return _op("MultiBoxDetection", cls_prob, loc_pred, anchor, **kw)
+
+
+def multibox_target(anchor, label, cls_pred, **kw):
+    return _op("MultiBoxTarget", anchor, label, cls_pred, **kw)
+
+
+def box_nms(data, **kw):
+    return _op("box_nms", data, **kw)
+
+
+def box_iou(lhs, rhs, format="corner"):
+    return _op("box_iou", lhs, rhs, format=format)
+
+
+def roi_align(data, rois, pooled_size, spatial_scale, sample_ratio=-1,
+              **kw):
+    return _op("ROIAlign", data, rois, pooled_size=pooled_size,
+               spatial_scale=spatial_scale, sample_ratio=sample_ratio,
+               **kw)
+
+
+def roi_pooling(data, rois, pooled_size, spatial_scale):
+    return _op("ROIPooling", data, rois, pooled_size=pooled_size,
+               spatial_scale=spatial_scale)
+
+
+def shape_array(data):
+    return _op("shape_array", data)
+
+
+def save(file, arr):
+    """npx.save — same 0x112-magic container as nd.save (round-trips with
+    the legacy front-end and the reference's on-disk format)."""
+    from .. import ndarray as nd
+    if isinstance(arr, dict):
+        nd.save(file, {k: v.as_nd_ndarray() if isinstance(v, ndarray)
+                       else v for k, v in arr.items()})
+    elif isinstance(arr, (list, tuple)):
+        nd.save(file, [v.as_nd_ndarray() if isinstance(v, ndarray) else v
+                       for v in arr])
+    else:
+        nd.save(file, arr.as_nd_ndarray() if isinstance(arr, ndarray)
+                else arr)
+
+
+def load(file):
+    from .. import ndarray as nd
+    out = nd.load(file)
+    if isinstance(out, dict):
+        return {k: from_nd(v) for k, v in out.items()}
+    return [from_nd(v) for v in out]

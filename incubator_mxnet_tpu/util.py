@@ -19,7 +19,33 @@ def reset_np():
 
 
 def use_np(func):
-    return func
+    """Decorator: run `func` (or every method of a class) with the np
+    array flag on (ref: python/mxnet/util.py use_np = use_np_shape +
+    use_np_array)."""
+    import functools
+    import inspect
+    if inspect.isclass(func):
+        for name, m in list(vars(func).items()):
+            if name.startswith("__"):
+                continue
+            if isinstance(m, staticmethod):
+                setattr(func, name, staticmethod(use_np(m.__func__)))
+            elif isinstance(m, classmethod):
+                setattr(func, name, classmethod(use_np(m.__func__)))
+            elif callable(m):
+                setattr(func, name, use_np(m))
+        return func
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        global _NP_ARRAY
+        prev = _NP_ARRAY
+        _NP_ARRAY = True
+        try:
+            return func(*args, **kwargs)
+        finally:
+            _NP_ARRAY = prev
+    return wrapper
 
 
 def makedirs(d):

@@ -1,0 +1,305 @@
+"""mx.np / mx.npx front-end tests (ref: tests/python/unittest/
+test_numpy_op.py + test_numpy_ndarray.py + test_numpy_gluon.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd as ag
+
+np = mx.np
+npx = mx.npx
+
+
+# ---------------------------------------------------------------------------
+# ndarray semantics
+# ---------------------------------------------------------------------------
+
+def test_creation_and_dtype_defaults():
+    a = np.array([1, 2, 3])
+    assert a.dtype == onp.float32          # mx.np default dtype
+    assert np.arange(5).dtype == onp.float32
+    assert np.zeros((2, 3)).shape == (2, 3)
+    assert np.ones((2,), dtype="int32").dtype == onp.int32
+    assert np.full((2, 2), 7.0).asnumpy().tolist() == [[7, 7], [7, 7]]
+    assert np.eye(3).asnumpy().trace() == 3.0
+    assert np.linspace(0, 1, 5).shape == (5,)
+
+
+def test_zero_dim_and_scalars():
+    a = np.arange(6).reshape(2, 3)
+    z = a[0, 1]
+    assert z.shape == ()
+    assert float(z) == 1.0
+    s = a.sum()
+    assert s.shape == ()
+    assert s.item() == 15.0
+
+
+def test_operator_broadcasting_and_promotion():
+    a = np.arange(6).reshape(2, 3)
+    b = np.ones((1, 3))
+    c = a + b * 3 - 1
+    assert onp.allclose(c.asnumpy(),
+                        onp.arange(6).reshape(2, 3) + 2)
+    # scalar ops, rops
+    assert onp.allclose((2 ** np.array([1., 2.])).asnumpy(), [2., 4.])
+    assert onp.allclose((10 / np.array([2., 5.])).asnumpy(), [5., 2.])
+    # matmul operator
+    m = np.ones((2, 3)) @ np.ones((3, 4))
+    assert m.shape == (2, 4) and float(m[0, 0]) == 3.0
+
+
+def test_comparison_and_boolean_indexing():
+    a = np.arange(6).reshape(2, 3)
+    m = a > 2
+    assert m.dtype == onp.bool_
+    sel = a[m]
+    assert sel.asnumpy().tolist() == [3., 4., 5.]
+    # setitem with mask
+    b = np.arange(6.0)
+    b[b < 3] = 0
+    assert b.asnumpy().tolist() == [0, 0, 0, 3, 4, 5]
+
+
+def test_fancy_indexing():
+    a = np.arange(12).reshape(3, 4)
+    idx = np.array([0, 2], dtype="int32")
+    sub = a[idx]
+    assert sub.shape == (2, 4)
+    assert onp.allclose(sub.asnumpy(), onp.arange(12).reshape(3, 4)[[0, 2]])
+
+
+def test_inplace_rebinding():
+    a = np.ones((3,))
+    a += 2
+    assert a.asnumpy().tolist() == [3., 3., 3.]
+    a *= 2
+    assert a.asnumpy().tolist() == [6., 6., 6.]
+
+
+def test_views_between_frontends():
+    legacy = mx.nd.array([[1., 2.]])
+    v = legacy.as_np_ndarray()
+    # legacy NDArray.as_np_ndarray returns self (pre-np-mode behavior);
+    # explicit np conversion:
+    v2 = np.array(legacy)
+    assert isinstance(v2, np.ndarray)
+    back = v2.as_nd_ndarray()
+    assert type(back) is mx.nd.NDArray
+    assert back._data is v2._data          # zero-copy
+
+
+# ---------------------------------------------------------------------------
+# function catalog
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name,args", [
+    ("exp", ([0.5, 1.0],)),
+    ("log", ([0.5, 1.0],)),
+    ("sqrt", ([4.0, 9.0],)),
+    ("tanh", ([0.1, -0.2],)),
+    ("sin", ([0.3],)),
+    ("arctan", ([0.4],)),
+    ("floor", ([1.7],)),
+    ("sign", ([-3.0, 2.0],)),
+])
+def test_unary_matches_numpy(name, args):
+    x = onp.array(args[0], dtype=onp.float32)
+    got = getattr(np, name)(np.array(x)).asnumpy()
+    want = getattr(onp, name)(x)
+    assert onp.allclose(got, want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["add", "subtract", "multiply",
+                                  "maximum", "minimum", "hypot",
+                                  "arctan2", "power"])
+def test_binary_matches_numpy(name):
+    a = onp.array([[1.0, 2.0], [3.0, 4.0]], onp.float32)
+    b = onp.array([2.0, 0.5], onp.float32)
+    got = getattr(np, name)(np.array(a), np.array(b)).asnumpy()
+    want = getattr(onp, name)(a, b)
+    assert onp.allclose(got, want, rtol=1e-5)
+
+
+def test_reductions():
+    a = onp.random.RandomState(0).randn(3, 4).astype(onp.float32)
+    x = np.array(a)
+    assert onp.allclose(np.sum(x, axis=1).asnumpy(), a.sum(1), rtol=1e-5)
+    assert onp.allclose(np.mean(x).asnumpy(), a.mean(), rtol=1e-5)
+    assert onp.allclose(np.std(x, axis=0).asnumpy(), a.std(0), rtol=1e-4)
+    assert onp.allclose(np.var(x, ddof=1).asnumpy(), a.var(ddof=1),
+                        rtol=1e-4)
+    assert int(np.argmax(x)) == int(a.argmax())
+    assert onp.allclose(np.cumsum(x, axis=1).asnumpy(), a.cumsum(1),
+                        rtol=1e-5)
+    assert bool(np.all(np.array([1, 1])))
+    assert not bool(np.all(np.array([1, 0])))
+
+
+def test_manipulation():
+    a = np.arange(12).reshape(3, 4)
+    assert np.transpose(a).shape == (4, 3)
+    assert np.expand_dims(a, 0).shape == (1, 3, 4)
+    assert np.squeeze(np.expand_dims(a, 0)).shape == (3, 4)
+    assert np.concatenate([a, a], axis=0).shape == (6, 4)
+    assert np.stack([a, a]).shape == (2, 3, 4)
+    parts = np.split(a, 2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (3, 2)
+    assert np.tile(a, (2, 1)).shape == (6, 4)
+    assert np.flip(a, axis=1)[0, 0].item() == 3.0
+    assert np.broadcast_to(np.ones((1, 4)), (3, 4)).shape == (3, 4)
+    assert np.vstack([a, a]).shape == (6, 4)
+    assert np.hstack([a, a]).shape == (3, 8)
+    assert np.moveaxis(np.zeros((2, 3, 5)), 0, -1).shape == (3, 5, 2)
+
+
+def test_sorting_searching():
+    a = np.array([3.0, 1.0, 2.0])
+    assert np.sort(a).asnumpy().tolist() == [1., 2., 3.]
+    assert np.argsort(a).asnumpy().tolist() == [1, 2, 0]
+    w = np.where(a > 1.5, a, np.zeros_like(a))
+    assert w.asnumpy().tolist() == [3., 0., 2.]
+    u = np.unique(np.array([1., 2., 2., 3.]))
+    assert u.asnumpy().tolist() == [1., 2., 3.]
+    nz = np.nonzero(np.array([0., 1., 0., 2.]))
+    assert nz[0].asnumpy().tolist() == [1, 3]
+
+
+def test_linalg_and_einsum():
+    rs = onp.random.RandomState(0)
+    a = rs.randn(4, 4).astype(onp.float32)
+    x = np.array(a)
+    assert onp.allclose(np.linalg.norm(x).asnumpy(),
+                        onp.linalg.norm(a), rtol=1e-4)
+    inv = np.linalg.inv(x)
+    assert onp.allclose((x @ inv).asnumpy(), onp.eye(4), atol=1e-3)
+    spd = a @ a.T + 4 * onp.eye(4, dtype=onp.float32)
+    c = np.linalg.cholesky(np.array(spd))
+    assert onp.allclose((c @ c.T).asnumpy(), spd, rtol=1e-3, atol=1e-3)
+    s, ld = np.linalg.slogdet(np.array(spd))
+    os_, old = onp.linalg.slogdet(spd)
+    assert float(s) == pytest.approx(float(os_))
+    assert float(ld) == pytest.approx(float(old), rel=1e-4)
+    e = np.einsum("ij,jk->ik", x, x)
+    assert onp.allclose(e.asnumpy(), a @ a, rtol=1e-4)
+
+
+def test_random():
+    np.random.seed(0)
+    u = np.random.uniform(2.0, 3.0, size=(1000,))
+    un = u.asnumpy()
+    assert (un >= 2.0).all() and (un < 3.0).all()
+    n = np.random.normal(5.0, 0.1, size=(2000,))
+    assert abs(float(n.mean()) - 5.0) < 0.05
+    r = np.random.randint(0, 10, size=(100,))
+    rn = r.asnumpy()
+    assert rn.min() >= 0 and rn.max() < 10
+    c = np.random.choice(5, size=(50,))
+    assert (c.asnumpy() < 5).all()
+    p = np.random.permutation(10)
+    assert sorted(p.asnumpy().tolist()) == list(range(10))
+
+
+# ---------------------------------------------------------------------------
+# autograd over np arrays
+# ---------------------------------------------------------------------------
+
+def test_autograd_basic():
+    x = np.array([[1., 2.], [3., 4.]])
+    x.attach_grad()
+    with ag.record():
+        y = np.sum(x * x + 2 * x)
+    y.backward()
+    assert onp.allclose(x.grad.asnumpy(), 2 * x.asnumpy() + 2)
+
+
+def test_autograd_chain_mixed_functions():
+    x = np.array([0.5, 1.5])
+    x.attach_grad()
+    with ag.record():
+        y = np.sum(np.exp(x) * np.sin(x))
+    y.backward()
+    xa = x.asnumpy()
+    want = onp.exp(xa) * onp.sin(xa) + onp.exp(xa) * onp.cos(xa)
+    assert onp.allclose(x.grad.asnumpy(), want, rtol=1e-5)
+
+
+def test_autograd_matmul_grad():
+    a = np.ones((2, 3))
+    b = np.ones((3, 4))
+    a.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        c = np.sum(a @ b)
+    c.backward()
+    assert onp.allclose(a.grad.asnumpy(), 4 * onp.ones((2, 3)))
+    assert onp.allclose(b.grad.asnumpy(), 2 * onp.ones((3, 4)))
+
+
+# ---------------------------------------------------------------------------
+# npx + np-mode Gluon
+# ---------------------------------------------------------------------------
+
+def test_npx_ops():
+    x = np.array([[-1.0, 2.0]])
+    assert npx.relu(x).asnumpy().tolist() == [[0.0, 2.0]]
+    s = npx.softmax(np.array([[1.0, 1.0]]))
+    assert onp.allclose(s.asnumpy(), [[0.5, 0.5]])
+    oh = npx.one_hot(np.array([0, 2], dtype="int32"), 3)
+    assert oh.asnumpy().tolist() == [[1, 0, 0], [0, 0, 1]]
+    t = npx.topk(np.array([[1.0, 3.0, 2.0]]), k=2)
+    assert t.asnumpy()[0].tolist() == [1, 2]
+
+
+def test_npx_save_load(tmp_path):
+    f = str(tmp_path / "arrs.npz")
+    npx.save(f, {"a": np.arange(4), "b": np.ones((2, 2))})
+    out = npx.load(f)
+    assert isinstance(out["a"], np.ndarray)
+    assert out["a"].asnumpy().tolist() == [0, 1, 2, 3]
+
+
+def test_np_mode_gluon_dense_training():
+    npx.set_np()
+    try:
+        net = mx.gluon.nn.Dense(4, in_units=8)
+        net.initialize()
+        x = np.ones((2, 8))
+        out = net(x)
+        assert isinstance(out, np.ndarray)
+        assert isinstance(net.weight.data(), np.ndarray)
+        loss_fn = mx.gluon.loss.L2Loss()
+        trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                                   {"learning_rate": 0.1})
+        with ag.record():
+            loss = loss_fn(net(x), np.zeros((2, 4)))
+            loss.backward()
+        w_before = net.weight.data().asnumpy().copy()
+        trainer.step(2)
+        assert isinstance(net.weight.grad(), np.ndarray)
+        assert not onp.allclose(net.weight.data().asnumpy(), w_before)
+    finally:
+        npx.reset_np()
+
+
+def test_np_mode_hybridized_block():
+    npx.set_np()
+    try:
+        net = mx.gluon.nn.Dense(3, in_units=5)
+        net.initialize()
+        net.hybridize()
+        out = net(np.ones((2, 5)))
+        assert isinstance(out, np.ndarray)
+        out2 = net(np.ones((2, 5)))          # cached path
+        assert isinstance(out2, np.ndarray)
+        assert onp.allclose(out.asnumpy(), out2.asnumpy())
+    finally:
+        npx.reset_np()
+
+
+def test_use_np_decorator():
+    @mx.use_np
+    def f():
+        return mx.is_np_array()
+    assert f() is True
+    assert mx.is_np_array() is False
