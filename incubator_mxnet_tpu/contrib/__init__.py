@@ -1,8 +1,9 @@
 """mx.contrib namespace (ref: python/mxnet/contrib/__init__.py).
 
-Subpackages land as they are built: `amp` (automatic mixed precision),
-`quantization` (int8 inference).
+Subpackages: `amp` (automatic mixed precision), `quantization`
+(int8 post-training quantization + calibration).
 """
 from . import amp
+from . import quantization
 
-__all__ = ["amp"]
+__all__ = ["amp", "quantization"]

@@ -1,0 +1,592 @@
+"""INT8 post-training quantization with calibration
+(ref: python/mxnet/contrib/quantization.py — quantize_model /
+quantize_net, LayerOutputCollector, KL-divergence calibration).
+
+Two entry points, mirroring the reference:
+
+- ``quantize_net(net, ...)`` — Gluon path: swaps Dense/Conv2D children
+  for int8 wrappers (activation quantize → int8 GEMM/conv on the MXU →
+  calibrated requantize → dequantize), calibrating ranges with forward
+  hooks over a few batches.
+- ``quantize_model(sym, arg_params, aux_params, ...)`` — legacy symbolic
+  path: a JSON graph pass inserting `_contrib_quantize_v2` /
+  `_contrib_quantized_*` / `_contrib_dequantize` nodes around
+  FullyConnected/Convolution, exactly where the reference's
+  QuantizeGraph pass rewires the nnvm graph.
+
+Calibration modes: ``naive`` (min/max over calibration batches) and
+``entropy`` (KL-divergence optimal thresholds, the reference's
+`_get_optimal_threshold` algorithm).
+"""
+from __future__ import annotations
+
+import json
+import logging
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["quantize_net", "quantize_model", "QuantizedDense",
+           "QuantizedConv2D", "_get_optimal_threshold",
+           "LayerOutputMinMaxCollector", "LayerHistogramCollector"]
+
+
+# ---------------------------------------------------------------------------
+# KL-divergence threshold (ref: _get_optimal_threshold)
+# ---------------------------------------------------------------------------
+
+def _smooth_distribution(p, eps=0.0001):
+    is_zeros = (p == 0).astype(_np.float32)
+    is_nonzeros = (p != 0).astype(_np.float32)
+    n_zeros = is_zeros.sum()
+    n_nonzeros = p.size - n_zeros
+    if not n_nonzeros:
+        raise ValueError("all-zero histogram")
+    eps1 = eps * float(n_zeros) / float(n_nonzeros)
+    hist = p.astype(_np.float32)
+    hist += eps * is_zeros + (-eps1) * is_nonzeros
+    return hist
+
+
+def _kl_divergence(p, q):
+    mask = p > 0
+    return float(_np.sum(p[mask] * _np.log(p[mask] / q[mask])))
+
+
+def _get_optimal_threshold(hist_and_edges, quantized_dtype="int8",
+                           num_quantized_bins=255):
+    """Pick the |threshold| minimising KL(reference ‖ quantized) over the
+    activation histogram (ref algorithm, 8001-bin histogram → 255-bin
+    quantized candidates)."""
+    hist, hist_edges = hist_and_edges
+    num_bins = hist.size
+    assert num_bins % 2 == 1
+    zero_bin_idx = num_bins // 2
+    num_half_quantized_bins = num_quantized_bins // 2
+
+    thresholds = _np.zeros(zero_bin_idx + 1 - num_half_quantized_bins)
+    divergence = _np.full_like(thresholds, _np.inf)
+    for i in range(num_half_quantized_bins, zero_bin_idx + 1):
+        p_bin_idx_start = zero_bin_idx - i
+        p_bin_idx_stop = zero_bin_idx + i + 1
+        thresholds[i - num_half_quantized_bins] = hist_edges[p_bin_idx_stop]
+        sliced = hist[p_bin_idx_start:p_bin_idx_stop].astype(_np.float64)
+
+        p = sliced.copy()
+        left_outliers = hist[:p_bin_idx_start].sum()
+        right_outliers = hist[p_bin_idx_stop:].sum()
+        p[0] += left_outliers
+        p[-1] += right_outliers
+        is_nonzeros = (p != 0).astype(_np.int64)
+
+        # quantize the sliced distribution into num_quantized_bins
+        num_merged_bins = sliced.size // num_quantized_bins
+        quantized = _np.zeros(num_quantized_bins)
+        for j in range(num_quantized_bins):
+            start = j * num_merged_bins
+            stop = start + num_merged_bins
+            quantized[j] = sliced[start:stop].sum()
+        quantized[-1] += sliced[num_quantized_bins * num_merged_bins:].sum()
+        # expand back
+        q = _np.zeros(sliced.size)
+        for j in range(num_quantized_bins):
+            start = j * num_merged_bins
+            stop = q.size if j == num_quantized_bins - 1 \
+                else start + num_merged_bins
+            norm = is_nonzeros[start:stop].sum()
+            if norm:
+                q[start:stop] = quantized[j] / norm
+        q[p == 0] = 0
+        try:
+            p = _smooth_distribution(p)
+            q = _smooth_distribution(q)
+        except ValueError:
+            continue
+        psum = p.sum()
+        qsum = q.sum()
+        if psum and qsum:
+            divergence[i - num_half_quantized_bins] = _kl_divergence(
+                p / psum, q / qsum)
+
+    best = int(_np.argmin(divergence))
+    return float(thresholds[best])
+
+
+# ---------------------------------------------------------------------------
+# collectors (ref: _LayerOutputCollector / _LayerOutputMinMaxCollector)
+# ---------------------------------------------------------------------------
+
+class LayerOutputMinMaxCollector:
+    """Records running min/max per named tensor."""
+
+    def __init__(self):
+        self.min_max = {}
+
+    def collect(self, name, arr):
+        a = arr.asnumpy() if hasattr(arr, "asnumpy") else _np.asarray(arr)
+        mn, mx = float(a.min()), float(a.max())
+        if name in self.min_max:
+            omn, omx = self.min_max[name]
+            self.min_max[name] = (min(mn, omn), max(mx, omx))
+        else:
+            self.min_max[name] = (mn, mx)
+
+    def range_of(self, name):
+        return self.min_max[name]
+
+
+class LayerHistogramCollector:
+    """Accumulates a symmetric 8001-bin histogram per named tensor for
+    entropy calibration."""
+
+    def __init__(self, num_bins=8001):
+        self.num_bins = num_bins
+        self.hist = {}
+
+    def collect(self, name, arr):
+        a = _np.abs(arr.asnumpy() if hasattr(arr, "asnumpy")
+                    else _np.asarray(arr))
+        th = float(a.max())
+        if th == 0.0:
+            th = 1e-8
+        if name in self.hist:
+            old_hist, old_edges, old_th = self.hist[name]
+            if th <= old_th:
+                h, _ = _np.histogram(a, bins=self.num_bins,
+                                     range=(-old_th, old_th))
+                self.hist[name] = (old_hist + h, old_edges, old_th)
+                return
+            # re-bin the old histogram into the wider range
+            new_hist, new_edges = _np.histogram(a, bins=self.num_bins,
+                                                range=(-th, th))
+            centers = (old_edges[:-1] + old_edges[1:]) / 2
+            idx = _np.searchsorted(new_edges, centers) - 1
+            idx = _np.clip(idx, 0, self.num_bins - 1)
+            _np.add.at(new_hist, idx, old_hist)
+            self.hist[name] = (new_hist, new_edges, th)
+        else:
+            h, edges = _np.histogram(a, bins=self.num_bins,
+                                     range=(-th, th))
+            self.hist[name] = (h, edges, th)
+
+    def range_of(self, name):
+        hist, edges, _th = self.hist[name]
+        t = _get_optimal_threshold((hist, edges))
+        return (-t, t)
+
+
+# ---------------------------------------------------------------------------
+# Gluon wrappers
+# ---------------------------------------------------------------------------
+
+def _quantize_weight(w):
+    """Symmetric per-tensor int8 weights (ref: quantize weights offline
+    with MaxAbs)."""
+    import jax.numpy as jnp
+    from ..ndarray.ndarray import NDArray
+    a = w.asnumpy()
+    amax = float(_np.abs(a).max()) or 1e-8
+    q = _np.clip(_np.round(a / (amax / 127.0)), -127, 127).astype(_np.int8)
+    return (NDArray(q, ctx=w.context), -amax, amax)
+
+
+class _QuantizedLayer:
+    """Shared machinery: calibrated input range + requantize-out."""
+
+    def _setup_ranges(self, in_range, out_range, quantized_dtype):
+        self._in_range = in_range      # (min, max) or None → dynamic
+        self._out_range = out_range
+        self._qdtype = quantized_dtype
+
+    def _quantize_in(self, x):
+        from ..ndarray.ndarray import invoke
+        kw = {"out_type": self._qdtype}
+        if self._in_range is not None:
+            kw["min_calib_range"] = self._in_range[0]
+            kw["max_calib_range"] = self._in_range[1]
+        return invoke("_contrib_quantize_v2", x, **kw)
+
+    def _finish(self, acc, mn, mx):
+        from ..ndarray.ndarray import invoke
+        if self._out_range is not None:
+            q8, qmn, qmx = invoke(
+                "_contrib_requantize", acc, mn, mx,
+                min_calib_range=self._out_range[0],
+                max_calib_range=self._out_range[1])
+            return invoke("_contrib_dequantize", q8, qmn, qmx)
+        return invoke("_contrib_dequantize", acc, mn, mx)
+
+
+from ..gluon.block import Block as _Block    # noqa: E402
+
+
+class QuantizedDense(_Block, _QuantizedLayer):
+    """int8 replacement for gluon.nn.Dense (ref: quantized FC subgraph:
+    quantize → quantized_fully_connected → requantize → dequantize)."""
+
+    def __init__(self, dense, in_range=None, out_range=None,
+                 quantized_dtype="int8"):
+        super().__init__()
+        self._setup_ranges(in_range, out_range, quantized_dtype)
+        self._units = dense._units
+        self._flatten = dense._flatten
+        self.act = dense.act
+        self._qw, self._wmin, self._wmax = _quantize_weight(
+            dense.weight.data())
+        bias = getattr(dense, "bias", None)   # absent on use_bias=False
+        if bias is not None:
+            self._qb, self._bmin, self._bmax = _quantize_weight(
+                bias.data())
+        else:
+            self._qb = None
+
+    def forward(self, x):
+        from ..ndarray.ndarray import invoke
+        from ..ndarray import array
+        qx, mnd, mxd = self._quantize_in(x)
+        ctx = x.context
+        wmin = array([self._wmin], ctx=ctx)
+        wmax = array([self._wmax], ctx=ctx)
+        if self._qb is not None:
+            bmin = array([self._bmin], ctx=ctx)
+            bmax = array([self._bmax], ctx=ctx)
+            acc, mn, mx = invoke(
+                "_contrib_quantized_fully_connected", qx, self._qw,
+                self._qb, mnd, mxd, wmin, wmax, bmin, bmax,
+                num_hidden=self._units, flatten=self._flatten)
+        else:
+            acc, mn, mx = invoke(
+                "_contrib_quantized_fully_connected", qx, self._qw,
+                None, mnd, mxd, wmin, wmax, None, None,
+                num_hidden=self._units, no_bias=True,
+                flatten=self._flatten)
+        out = self._finish(acc, mn, mx)
+        if self.act is not None:
+            out = invoke("Activation", out, act_type=self.act)
+        return out
+
+
+class QuantizedConv2D(_Block, _QuantizedLayer):
+    """int8 replacement for gluon.nn.Conv2D."""
+
+    def __init__(self, conv, in_range=None, out_range=None,
+                 quantized_dtype="int8"):
+        super().__init__()
+        self._setup_ranges(in_range, out_range, quantized_dtype)
+        self._kwargs = dict(conv._kwargs)
+        self.act = conv.act
+        self._qw, self._wmin, self._wmax = _quantize_weight(
+            conv.weight.data())
+        bias = getattr(conv, "bias", None)
+        if bias is not None:
+            self._qb, self._bmin, self._bmax = _quantize_weight(
+                bias.data())
+        else:
+            self._qb = None
+
+    def forward(self, x):
+        from ..ndarray.ndarray import invoke
+        from ..ndarray import array
+        qx, mnd, mxd = self._quantize_in(x)
+        ctx = x.context
+        wmin = array([self._wmin], ctx=ctx)
+        wmax = array([self._wmax], ctx=ctx)
+        kw = {k: self._kwargs[k] for k in
+              ("kernel", "stride", "pad", "dilate", "num_filter",
+               "num_group") if k in self._kwargs}
+        if self._qb is not None:
+            bmin = array([self._bmin], ctx=ctx)
+            bmax = array([self._bmax], ctx=ctx)
+            acc, mn, mx = invoke(
+                "_contrib_quantized_conv", qx, self._qw, self._qb,
+                mnd, mxd, wmin, wmax, bmin, bmax, **kw)
+        else:
+            acc, mn, mx = invoke(
+                "_contrib_quantized_conv", qx, self._qw, None,
+                mnd, mxd, wmin, wmax, None, None, no_bias=True, **kw)
+        out = self._finish(acc, mn, mx)
+        if self.act is not None:
+            out = invoke("Activation", out, act_type=self.act)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# quantize_net (Gluon)
+# ---------------------------------------------------------------------------
+
+def _unhybridize(block):
+    """Drop any cached hybridize executables and fall back to imperative
+    execution: calibration hooks must see every child call, and a stale
+    _CachedGraph would keep executing the old fp32 children after the
+    swap.  (Call net.hybridize() again after quantization if desired —
+    the int8 wrappers trace like any other block.)"""
+    if hasattr(block, "_cached_graph"):
+        block._cached_graph = None
+    if getattr(block, "_active", False):
+        block._active = False
+    for child in block._children.values():
+        _unhybridize(child)
+
+
+def _iter_quantizable(block, prefix="", exclude=()):
+    from ..gluon import nn
+    for name, child in list(block._children.items()):
+        path = prefix + name
+        if isinstance(child, (nn.Dense, nn.Conv2D)) and \
+                path not in exclude and name not in exclude:
+            yield block, name, path, child
+        else:
+            yield from _iter_quantizable(child, path + ".", exclude)
+
+
+def quantize_net(net, quantized_dtype="int8", exclude_layers=None,
+                 calib_data=None, calib_mode="naive",
+                 num_calib_batches=None, logger=None):
+    """Quantize a Gluon net in place and return it (ref: quantize_net).
+
+    calib_mode: 'none' (dynamic ranges, slowest), 'naive' (min/max over
+    calib_data), 'entropy' (KL thresholds over calib_data)."""
+    log = logger or logging.getLogger(__name__)
+    if quantized_dtype != "int8":
+        raise MXNetError("quantize_net supports quantized_dtype='int8' "
+                         "(symmetric MXU path); got %r" % quantized_dtype)
+    _unhybridize(net)
+    exclude = tuple(exclude_layers or ())
+    sites = list(_iter_quantizable(net, exclude=exclude))
+    if not sites:
+        raise MXNetError("no quantizable layers found")
+
+    ranges = {}
+    if calib_mode != "none":
+        if calib_data is None:
+            raise MXNetError("calib_data required for calib_mode=%r"
+                             % calib_mode)
+        collector = (LayerOutputMinMaxCollector() if calib_mode == "naive"
+                     else LayerHistogramCollector())
+        hooks = []
+        for parent, name, path, child in sites:
+            def _pre(block, args, _p=path):
+                collector.collect(_p + ":in", args[0])
+            def _post(block, args, out, _p=path):
+                collector.collect(_p + ":out", out)
+            hooks.append(child.register_forward_pre_hook(_pre))
+            hooks.append(child.register_forward_hook(_post))
+        n = 0
+        for batch in calib_data:
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            net(x)
+            n += 1
+            if num_calib_batches is not None and n >= num_calib_batches:
+                break
+        for h in hooks:
+            h.detach()
+        for _parent, _name, path, _child in sites:
+            ranges[path] = (collector.range_of(path + ":in"),
+                            collector.range_of(path + ":out"))
+        log.info("calibrated %d layers over %d batches (%s)",
+                 len(sites), n, calib_mode)
+
+    from ..gluon import nn
+    for parent, name, path, child in sites:
+        in_r, out_r = ranges.get(path, (None, None))
+        if isinstance(child, nn.Dense):
+            wrapper = QuantizedDense(child, in_r, out_r, quantized_dtype)
+        else:
+            wrapper = QuantizedConv2D(child, in_r, out_r, quantized_dtype)
+        parent._children[name] = wrapper
+        # custom nets hold the child as an attribute too
+        for attr, val in list(vars(parent).items()):
+            if val is child:
+                object.__setattr__(parent, attr, wrapper)
+    return net
+
+
+# ---------------------------------------------------------------------------
+# quantize_model (legacy symbolic): JSON graph pass
+# ---------------------------------------------------------------------------
+
+_QUANTIZABLE = {"FullyConnected": "_contrib_quantized_fully_connected",
+                "Convolution": "_contrib_quantized_conv"}
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   excluded_sym_names=None, calib_mode="none",
+                   calib_data=None, num_calib_batches=None,
+                   quantized_dtype="int8", logger=None):
+    """Rewrite a Symbol into its int8 form + quantized params (ref:
+    quantize_model; the graph pass mirrors src/operator/quantization/
+    quantize_graph_pass.cc).
+
+    Returns (qsym, qarg_params, aux_params).  Each quantizable node is
+    replaced by quantize_v2(data) → quantized_op → dequantize; weights
+    are quantized offline into qarg_params."""
+    if quantized_dtype != "int8":
+        raise MXNetError("quantize_model supports quantized_dtype='int8' "
+                         "(symmetric MXU path); got %r" % quantized_dtype)
+    from .. import symbol as S
+    excluded = set(excluded_sym_names or ())
+
+    graph = json.loads(sym.tojson())
+    nodes = graph["nodes"]
+    # name → (new_symbol, is_quantizable_output) build-up, topo order
+    built = {}
+    qarg = dict(arg_params)
+
+    # calibration: run the fp32 graph, collect output ranges per node
+    ranges = {}
+    if calib_mode != "none":
+        if calib_data is None:
+            raise MXNetError("calib_data required")
+        collector = (LayerOutputMinMaxCollector() if calib_mode == "naive"
+                     else LayerHistogramCollector())
+        _calibrate_symbolic(sym, arg_params, aux_params, data_names,
+                            calib_data, num_calib_batches, collector,
+                            nodes, excluded)
+        for node in nodes:
+            if node["op"] in _QUANTIZABLE and node["name"] not in excluded:
+                try:
+                    ranges[node["name"]] = collector.range_of(
+                        node["name"] + ":out")
+                except KeyError:
+                    pass
+
+    def _in_sym(entry):
+        nid, out_idx = entry[0], entry[1]
+        s = built[nodes[nid]["name"]]
+        if out_idx and len(s.list_outputs()) > 1:
+            return s[out_idx]
+        return s
+
+    for node in nodes:
+        name, op = node["name"], node["op"]
+        attrs = {k: _parse_attr(v) for k, v in
+                 node.get("attrs", {}).items()}
+        if op == "null":
+            built[name] = S.var(name)
+            continue
+        ins = [_in_sym(e) for e in node["inputs"]]
+        if op in _QUANTIZABLE and name not in excluded \
+                and name in qarg_names_ok(node, nodes, arg_params):
+            built[name] = _emit_quantized(S, node, ins, nodes, qarg,
+                                          ranges.get(name),
+                                          quantized_dtype)
+        else:
+            built[name] = getattr(S, op)(*ins, name=name, **attrs)
+
+    heads = [built[nodes[h[0]]["name"]] if not h[1] or
+             len(built[nodes[h[0]]["name"]].list_outputs()) <= 1
+             else built[nodes[h[0]]["name"]][h[1]]
+             for h in graph["heads"]]
+    qsym = heads[0] if len(heads) == 1 else S.Group(heads)
+    return qsym, qarg, dict(aux_params)
+
+
+def qarg_names_ok(node, nodes, arg_params):
+    """Quantizable only when its weight is a known parameter."""
+    ins = node["inputs"]
+    if len(ins) < 2:
+        return set()
+    wname = nodes[ins[1][0]]["name"]
+    return {node["name"]} if wname in arg_params else set()
+
+
+def _parse_attr(v):
+    if not isinstance(v, str):
+        return v
+    import ast
+    try:
+        return ast.literal_eval(v)   # tuples/ints/bools, no code exec
+    except (ValueError, SyntaxError):
+        return v
+
+
+def _emit_quantized(S, node, ins, nodes, qarg, out_range, qdtype):
+    """quantize_v2 → quantized op → (requantize) → dequantize subgraph."""
+    name, op = node["name"], node["op"]
+    attrs = {k: _parse_attr(v) for k, v in node.get("attrs", {}).items()}
+    wname = nodes[node["inputs"][1][0]]["name"]
+    bname = None
+    no_bias = _truthy(attrs.get("no_bias"))
+    if len(node["inputs"]) > 2 and not no_bias:
+        bname = nodes[node["inputs"][2][0]]["name"]
+
+    # offline weight quantization
+    from ..ndarray.ndarray import NDArray
+    w = qarg[wname]
+    qw, wmin, wmax = _quantize_weight(w)
+    qarg[wname + "_quantize"] = qw
+    qarg[wname + "_min"] = NDArray(_np.array([wmin], _np.float32))
+    qarg[wname + "_max"] = NDArray(_np.array([wmax], _np.float32))
+    if bname is None:
+        # the symbol graph has no optional-input slots (None operands
+        # would shift positions at eval) — synthesize a zero int8 bias
+        nb = (w.shape[0],)
+        bname = name + "_zero_bias"
+        qarg[bname + "_quantize"] = NDArray(_np.zeros(nb, _np.int8))
+        qarg[bname + "_min"] = NDArray(_np.array([-1.0], _np.float32))
+        qarg[bname + "_max"] = NDArray(_np.array([1.0], _np.float32))
+    else:
+        qb, bmin, bmax = _quantize_weight(qarg[bname])
+        qarg[bname + "_quantize"] = qb
+        qarg[bname + "_min"] = NDArray(_np.array([bmin], _np.float32))
+        qarg[bname + "_max"] = NDArray(_np.array([bmax], _np.float32))
+
+    qdata = S._apply("_contrib_quantize_v2", [ins[0]],
+                     {"out_type": qdtype}, name=name + "_quantize")
+    qd, qd_min, qd_max = qdata[0], qdata[1], qdata[2]
+    wsym = S.var(wname + "_quantize")
+    wmin_s = S.var(wname + "_min")
+    wmax_s = S.var(wname + "_max")
+    qop = _QUANTIZABLE[op]
+    attrs.pop("no_bias", None)
+    args = [qd, wsym, S.var(bname + "_quantize"), qd_min, qd_max,
+            wmin_s, wmax_s, S.var(bname + "_min"),
+            S.var(bname + "_max")]
+    acc = S._apply(qop, args, attrs, name=name + "_quantized")
+    a, amn, amx = acc[0], acc[1], acc[2]
+    if out_range is not None:
+        rq = S._apply("_contrib_requantize", [a, amn, amx],
+                      {"min_calib_range": out_range[0],
+                       "max_calib_range": out_range[1]},
+                      name=name + "_requantize")
+        a, amn, amx = rq[0], rq[1], rq[2]
+    return S._apply("_contrib_dequantize", [a, amn, amx], {},
+                    name=name + "_dequantize")
+
+
+def _truthy(v):
+    return v in (True, "True", "true", "1", 1)
+
+
+def _calibrate_symbolic(sym, arg_params, aux_params, data_names,
+                        calib_data, num_calib_batches, collector,
+                        nodes, excluded):
+    """Run the fp32 graph over calibration batches, collecting the
+    outputs of quantizable nodes via per-node head symbols."""
+    from .. import symbol as S
+    internals = sym.get_internals()
+    outs = []
+    names = []
+    for node in nodes:
+        if node["op"] in _QUANTIZABLE and node["name"] not in excluded:
+            try:
+                outs.append(internals[node["name"] + "_output"])
+                names.append(node["name"])
+            except Exception:
+                pass
+    if not outs:
+        return
+    group = S.Group(outs)
+    n = 0
+    for batch in calib_data:
+        x = batch[0] if isinstance(batch, (tuple, list)) else batch
+        feed = dict(arg_params)
+        feed.update(aux_params)
+        feed[data_names[0]] = x
+        res = group.eval(**feed)
+        for nm, r in zip(names, res):
+            collector.collect(nm + ":out", r)
+        n += 1
+        if num_calib_batches is not None and n >= num_calib_batches:
+            break

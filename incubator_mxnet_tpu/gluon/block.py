@@ -201,9 +201,11 @@ class Block:
 
     def register_forward_hook(self, hook):
         self._forward_hooks.append(hook)
+        return _HookHandle(self._forward_hooks, hook)
 
     def register_forward_pre_hook(self, hook):
         self._forward_pre_hooks.append(hook)
+        return _HookHandle(self._forward_pre_hooks, hook)
 
     def apply(self, fn):
         for child in self._children.values():
@@ -288,6 +290,20 @@ class Block:
 
 def _indent(s):
     return s.replace("\n", "\n  ")
+
+
+class _HookHandle:
+    """Removable hook registration (ref: mxnet.gluon.utils.HookHandle)."""
+
+    def __init__(self, hooks_list, hook):
+        self._list = hooks_list
+        self._hook = hook
+
+    def detach(self):
+        if self._hook in self._list:
+            self._list.remove(self._hook)
+
+    remove = detach
 
 
 def _np_mode_out(out):

@@ -427,8 +427,6 @@ class ndarray(NDArray):
         return tuple(array(x, ctx=self._ctx, dtype="int64") for x in d)
 
     def take(self, indices, axis=None, mode="clip"):
-        if isinstance(indices, NDArray):
-            indices = indices
         return _apply(jnp.take, (self, indices),
                       {"axis": axis, "mode": mode}, name="np_take")
 

@@ -1,0 +1,260 @@
+"""INT8 quantization operators.
+
+TPU-native re-design of ref: src/operator/quantization/{quantize.cc,
+quantize_v2.cc, dequantize.cc, requantize.cc, quantized_conv.cc,
+quantized_fully_connected.cc, quantized_pooling.cc, quantized_flatten.cc,
+quantized_elemwise_add.cc}.
+
+Range convention (identical to the reference): a quantized tensor is the
+triple (q, min_range, max_range); the real value is
+``q * MaxAbs(min_range, max_range) / Q`` with Q = 127 for int8,
+2^31-1 for int32 (symmetric signed), and an affine mapping for uint8.
+
+TPU mapping: int8×int8 `lax.dot_general`/`conv_general_dilated` with
+``preferred_element_type=int32`` lowers onto the MXU's native 8-bit
+multiply / 32-bit accumulate path — the cuDNN-int8 analogue, but picked
+by the compiler instead of a runtime autotuner.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+INT8_Q = 127.0
+INT32_Q = float(2 ** 31 - 1)
+
+
+def _max_abs(mn, mx):
+    return jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+
+
+def _scale_of(mn, mx, out_type):
+    if out_type == "uint8":
+        return (mx - mn) / 255.0
+    q = INT8_Q if out_type == "int8" else INT32_Q
+    return _max_abs(mn, mx) / q
+
+
+@register("_contrib_quantize", ndarray_inputs=("data", "min_range",
+                                               "max_range"),
+          differentiable=False, num_outputs=3)
+def quantize(data, min_range, max_range, out_type="uint8"):
+    """ref: quantize.cc — float → int8/uint8 given a range."""
+    mn = jnp.min(min_range)
+    mx = jnp.max(max_range)
+    if out_type == "uint8":
+        scale = (mx - mn) / 255.0
+        q = jnp.clip(jnp.round((data - mn) / scale), 0, 255).astype(
+            jnp.uint8)
+        return q, mn, mx
+    amax = _max_abs(mn, mx)
+    scale = amax / INT8_Q
+    q = jnp.clip(jnp.round(data / scale), -INT8_Q, INT8_Q).astype(jnp.int8)
+    return q, -amax, amax
+
+
+@register("_contrib_quantize_v2", ndarray_inputs=("data",),
+          differentiable=False, num_outputs=3)
+def quantize_v2(data, out_type="int8", min_calib_range=None,
+                max_calib_range=None):
+    """ref: quantize_v2.cc — range from calibration attrs, or from the
+    data itself when uncalibrated."""
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(data)
+        mx = jnp.max(data)
+    else:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    return quantize(data, mn, mx, out_type=out_type)
+
+
+@register("_contrib_dequantize", ndarray_inputs=("data", "min_range",
+                                                 "max_range"),
+          differentiable=False)
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """ref: dequantize.cc — int8/int32/uint8 → float."""
+    mn = jnp.min(min_range)
+    mx = jnp.max(max_range)
+    if data.dtype == jnp.uint8:
+        scale = (mx - mn) / 255.0
+        return data.astype(jnp.float32) * scale + mn
+    q = INT8_Q if data.dtype == jnp.int8 else INT32_Q
+    scale = _max_abs(mn, mx) / q
+    return data.astype(jnp.float32) * scale
+
+
+@register("_contrib_requantize", ndarray_inputs=("data", "min_range",
+                                                 "max_range"),
+          differentiable=False, num_outputs=3)
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None, out_type="int8"):
+    """ref: requantize.cc — int32 accumulator → int8 with a (calibrated)
+    narrower range."""
+    real = dequantize(data, min_range, max_range)
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(real)
+        mx = jnp.max(real)
+    else:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    return quantize(real, mn, mx, out_type=out_type)
+
+
+def _int32_out_range(min_d, max_d, min_w, max_w):
+    """Output range of an int8×int8→int32 accumulation (ref:
+    quantization_utils.h QuantizationRangeForMultiplication)."""
+    s = (_max_abs(jnp.min(min_d), jnp.max(max_d)) / INT8_Q) * \
+        (_max_abs(jnp.min(min_w), jnp.max(max_w)) / INT8_Q)
+    mx = s * INT32_Q
+    return -mx, mx
+
+
+@register("_contrib_quantized_fully_connected",
+          ndarray_inputs=("data", "weight", "bias", "min_data", "max_data",
+                          "min_weight", "max_weight", "min_bias",
+                          "max_bias"),
+          differentiable=False, num_outputs=3)
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias, max_bias,
+                              num_hidden=None, no_bias=False,
+                              flatten=True):
+    """ref: quantized_fully_connected.cc — int8 GEMM, int32 accum.
+
+    Output is the raw int32 accumulator plus its range; follow with
+    `_contrib_requantize` (calibrated) or `_contrib_dequantize`."""
+    if data.dtype == jnp.uint8 or weight.dtype == jnp.uint8:
+        # affine uint8 codes cannot be fed to the symmetric int8 MXU
+        # path (values ≥128 would wrap negative and the range math is
+        # maxabs/127-based); quantize with out_type='int8'
+        raise ValueError("quantized_fully_connected requires symmetric "
+                         "int8 inputs, got uint8")
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    acc = lax.dot_general(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        (((data.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    mn_o, mx_o = _int32_out_range(min_data, max_data, min_weight,
+                                  max_weight)
+    if bias is not None and not no_bias:
+        # bias arrives int8 with its own scale; rescale into the
+        # accumulator's scale (s_d * s_w) before adding
+        s_b = _max_abs(jnp.min(min_bias), jnp.max(max_bias)) / INT8_Q
+        s_acc = mx_o / INT32_Q
+        b32 = jnp.round(bias.astype(jnp.float32) * (s_b / s_acc)).astype(
+            jnp.int32)
+        acc = acc + b32
+    return acc, mn_o, mx_o
+
+
+@register("_contrib_quantized_conv",
+          ndarray_inputs=("data", "weight", "bias", "min_data", "max_data",
+                          "min_weight", "max_weight", "min_bias",
+                          "max_bias"),
+          differentiable=False, num_outputs=3)
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, min_bias, max_bias, kernel=None,
+                   stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                   num_filter=0, num_group=1, no_bias=False,
+                   layout="NCHW"):
+    """ref: quantized_conv.cc — int8 convolution, int32 accumulate on
+    the MXU."""
+    if data.dtype == jnp.uint8 or weight.dtype == jnp.uint8:
+        raise ValueError("quantized_conv requires symmetric int8 inputs, "
+                         "got uint8")
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(pad, int):
+        pad = (pad, pad)
+    if isinstance(dilate, int):
+        dilate = (dilate, dilate)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=tuple(stride),
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=tuple(dilate),
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    mn_o, mx_o = _int32_out_range(min_data, max_data, min_weight,
+                                  max_weight)
+    if bias is not None and not no_bias:
+        s_b = _max_abs(jnp.min(min_bias), jnp.max(max_bias)) / INT8_Q
+        s_acc = mx_o / INT32_Q
+        b32 = jnp.round(bias.astype(jnp.float32) * (s_b / s_acc)).astype(
+            jnp.int32)
+        acc = acc + b32[None, :, None, None]
+    return acc, mn_o, mx_o
+
+
+@register("_contrib_quantized_pooling",
+          ndarray_inputs=("data", "min_data", "max_data"),
+          differentiable=False, num_outputs=3)
+def quantized_pooling(data, min_data, max_data, kernel=(2, 2),
+                      pool_type="max", stride=None, pad=(0, 0),
+                      global_pool=False, **_):
+    """ref: quantized_pooling.cc — max/avg pool directly on int8 (range
+    is unchanged for max; avg dequantizes-free since it's linear)."""
+    if stride is None:
+        stride = kernel
+    if isinstance(kernel, int):
+        kernel = (kernel, kernel)
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(pad, int):
+        pad = (pad, pad)
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1, 1)
+        pad = (0, 0)
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    pads = ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1]))
+    if pool_type == "max":
+        init = jnp.array(jnp.iinfo(data.dtype).min, data.dtype)
+        out = lax.reduce_window(data, init, lax.max,
+                                window, strides, pads)
+    else:
+        s = lax.reduce_window(data.astype(jnp.int32), 0, lax.add,
+                              window, strides, pads)
+        n = kernel[0] * kernel[1]
+        out = jnp.round(s.astype(jnp.float32) / n).astype(jnp.int8)
+    return out, jnp.min(min_data), jnp.max(max_data)
+
+
+@register("_contrib_quantized_flatten",
+          ndarray_inputs=("data", "min_data", "max_data"),
+          differentiable=False, num_outputs=3)
+def quantized_flatten(data, min_data, max_data):
+    return (data.reshape(data.shape[0], -1), jnp.min(min_data),
+            jnp.max(max_data))
+
+
+@register("_contrib_quantized_act",
+          ndarray_inputs=("data", "min_data", "max_data"),
+          differentiable=False, num_outputs=3)
+def quantized_act(data, min_data, max_data, act_type="relu"):
+    """ref: quantized_activation.cc — relu on int8 keeps the scale."""
+    if act_type != "relu":
+        raise ValueError("quantized_act supports relu only")
+    return (jnp.maximum(data, 0), jnp.min(min_data), jnp.max(max_data))
+
+
+@register("_contrib_quantized_elemwise_add",
+          ndarray_inputs=("lhs", "rhs", "min_lhs", "max_lhs", "min_rhs",
+                          "max_rhs"),
+          differentiable=False, num_outputs=3)
+def quantized_elemwise_add(lhs, rhs, min_lhs, max_lhs, min_rhs, max_rhs):
+    """ref: quantized_elemwise_add.cc — align scales into int32."""
+    s_l = _max_abs(jnp.min(min_lhs), jnp.max(max_lhs)) / INT8_Q
+    s_r = _max_abs(jnp.min(min_rhs), jnp.max(max_rhs)) / INT8_Q
+    s_o = jnp.maximum(s_l, s_r) / (INT32_Q / (2 * INT8_Q))
+    acc = (jnp.round(lhs.astype(jnp.float32) * (s_l / s_o)) +
+           jnp.round(rhs.astype(jnp.float32) * (s_r / s_o))).astype(
+               jnp.int32)
+    mx = s_o * INT32_Q
+    return acc, -mx, mx

@@ -34,6 +34,12 @@ def use_np(func):
                 setattr(func, name, classmethod(use_np(m.__func__)))
             elif callable(m):
                 setattr(func, name, use_np(m))
+        # a Gluon block's user code runs inside the inherited
+        # Block.__call__ (including the np-output conversion) — wrap it
+        # on the subclass so the np flag is live for the whole call
+        call = getattr(func, "__call__", None)
+        if call is not None and "__call__" not in vars(func):
+            setattr(func, "__call__", use_np(call))
         return func
 
     @functools.wraps(func)

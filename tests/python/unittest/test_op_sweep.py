@@ -746,6 +746,16 @@ case("multi_sgd_mom_update", [_W, _G, _S1, _W * 2, _G * 2, _S1 * 2],
 # ---------------------------------------------------------------------------
 
 TESTED_ELSEWHERE = {
+    "_contrib_quantize": "test_quantization.py",
+    "_contrib_quantize_v2": "test_quantization.py",
+    "_contrib_dequantize": "test_quantization.py",
+    "_contrib_requantize": "test_quantization.py",
+    "_contrib_quantized_conv": "test_quantization.py",
+    "_contrib_quantized_fully_connected": "test_quantization.py",
+    "_contrib_quantized_pooling": "test_quantization.py",
+    "_contrib_quantized_flatten": "test_quantization.py",
+    "_contrib_quantized_act": "test_quantization.py",
+    "_contrib_quantized_elemwise_add": "test_quantization.py",
     "Convolution": "test_operator.py",
     "Pooling": "test_operator.py",
     "BatchNorm": "test_operator.py",

@@ -1,0 +1,247 @@
+"""Quantization tests (ref: tests/python/quantization/test_quantization.py
+— quantize/dequantize roundtrip, quantized conv/FC vs fp32, calibration)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.contrib import quantization as qz
+
+
+def test_quantize_dequantize_roundtrip_int8():
+    rs = onp.random.RandomState(0)
+    x = rs.randn(4, 16).astype(onp.float32)
+    data = nd.array(x)
+    q, mn, mx_ = nd.invoke("_contrib_quantize_v2", data, out_type="int8")
+    assert q.dtype == onp.int8
+    back = nd.invoke("_contrib_dequantize", q, mn, mx_)
+    # worst-case quantization error: max_abs/127 per element
+    tol = onp.abs(x).max() / 127.0 + 1e-6
+    assert onp.abs(back.asnumpy() - x).max() <= tol
+
+
+def test_quantize_uint8_affine():
+    x = onp.linspace(0.0, 10.0, 100, dtype=onp.float32)
+    q, mn, mx_ = nd.invoke("_contrib_quantize_v2", nd.array(x),
+                           out_type="uint8")
+    assert q.dtype == onp.uint8
+    back = nd.invoke("_contrib_dequantize", q, mn, mx_)
+    assert onp.abs(back.asnumpy() - x).max() <= 10.0 / 255.0 + 1e-6
+
+
+def test_quantize_with_calibrated_range_clips():
+    x = onp.array([-5.0, -1.0, 0.5, 1.0, 50.0], onp.float32)
+    q, mn, mx_ = nd.invoke("_contrib_quantize_v2", nd.array(x),
+                           out_type="int8",
+                           min_calib_range=-2.0, max_calib_range=2.0)
+    back = nd.invoke("_contrib_dequantize", q, mn, mx_).asnumpy()
+    assert back[-1] == pytest.approx(2.0, abs=0.05)    # clipped
+    assert back[2] == pytest.approx(0.5, abs=0.05)
+
+
+def test_requantize_matches_direct():
+    rs = onp.random.RandomState(1)
+    x = rs.randn(32).astype(onp.float32)
+    q, mn, mx_ = nd.invoke("_contrib_quantize_v2", nd.array(x),
+                           out_type="int8")
+    # fake int32 accumulator: upscale by 1000; its real-value range is
+    # amax such that acc * amax / (2^31-1) == x, i.e.
+    # amax = max_abs * (2^31-1) / (127 * 1000)
+    acc = nd.array(q.asnumpy().astype(onp.int32) * 1000, dtype="int32")
+    amax = float(onp.abs(x).max()) * (2 ** 31 - 1) / (127.0 * 1000.0)
+    q8, qmn, qmx = nd.invoke("_contrib_requantize", acc,
+                             nd.array([-amax]), nd.array([amax]),
+                             min_calib_range=float(x.min()),
+                             max_calib_range=float(x.max()))
+    back = nd.invoke("_contrib_dequantize", q8, qmn, qmx).asnumpy()
+    assert onp.abs(back - x).max() <= onp.abs(x).max() / 127 * 2.5
+
+
+def test_quantized_fully_connected_vs_fp32():
+    rs = onp.random.RandomState(2)
+    x = rs.randn(8, 32).astype(onp.float32)
+    w = rs.randn(16, 32).astype(onp.float32) * 0.5
+    b = rs.randn(16).astype(onp.float32)
+    want = x @ w.T + b
+
+    qx, xmn, xmx = nd.invoke("_contrib_quantize_v2", nd.array(x),
+                             out_type="int8")
+    qw, wmn, wmx = nd.invoke("_contrib_quantize_v2", nd.array(w),
+                             out_type="int8")
+    qb, bmn, bmx = nd.invoke("_contrib_quantize_v2", nd.array(b),
+                             out_type="int8")
+    acc, omn, omx = nd.invoke(
+        "_contrib_quantized_fully_connected", qx, qw, qb, xmn, xmx,
+        wmn, wmx, bmn, bmx, num_hidden=16)
+    assert acc.dtype == onp.int32
+    got = nd.invoke("_contrib_dequantize", acc, omn, omx).asnumpy()
+    # int8 quant error ~1% relative on well-scaled data
+    assert onp.abs(got - want).max() / onp.abs(want).max() < 0.05
+
+
+def test_quantized_conv_vs_fp32():
+    rs = onp.random.RandomState(3)
+    x = rs.randn(2, 3, 8, 8).astype(onp.float32)
+    w = rs.randn(4, 3, 3, 3).astype(onp.float32)
+    want = nd.invoke("Convolution", nd.array(x), nd.array(w), None,
+                     kernel=(3, 3), num_filter=4, no_bias=True,
+                     stride=(1, 1), pad=(1, 1)).asnumpy()
+
+    qx, xmn, xmx = nd.invoke("_contrib_quantize_v2", nd.array(x),
+                             out_type="int8")
+    qw, wmn, wmx = nd.invoke("_contrib_quantize_v2", nd.array(w),
+                             out_type="int8")
+    acc, omn, omx = nd.invoke(
+        "_contrib_quantized_conv", qx, qw, None, xmn, xmx, wmn, wmx,
+        None, None, kernel=(3, 3), num_filter=4, no_bias=True,
+        stride=(1, 1), pad=(1, 1))
+    got = nd.invoke("_contrib_dequantize", acc, omn, omx).asnumpy()
+    assert onp.abs(got - want).max() / onp.abs(want).max() < 0.05
+
+
+def test_quantized_pooling_max():
+    x = onp.arange(16, dtype=onp.int8).reshape(1, 1, 4, 4)
+    out, mn, mx_ = nd.invoke("_contrib_quantized_pooling",
+                             nd.array(x, dtype="int8"),
+                             nd.array([0.0]), nd.array([1.0]),
+                             kernel=(2, 2), pool_type="max",
+                             stride=(2, 2))
+    assert out.asnumpy().reshape(2, 2).tolist() == [[5, 7], [13, 15]]
+
+
+def test_kl_threshold_reasonable():
+    rs = onp.random.RandomState(4)
+    # gaussian bulk + tiny outlier: KL threshold should ignore outlier
+    a = onp.concatenate([rs.randn(100000).astype(onp.float32),
+                         onp.array([100.0], onp.float32)])
+    hist, edges = onp.histogram(onp.abs(a), bins=8001, range=(-100, 100))
+    th = qz._get_optimal_threshold((hist, edges))
+    assert th < 20.0    # far below the 100.0 outlier
+
+
+def test_minmax_collector():
+    c = qz.LayerOutputMinMaxCollector()
+    c.collect("a", nd.array([1.0, -2.0]))
+    c.collect("a", nd.array([5.0, 0.0]))
+    assert c.range_of("a") == (-2.0, 5.0)
+
+
+def test_histogram_collector_widens():
+    c = qz.LayerHistogramCollector(num_bins=101)
+    c.collect("a", nd.array([1.0, -1.0]))
+    c.collect("a", nd.array([3.0]))
+    hist, edges, th = c.hist["a"]
+    assert th == 3.0
+    assert hist.sum() == 3
+
+
+def _make_mlp():
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(32, activation="relu", in_units=16),
+            mx.gluon.nn.Dense(8, in_units=32))
+    net.initialize()
+    return net
+
+
+@pytest.mark.parametrize("calib_mode", ["none", "naive", "entropy"])
+def test_quantize_net_mlp(calib_mode):
+    rs = onp.random.RandomState(5)
+    net = _make_mlp()
+    xs = [nd.array(rs.randn(8, 16).astype(onp.float32)) for _ in range(4)]
+    want = net(xs[0]).asnumpy()
+    qnet = qz.quantize_net(net, calib_data=xs if calib_mode != "none"
+                           else None, calib_mode=calib_mode,
+                           num_calib_batches=4)
+    got = qnet(xs[0]).asnumpy()
+    rel = onp.abs(got - want).max() / (onp.abs(want).max() + 1e-8)
+    assert rel < 0.1, "calib_mode=%s rel err %.4f" % (calib_mode, rel)
+
+
+def test_quantize_net_conv_and_exclude():
+    rs = onp.random.RandomState(6)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Conv2D(8, 3, padding=1, in_channels=3,
+                               activation="relu"),
+            mx.gluon.nn.Conv2D(4, 3, padding=1, in_channels=8))
+    net.initialize()
+    x = nd.array(rs.randn(2, 3, 8, 8).astype(onp.float32))
+    want = net(x).asnumpy()
+    qnet = qz.quantize_net(net, calib_data=[x], calib_mode="naive",
+                           exclude_layers=["1"])
+    # layer 0 quantized, layer 1 untouched
+    assert isinstance(qnet._children["0"], qz.QuantizedConv2D)
+    assert isinstance(qnet._children["1"], mx.gluon.nn.Conv2D)
+    got = qnet(x).asnumpy()
+    rel = onp.abs(got - want).max() / (onp.abs(want).max() + 1e-8)
+    assert rel < 0.1
+
+
+def test_quantize_model_symbolic():
+    rs = onp.random.RandomState(7)
+    data = mx.sym.var("data")
+    w1 = mx.sym.var("fc1_weight")
+    b1 = mx.sym.var("fc1_bias")
+    h = mx.sym.FullyConnected(data, w1, b1, num_hidden=32, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    w2 = mx.sym.var("fc2_weight")
+    out = mx.sym.FullyConnected(h, w2, num_hidden=8, no_bias=True,
+                                name="fc2")
+
+    arg = {"fc1_weight": nd.array(rs.randn(32, 16) * 0.3),
+           "fc1_bias": nd.array(rs.randn(32) * 0.1),
+           "fc2_weight": nd.array(rs.randn(8, 32) * 0.3)}
+    x = nd.array(rs.randn(4, 16).astype(onp.float32))
+    want = out.eval(data=x, **arg)[0].asnumpy()
+
+    qsym, qarg, qaux = qz.quantize_model(
+        out, arg, {}, calib_mode="naive", calib_data=[x],
+        num_calib_batches=1)
+    feed = {k: v for k, v in qarg.items()}
+    feed["data"] = x
+    got = qsym.eval(**feed)[0].asnumpy()
+    rel = onp.abs(got - want).max() / (onp.abs(want).max() + 1e-8)
+    assert rel < 0.1, rel
+    # quantized ops actually present in the rewritten graph
+    j = qsym.tojson()
+    assert "_contrib_quantized_fully_connected" in j
+    assert "_contrib_quantize_v2" in j
+
+
+def test_quantize_v1_with_explicit_range():
+    # _contrib_quantize: range supplied as tensors
+    x = onp.array([-1.0, 0.0, 2.0], onp.float32)
+    q, mn, mx_ = nd.invoke("_contrib_quantize", nd.array(x),
+                           nd.array([-2.0]), nd.array([2.0]),
+                           out_type="int8")
+    back = nd.invoke("_contrib_dequantize", q, mn, mx_).asnumpy()
+    assert onp.abs(back - x).max() <= 2.0 / 127 + 1e-6
+
+
+def test_quantized_act_relu():
+    q = nd.array(onp.array([-5, 0, 7], onp.int8), dtype="int8")
+    out, mn, mx_ = nd.invoke("_contrib_quantized_act", q,
+                             nd.array([-1.0]), nd.array([1.0]))
+    assert out.asnumpy().tolist() == [0, 0, 7]
+
+
+def test_quantized_flatten():
+    q = nd.array(onp.arange(8, dtype=onp.int8).reshape(2, 2, 2),
+                 dtype="int8")
+    out, mn, mx_ = nd.invoke("_contrib_quantized_flatten", q,
+                             nd.array([-1.0]), nd.array([1.0]))
+    assert out.shape == (2, 4)
+
+
+def test_quantized_elemwise_add_vs_fp32():
+    rs = onp.random.RandomState(8)
+    a = rs.randn(16).astype(onp.float32)
+    b = rs.randn(16).astype(onp.float32) * 3
+    qa, amn, amx = nd.invoke("_contrib_quantize_v2", nd.array(a),
+                             out_type="int8")
+    qb, bmn, bmx = nd.invoke("_contrib_quantize_v2", nd.array(b),
+                             out_type="int8")
+    acc, mn, mx_ = nd.invoke("_contrib_quantized_elemwise_add",
+                             qa, qb, amn, amx, bmn, bmx)
+    got = nd.invoke("_contrib_dequantize", acc, mn, mx_).asnumpy()
+    want = a + b
+    assert onp.abs(got - want).max() / onp.abs(want).max() < 0.05
