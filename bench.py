@@ -151,6 +151,46 @@ def run_sharded(batch=256, warmup=3, iters=20):
     return batch * iters / (time.perf_counter() - t0)
 
 
+def run_io(batch=128, n_images=1024):
+    """Input-pipeline throughput: native C++ RecordIO+JPEG pipeline
+    (src/io/recordio_pipeline.cc), images/sec/host-core — SURVEY §2.4
+    "must sustain v5e input rates".  Scales ~linearly with host cores;
+    this VM exposes os.cpu_count() of them."""
+    import os
+    import tempfile
+    from incubator_mxnet_tpu.io import recordio, native
+    if not native.available():
+        raise RuntimeError("native io unavailable")
+    rs = np.random.RandomState(0)
+    path = os.path.join(tempfile.gettempdir(),
+                        "bench_io_%d.rec" % n_images)
+    if not os.path.exists(path):
+        # write-then-rename: a killed prior run must not leave a
+        # truncated file that silently skews the benchmark
+        tmp = path + ".tmp"
+        rec = recordio.MXRecordIO(tmp, "w")
+        for i in range(n_images):
+            img = rs.randint(0, 255, (256, 313, 3), dtype=np.uint8)
+            rec.write(recordio.pack_img(
+                recordio.IRHeader(0, float(i % 1000), i, 0), img,
+                quality=90))
+        rec.close()
+        os.replace(tmp, path)
+    r = native.NativeImageRecordReader(
+        path, batch_size=batch, data_shape=(3, 224, 224), resize=256,
+        rand_crop=True, rand_mirror=True, shuffle=True)
+    for _ in r:     # warm epoch
+        pass
+    r.reset()
+    t0 = time.perf_counter()
+    n = 0
+    for epoch in range(2):
+        for data, _label in r:
+            n += data.shape[0]
+        r.reset()
+    return n / (time.perf_counter() - t0)
+
+
 def _try_batches(fn, batches):
     err = None
     for b in batches:
@@ -183,6 +223,13 @@ def main():
                       "bert_batch": bbatch, "bert_seq": 512})
     except Exception as e:
         extra["bert_error"] = str(e)[:120]
+    try:
+        import os
+        io_rate = run_io()
+        extra.update({"io_pipeline_images_per_sec": round(io_rate, 1),
+                      "io_host_cores": os.cpu_count()})
+    except Exception as e:
+        extra["io_error"] = str(e)[:120]
     print(json.dumps({
         "metric": "resnet50_v1b_train_images_per_sec_per_chip",
         "value": round(imgs, 2),

@@ -45,16 +45,23 @@ def _worker_init(dataset):
     _worker_dataset = dataset
 
 
+def _fetch_batch(dataset, samples):
+    batch = [dataset[i] for i in samples]
+    if isinstance(batch[0], tuple):
+        return tuple(_np.stack([_asnumpy(b[i]) for b in batch])
+                     for i in range(len(batch[0])))
+    return _np.stack([_asnumpy(b) for b in batch])
+
+
 def _worker_fn(samples):
     """Runs in worker process: fetch + batchify to numpy (picklable)."""
-    global _worker_dataset
-    batch = [_worker_dataset[i] for i in samples]
-    if isinstance(batch[0], tuple):
-        out = tuple(_np.stack([_asnumpy(b[i]) for b in batch])
-                    for i in range(len(batch[0])))
-    else:
-        out = _np.stack([_asnumpy(b) for b in batch])
-    return out
+    return _fetch_batch(_worker_dataset, samples)
+
+
+def _thread_worker_fn(dataset, samples):
+    """Thread-pool variant: dataset passed per call — a process-wide
+    global would be clobbered by a second thread-pool DataLoader."""
+    return _fetch_batch(dataset, samples)
 
 
 def _np_to_nd(out):
@@ -94,11 +101,33 @@ class DataLoader:
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._prefetch = max(0, prefetch or 2 * self._num_workers)
         self._pool = None
+        self._thread_pool = thread_pool
         if self._num_workers > 0:
-            ctx = _mp.get_context("fork")
-            self._pool = ctx.Pool(self._num_workers,
-                                  initializer=_worker_init,
-                                  initargs=(self._dataset,))
+            if not thread_pool:
+                # spawn, not fork: forking a process that holds live JAX
+                # runtime threads deadlocks the child (the reference used
+                # fork + cpu_shared IPC; PJRT rules that out).  Spawn
+                # must pickle the dataset — fall back to threads when it
+                # can't (e.g. transform_first(lambda ...)).
+                import pickle
+                try:
+                    pickle.dumps(self._dataset)
+                except Exception:
+                    import warnings
+                    warnings.warn(
+                        "DataLoader: dataset is not picklable (lambda "
+                        "transform?) — using thread workers instead of "
+                        "spawned processes (pass thread_pool=True to "
+                        "silence)")
+                    self._thread_pool = thread_pool = True
+            if thread_pool:
+                from multiprocessing.dummy import Pool as _ThreadPool
+                self._pool = _ThreadPool(self._num_workers)
+            else:
+                ctx = _mp.get_context("spawn")
+                self._pool = ctx.Pool(self._num_workers,
+                                      initializer=_worker_init,
+                                      initargs=(self._dataset,))
 
     def __iter__(self):
         if self._pool is not None:
@@ -121,7 +150,11 @@ class DataLoader:
                 idx = next(it)
             except StopIteration:
                 return False
-            queue.append(self._pool.apply_async(_worker_fn, (idx,)))
+            if self._thread_pool:
+                queue.append(self._pool.apply_async(
+                    _thread_worker_fn, (self._dataset, idx)))
+            else:
+                queue.append(self._pool.apply_async(_worker_fn, (idx,)))
             return True
 
         for _ in range(self._prefetch or 2):

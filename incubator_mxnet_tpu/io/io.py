@@ -279,6 +279,33 @@ class ImageRecordIter(DataIter):
         self._std = _np.array([std_r, std_g, std_b],
                               dtype=_np.float32).reshape(3, 1, 1)
         self._rng = _np.random.RandomState(seed)
+
+        # native C++ pipeline (src/io/recordio_pipeline.cc — the
+        # ImageRecordIOParser2 equivalent): GIL-free decode+augment.
+        # PIL threadpool below is the always-available fallback.
+        self._native = None
+        self._nat_fut = None
+        if dtype == "float32" and self.data_shape[0] == 3:
+            from . import native as _native
+            if _native.available():
+                try:
+                    self._native = _native.NativeImageRecordReader(
+                        path_imgrec, batch_size, self.data_shape,
+                        resize=max(resize, 0), rand_crop=rand_crop,
+                        rand_mirror=rand_mirror, shuffle=shuffle,
+                        label_width=label_width,
+                        mean=(mean_r, mean_g, mean_b),
+                        std=(std_r, std_g, std_b), seed=seed,
+                        num_threads=preprocess_threads)
+                except (IOError, RuntimeError):
+                    self._native = None
+        if self._native is not None:
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1)          # prefetch thread (double buffer)
+            self._nat_fut = None
+            self.reset()
+            return
+
         idx_path = path_imgrec[:path_imgrec.rfind(".")] + ".idx"
         if os.path.exists(idx_path):
             self._rec = MXIndexedRecordIO(idx_path, path_imgrec, "r")
@@ -293,6 +320,16 @@ class ImageRecordIter(DataIter):
         self.reset()
 
     def reset(self):
+        if self._native is not None:
+            # drain the in-flight prefetch first: Pipeline::Reset must
+            # not race mxio_next, and an orphaned future would consume
+            # (and drop) the new epoch's first batch
+            if self._nat_fut is not None:
+                self._nat_fut.result()
+                self._nat_fut = None
+            self._native.reset()
+            self._nat_fut = self._pool.submit(self._native.next_batch)
+            return
         if self._keys is not None:
             self._order = list(self._keys)
             if self._shuffle:
@@ -356,6 +393,21 @@ class ImageRecordIter(DataIter):
             self._pending.append(futs)
 
     def next(self):
+        if self._native is not None:
+            batch = self._nat_fut.result()
+            if batch is None:
+                raise StopIteration
+            self._nat_fut = self._pool.submit(self._native.next_batch)
+            data, label = batch
+            if self.label_width == 1:
+                label = label[:, 0]
+            pad = self.batch_size - data.shape[0]
+            if pad:
+                data = _np.concatenate([data, _np.repeat(
+                    data[-1:], pad, axis=0)])
+                label = _np.concatenate([label, _np.repeat(
+                    label[-1:], pad, axis=0)])
+            return DataBatch([nd.array(data)], [nd.array(label)], pad=pad)
         if not self._pending:
             raise StopIteration
         futs = self._pending.pop(0)

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""im2rec — pack images into RecordIO (ref: tools/im2rec.py).
+
+Two modes, same CLI shape as the reference:
+
+  # 1) make a list file from an image directory (label = folder index)
+  python tools/im2rec.py --list mydata ./images
+
+  # 2) pack the list into mydata.rec / mydata.idx
+  python tools/im2rec.py mydata ./images --resize 256 --quality 95
+
+List format (tab-separated): index <tab> label... <tab> relpath
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(prefix, root, args):
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    entries = []
+    if classes:
+        for li, cls in enumerate(classes):
+            for dirpath, _dirs, files in os.walk(os.path.join(root, cls)):
+                for f in sorted(files):
+                    if f.lower().endswith(_EXTS):
+                        rel = os.path.relpath(os.path.join(dirpath, f),
+                                              root)
+                        entries.append((float(li), rel))
+    else:
+        for dirpath, _dirs, files in os.walk(root):
+            for f in sorted(files):
+                if f.lower().endswith(_EXTS):
+                    rel = os.path.relpath(os.path.join(dirpath, f), root)
+                    entries.append((0.0, rel))
+    if args.shuffle:
+        random.Random(args.seed).shuffle(entries)
+    with open(prefix + ".lst", "w") as out:
+        for i, (label, rel) in enumerate(entries):
+            out.write("%d\t%g\t%s\n" % (i, label, rel))
+    print("wrote %s.lst (%d entries, %d classes)"
+          % (prefix, len(entries), max(1, len(classes))))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels, parts[-1]
+
+
+def pack(prefix, root, args):
+    import numpy as np
+    from PIL import Image
+    from incubator_mxnet_tpu.io import recordio
+
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                     "w")
+    n = 0
+    for idx, labels, rel in read_list(prefix + ".lst"):
+        path = os.path.join(root, rel)
+        try:
+            im = Image.open(path).convert("RGB")
+        except OSError as e:
+            print("skip %s: %s" % (rel, e), file=sys.stderr)
+            continue
+        if args.resize > 0:
+            w, h = im.size
+            short = min(w, h)
+            if short != args.resize:
+                s = args.resize / short
+                im = im.resize((max(1, round(w * s)),
+                                max(1, round(h * s))),
+                               Image.BILINEAR)
+        if args.center_crop and im.size[0] != im.size[1]:
+            w, h = im.size
+            c = min(w, h)
+            x0, y0 = (w - c) // 2, (h - c) // 2
+            im = im.crop((x0, y0, x0 + c, y0 + c))
+        label = labels[0] if len(labels) == 1 else \
+            np.asarray(labels, np.float32)
+        header = recordio.IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, recordio.pack_img(header, np.asarray(im),
+                                             quality=args.quality))
+        n += 1
+        if n % 1000 == 0:
+            print("packed %d" % n)
+    rec.close()
+    print("wrote %s.rec / %s.idx (%d records)" % (prefix, prefix, n))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix", help="output prefix (prefix.lst/.rec/.idx)")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="generate the .lst file instead of packing")
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter side before packing")
+    ap.add_argument("--center-crop", action="store_true")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--shuffle", action="store_true", default=True)
+    ap.add_argument("--no-shuffle", dest="shuffle", action="store_false")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    if args.list:
+        make_list(args.prefix, args.root, args)
+    else:
+        if not os.path.exists(args.prefix + ".lst"):
+            make_list(args.prefix, args.root, args)
+        pack(args.prefix, args.root, args)
+
+
+if __name__ == "__main__":
+    main()
