@@ -45,6 +45,7 @@ from .symbol import Symbol
 from . import module as mod
 from . import module
 from . import parallel
+from . import config
 from . import contrib
 from . import callback
 from . import monitor

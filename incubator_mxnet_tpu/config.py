@@ -1,0 +1,174 @@
+"""Unified typed config/flag registry (ref: SURVEY §5.6 — the reference
+reads MXNET_* env vars ad-hoc via dmlc::GetEnv across the codebase and
+documents them in docs/faq/env_var.md; this module is the single typed
+catalogue of every knob this framework honors).
+
+Usage:
+
+    from incubator_mxnet_tpu import config
+    config.get("MXNET_ENGINE_TYPE")       # typed read (env > default)
+    config.describe()                     # the env_var.md analogue
+    config.set("MXNET_USE_PALLAS", "0")   # process-local override
+
+Values resolve in order: process-local override (`set`) → environment →
+registered default.  Use sites read through `config.get` at call time,
+so env changes made before first use are honored (matching dmlc::GetEnv
+semantics)."""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["register", "get", "set", "unset", "list_vars", "describe"]
+
+_LOCK = threading.Lock()
+_REGISTRY: Dict[str, "_Var"] = {}
+_OVERRIDES: Dict[str, str] = {}
+
+
+class _Var:
+    __slots__ = ("name", "type", "default", "doc", "choices")
+
+    def __init__(self, name, type_, default, doc, choices=None):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.doc = doc
+        self.choices = choices
+
+
+def _to_bool(s):
+    return str(s).lower() in ("1", "true", "yes", "on")
+
+
+def register(name: str, type_: Callable = str, default: Any = None,
+             doc: str = "", choices=None):
+    """Register a knob. Re-registration with identical signature is a
+    no-op; conflicting re-registration raises."""
+    with _LOCK:
+        old = _REGISTRY.get(name)
+        if old is not None:
+            if (old.type, old.default, old.choices) != \
+                    (type_, default, choices):
+                raise ValueError("config %s re-registered with a "
+                                 "different signature" % name)
+            return
+        _REGISTRY[name] = _Var(name, type_, default, doc, choices)
+
+
+def _parse(var, raw):
+    conv = _to_bool if var.type is bool else var.type
+    val = conv(raw)
+    if var.choices is not None and val not in var.choices:
+        raise ValueError("config %s: %r not in %r"
+                         % (var.name, val, var.choices))
+    return val
+
+
+_warned = set()
+
+
+def get(name: str, default: Any = None):
+    """Typed read: override > environment > registered default > the
+    `default` argument. Unregistered names read the raw environment.
+
+    A malformed ENVIRONMENT value warns once and falls back to the
+    default — a stray env var must never make `import` crash (matching
+    dmlc::GetEnv's tolerance). `set()` overrides were validated eagerly,
+    so they always parse here."""
+    var = _REGISTRY.get(name)
+    raw = _OVERRIDES.get(name, os.environ.get(name))
+    if var is None:
+        return raw if raw is not None else default
+    if raw is None:
+        return var.default if default is None else default
+    try:
+        return _parse(var, raw)
+    except (TypeError, ValueError) as e:
+        if name not in _warned:
+            _warned.add(name)
+            import warnings
+            warnings.warn("ignoring invalid %s=%r (%s); using default %r"
+                          % (name, raw, e, var.default))
+        return var.default if default is None else default
+
+
+def set(name: str, value) -> None:     # noqa: A001 — parity naming
+    """Process-local override (wins over the environment). Validated
+    eagerly for registered names — a bad explicit override is a bug at
+    the call site, unlike a stray env var."""
+    var = _REGISTRY.get(name)
+    if var is not None:
+        _parse(var, str(value))
+    _OVERRIDES[name] = str(value)
+
+
+def unset(name: str) -> None:
+    _OVERRIDES.pop(name, None)
+
+
+def list_vars():
+    return sorted(_REGISTRY)
+
+
+def describe() -> str:
+    """Render the registry as the env_var.md-style table."""
+    lines = ["%-36s %-8s %-14s %s" % ("Variable", "Type", "Default",
+                                      "Description"),
+             "-" * 100]
+    for name in sorted(_REGISTRY):
+        v = _REGISTRY[name]
+        cur = get(name)
+        mark = "" if cur == v.default else "   [now: %r]" % (cur,)
+        lines.append("%-36s %-8s %-14r %s%s"
+                     % (name, v.type.__name__, v.default,
+                        v.doc, mark))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# the catalogue — every knob the framework honors, in one place
+# ---------------------------------------------------------------------------
+
+register("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
+         "Engine mode; 'NaiveEngine' blocks after every op (race "
+         "debugging, ref §5.2)",
+         choices=("ThreadedEnginePerDevice", "ThreadedEngine",
+                  "NaiveEngine"))
+register("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", int, 15,
+         "Op count threshold above which the engine emits a bulk-segment "
+         "profiler mark (XLA fuses regardless)")
+register("MXNET_USE_PALLAS", str, "1",
+         "Pallas kernel dispatch: 0=never, 1=auto (by score-matrix "
+         "bytes), 2=always", choices=("0", "1", "2"))
+register("MXNET_PALLAS_INTERPRET", bool, False,
+         "Run Pallas kernels in interpret mode (CPU debugging)")
+register("MXNET_FLASH_BLOCK_Q", int, 0,
+         "Flash-attention Q block size (0 = auto)")
+register("MXNET_FLASH_BLOCK_K", int, 0,
+         "Flash-attention K block size (0 = auto)")
+register("MXNET_FLASH_AUTO_BYTES", float, 4e9,
+         "Score-matrix bytes above which attention auto-switches to the "
+         "flash kernel")
+register("MXNET_FLASH_BWD_BYTES", float, 5e8,
+         "Bytes threshold for the recompute-free flash backward")
+register("MXNET_TEST_DEVICE", str, "cpu",
+         "Test corpus device: 'cpu' (virtual 8-chip mesh) or 'tpu'")
+register("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 20,
+         "Array size above which kvstore push/pull prefers sharded "
+         "reduce (parity knob; XLA collectives auto-tune)")
+register("MXNET_GPU_MEM_POOL_TYPE", str, "Naive",
+         "Accepted for parity; memory pooling is the PJRT/XLA "
+         "allocator's job on TPU (BFC arena) — value is recorded but "
+         "has no effect",
+         choices=("Naive", "Round", "Unpooled"))
+register("MXNET_GPU_MEM_POOL_RESERVE", int, 5,
+         "Accepted for parity; see MXNET_GPU_MEM_POOL_TYPE")
+register("MXNET_ENFORCE_DETERMINISM", bool, False,
+         "Request deterministic XLA lowering (sets "
+         "--xla_gpu_deterministic_ops-equivalent behavior where "
+         "available; threefry RNG is always deterministic)")
+register("MXNET_SAFE_ACCUMULATION", bool, True,
+         "Accumulate norms/softmax in float32 when inputs are "
+         "half-precision (always on in XLA lowerings here)")

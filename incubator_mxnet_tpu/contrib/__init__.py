@@ -5,5 +5,7 @@ Subpackages: `amp` (automatic mixed precision), `quantization`
 """
 from . import amp
 from . import quantization
+from . import text
+from . import onnx
 
-__all__ = ["amp", "quantization"]
+__all__ = ["amp", "quantization", "text", "onnx"]

@@ -1,0 +1,30 @@
+"""mx.contrib.onnx (ref: python/mxnet/contrib/onnx/ — import_model /
+export_model over the onnx package).
+
+The `onnx` package is not part of this build's frozen environment, so
+both directions raise with a pointer to the supported interchange paths
+(HybridBlock.export symbol+params JSON, and DLPack for in-memory
+tensors).  The API names match the reference so callers fail at the
+call site, not at import."""
+from __future__ import annotations
+
+__all__ = ["import_model", "export_model", "get_model_metadata"]
+
+_MSG = ("mx.contrib.onnx requires the 'onnx' package, which is not "
+        "available in this environment (no egress to install it). "
+        "Supported interchange: HybridBlock.export()/SymbolBlock.imports "
+        "for whole models, mx.nd.to_dlpack_for_read/from_dlpack for "
+        "tensors.")
+
+
+def import_model(model_file):
+    raise NotImplementedError(_MSG)
+
+
+def export_model(sym, params, input_shape, input_type=None,
+                 onnx_file_path="model.onnx", verbose=False):
+    raise NotImplementedError(_MSG)
+
+
+def get_model_metadata(model_file):
+    raise NotImplementedError(_MSG)

@@ -1,0 +1,14 @@
+"""mx.contrib.text (ref: python/mxnet/contrib/text/ — vocab,
+embedding, utils): text vocabulary + token-embedding containers feeding
+`nn.Embedding`."""
+from . import vocab
+from . import embedding
+from . import utils
+from .vocab import Vocabulary
+from .embedding import (TokenEmbedding, CustomEmbedding,
+                        CompositeEmbedding, register, create,
+                        get_pretrained_file_names)
+
+__all__ = ["vocab", "embedding", "utils", "Vocabulary", "TokenEmbedding",
+           "CustomEmbedding", "CompositeEmbedding", "register", "create",
+           "get_pretrained_file_names"]

@@ -1,0 +1,219 @@
+"""Token embeddings (ref: python/mxnet/contrib/text/embedding.py —
+TokenEmbedding base, CustomEmbedding from a pretrained file,
+CompositeEmbedding, registry/create).
+
+Pretrained downloads (GloVe/FastText) are registered for API parity but
+this environment has no egress — `create('glove', ...)` raises with the
+local-file alternative (`CustomEmbedding(pretrained_file_path=...)`)."""
+from __future__ import annotations
+
+import io
+import logging
+import os
+
+import numpy as _np
+
+from ...ndarray import array as _nd_array
+
+__all__ = ["TokenEmbedding", "CustomEmbedding", "CompositeEmbedding",
+           "register", "create", "get_pretrained_file_names"]
+
+_REGISTRY = {}
+
+
+def register(cls):
+    """ref: text.embedding.register decorator."""
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(embedding_name, **kwargs):
+    """ref: text.embedding.create('glove', pretrained_file_name=...)."""
+    name = embedding_name.lower()
+    if name not in _REGISTRY:
+        raise KeyError("unknown embedding %r; registered: %s"
+                       % (embedding_name, sorted(_REGISTRY)))
+    return _REGISTRY[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """ref: same API; names listed for parity, files must be local."""
+    table = {
+        "glove": ["glove.6B.50d.txt", "glove.6B.100d.txt",
+                  "glove.6B.200d.txt", "glove.6B.300d.txt",
+                  "glove.42B.300d.txt", "glove.840B.300d.txt"],
+        "fasttext": ["wiki.simple.vec", "wiki.en.vec"],
+    }
+    if embedding_name is None:
+        return table
+    return table[embedding_name.lower()]
+
+
+class TokenEmbedding:
+    """Base container: idx ↔ token plus an (N, dim) vector table whose
+    row 0 is the unknown vector (ref: text.embedding.TokenEmbedding)."""
+
+    def __init__(self, unknown_token="<unk>"):
+        self._unknown_token = unknown_token
+        self._idx_to_token = [unknown_token]
+        self._token_to_idx = {unknown_token: 0}
+        self._idx_to_vec = None          # NDArray (N, dim)
+
+    # -- loading -------------------------------------------------------
+    def _load_embedding_txt(self, path, elem_delim=" ",
+                            encoding="utf8"):
+        vecs = []
+        dim = None
+        with io.open(path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if line_num == 0 and len(parts) == 2 and \
+                        parts[0].isdigit() and parts[1].isdigit():
+                    continue    # fasttext "count dim" header line
+                token, elems = parts[0], parts[1:]
+                if not token or not elems:
+                    logging.warning("line %d: bad entry, skipped",
+                                    line_num)
+                    continue
+                if dim is None:
+                    dim = len(elems)
+                elif len(elems) != dim:
+                    logging.warning("line %d: dim %d != %d, skipped",
+                                    line_num, len(elems), dim)
+                    continue
+                if token in self._token_to_idx:
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                vecs.append(_np.asarray(elems, _np.float32))
+        if dim is None:
+            raise ValueError("no vectors found in %s" % path)
+        table = _np.zeros((len(self._idx_to_token), dim), _np.float32)
+        if vecs:
+            table[1:] = _np.stack(vecs)
+        self._idx_to_vec = _nd_array(table)
+
+    # -- interface -----------------------------------------------------
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    def __contains__(self, token):
+        return token in self._token_to_idx
+
+    @property
+    def vec_len(self):
+        return 0 if self._idx_to_vec is None else \
+            self._idx_to_vec.shape[1]
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = []
+        for t in toks:
+            if t in self._token_to_idx:
+                idx.append(self._token_to_idx[t])
+            elif lower_case_backup and t.lower() in self._token_to_idx:
+                idx.append(self._token_to_idx[t.lower()])
+            else:
+                idx.append(0)
+        data = self._idx_to_vec.asnumpy()[idx]
+        out = _nd_array(data[0] if single else data)
+        return out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        nv = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else _np.asarray(new_vectors, _np.float32)
+        if nv.ndim == 1:
+            nv = nv[None, :]
+        table = self._idx_to_vec.asnumpy().copy()   # device view is RO
+        for t, v in zip(toks, nv):
+            if t not in self._token_to_idx:
+                raise ValueError("token %r not indexed" % t)
+            table[self._token_to_idx[t]] = v
+        self._idx_to_vec = _nd_array(table)
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Embedding from a local pretrained text file: each line
+    'token<delim>v1<delim>v2...' (ref: text.embedding.CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        if not os.path.isfile(pretrained_file_path):
+            raise ValueError("no such file: %r" % pretrained_file_path)
+        self._load_embedding_txt(pretrained_file_path, elem_delim,
+                                 encoding)
+        if vocabulary is not None:
+            self._restrict_to_vocab(vocabulary)
+
+    def _restrict_to_vocab(self, vocabulary):
+        old = self._idx_to_vec.asnumpy()
+        old_map = self._token_to_idx
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        table = _np.zeros((len(self._idx_to_token), old.shape[1]),
+                          _np.float32)
+        for t, i in self._token_to_idx.items():
+            if t in old_map:
+                table[i] = old[old_map[t]]
+        self._idx_to_vec = _nd_array(table)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary
+    (ref: text.embedding.CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings, **kwargs):
+        super().__init__(**kwargs)
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        self._vocab = vocabulary
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        parts = []
+        for emb in token_embeddings:
+            vecs = emb.get_vecs_by_tokens(self._idx_to_token)
+            parts.append(vecs.asnumpy())
+        self._idx_to_vec = _nd_array(_np.concatenate(parts, axis=1))
+
+    @property
+    def vocabulary(self):
+        return self._vocab
+
+
+class _NoEgress(TokenEmbedding):
+    def __init__(self, pretrained_file_name=None, **kwargs):
+        raise RuntimeError(
+            "pretrained %s downloads need network egress, which this "
+            "build does not have; download the file yourself and use "
+            "CustomEmbedding(pretrained_file_path=...)"
+            % type(self).__name__)
+
+
+@register
+class GloVe(_NoEgress):
+    """Gated: see _NoEgress."""
+
+
+@register
+class FastText(_NoEgress):
+    """Gated: see _NoEgress."""

@@ -1,0 +1,83 @@
+"""Vocabulary (ref: python/mxnet/contrib/text/vocab.py)."""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    """Token ↔ index mapping built from a Counter (ref: text.vocab.
+    Vocabulary — same constructor contract: most_freq_count,
+    min_freq, unknown_token, reserved_tokens; index 0 is unknown)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        self._unknown_token = unknown_token
+        reserved_tokens = list(reserved_tokens or [])
+        if len(set(reserved_tokens)) != len(reserved_tokens):
+            raise ValueError("reserved tokens must be unique")
+        if unknown_token in reserved_tokens:
+            raise ValueError("unknown_token cannot be reserved")
+        self._reserved_tokens = reserved_tokens or None
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        self._token_to_idx = {t: i for i, t in
+                              enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        assert isinstance(counter, collections.Counter)
+        unknown = self._unknown_token
+        reserved = set(self._idx_to_token)
+        token_freqs = sorted(counter.items(), key=lambda x: x[0])
+        token_freqs.sort(key=lambda x: x[1], reverse=True)
+        limit = len(counter) if most_freq_count is None else \
+            most_freq_count
+        for token, freq in token_freqs:
+            if freq < min_freq or len(self._idx_to_token) - 1 - \
+                    len(self._reserved_tokens or []) >= limit:
+                break
+            if token != unknown and token not in reserved:
+                self._idx_to_token.append(token)
+                self._token_to_idx[token] = len(self._idx_to_token) - 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    def __contains__(self, token):
+        return token in self._token_to_idx
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Tokens → indices (unknown → 0)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError("index %d out of range" % i)
+        toks = [self._idx_to_token[i] for i in idxs]
+        return toks[0] if single else toks

@@ -25,7 +25,8 @@ __all__ = ["naive_mode", "set_naive_mode", "wait_all", "add_dispatch_listener",
            "remove_dispatch_listener", "_dispatch_hook", "bulk",
            "set_bulk_size"]
 
-_NAIVE = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+from . import config as _cfg
+_NAIVE = _cfg.get("MXNET_ENGINE_TYPE") == "NaiveEngine"
 
 # Listeners: callables (name, ctx, elapsed_s) — used by the profiler.
 _LISTENERS: List[Callable] = []
@@ -76,7 +77,7 @@ def wait_all():
 # Bulking knobs kept for API familiarity (ref: MXNET_EXEC_BULK_EXEC_*).
 # XLA fusion inside jitted executables is the actual bulking mechanism;
 # these are accepted and recorded but change nothing imperatively.
-_BULK_SIZE = int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15))
+_BULK_SIZE = int(_cfg.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN"))
 
 
 def set_bulk_size(size: int) -> int:

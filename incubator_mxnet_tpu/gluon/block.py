@@ -279,7 +279,84 @@ class Block:
         raise NotImplementedError
 
     def summary(self, *inputs):
-        raise NotImplementedError("Block.summary lands with the docs slice")
+        """Print a per-layer summary table (ref: Block.summary —
+        layer name, output shape, param count) by running a hooked
+        forward on `inputs`."""
+        rows = []
+        hooks = []
+        seen_params = set()
+
+        def _count_params(block, trainable_only=False):
+            n = 0
+            for p in block._reg_params.values():
+                if p._data is None and not p._shape_known():
+                    continue
+                if trainable_only and p.grad_req == "null":
+                    continue
+                size = 1
+                for d in (p.shape or ()):
+                    size *= d
+                n += size
+            return n
+
+        def _register(block, prefix):
+            def hook(blk, args, out, _name=prefix or
+                     block.__class__.__name__):
+                first = out[0] if isinstance(out, (list, tuple)) else out
+                shape = tuple(getattr(first, "shape", ()))
+                rows.append((_name, blk.__class__.__name__, shape,
+                             _count_params(blk)))
+            hooks.append(block.register_forward_hook(hook))
+            for name, child in block._children.items():
+                _register(child, (prefix + "." if prefix else "") + name)
+
+        _register(self, "")
+        # force the imperative path: the cached-graph executable would
+        # bypass every child's forward hooks (upstream raises on active
+        # hybridized blocks; deactivate-and-restore is strictly better)
+        deactivated = []
+
+        def _deactivate(b):
+            if getattr(b, "_active", False):
+                b._active = False
+                deactivated.append(b)
+            for c in b._children.values():
+                _deactivate(c)
+
+        _deactivate(self)
+        try:
+            with _ag.pause():
+                self(*inputs)
+        finally:
+            for h in hooks:
+                h.detach()
+            for b in deactivated:
+                b._active = True
+
+        lines = ["%s" % ("-" * 68),
+                 "%-28s %-14s %14s %8s" % ("Layer", "Type",
+                                           "Output Shape", "Params"),
+                 "=" * 68]
+        total = 0
+        for name, typ, shape, n in rows:
+            lines.append("%-28s %-14s %14s %8d"
+                         % (name[:28] or "(self)", typ[:14],
+                            str(shape), n))
+        for p in self.collect_params().values():
+            if id(p) in seen_params:
+                continue
+            seen_params.add(id(p))
+            if p.shape and all(d > 0 for d in p.shape):
+                size = 1
+                for d in p.shape:
+                    size *= d
+                total += size
+        lines.append("=" * 68)
+        lines.append("Total params: %d" % total)
+        lines.append("-" * 68)
+        out = "\n".join(lines)
+        print(out)
+        return out
 
     def __repr__(self):
         s = "{name}(\n{modstr}\n)" if self._children else "{name}()"
@@ -412,6 +489,14 @@ class _CachedGraph:
             kb = leaves[-1]
             outs, states = pure(pv, iv, kb)
             return tuple(outs) + tuple(states)
+
+        if self.flags.get("remat"):
+            import jax
+            policy = None
+            name = self.flags.get("remat_policy")
+            if name:
+                policy = getattr(jax.checkpoint_policies, name)
+            pure_flat = jax.checkpoint(pure_flat, policy=policy)
         return pure_flat
 
     def _get_fwd_vjp(self, training, np_, ni_):
@@ -531,12 +616,20 @@ class HybridBlock(Block):
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
                   inline_limit=2, forward_bulk_size=None,
-                  backward_bulk_size=None):
+                  backward_bulk_size=None, remat=False, remat_policy=None):
         """static_alloc/static_shape accepted for API parity; XLA buffer
-        assignment + donation already provide them (SURVEY §7.0)."""
+        assignment + donation already provide them (SURVEY §7.0).
+
+        remat=True enables rematerialisation (SURVEY §5.7: the
+        reference's memonger/grad-mirroring role): backward recomputes
+        this block's forward instead of storing residuals, trading FLOPs
+        for HBM — the standard long-context lever on TPU.  remat_policy
+        names a jax.checkpoint_policies member (e.g.
+        'dots_with_no_batch_dims_saveable') for selective saving."""
         self._active = active
         self._flags = dict(static_alloc=static_alloc,
-                           static_shape=static_shape)
+                           static_shape=static_shape, remat=remat,
+                           remat_policy=remat_policy)
         self._cached_graph = None
         super().hybridize(active, static_alloc=static_alloc,
                           static_shape=static_shape)

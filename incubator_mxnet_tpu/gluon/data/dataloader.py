@@ -109,9 +109,15 @@ class DataLoader:
                 # fork + cpu_shared IPC; PJRT rules that out).  Spawn
                 # must pickle the dataset — fall back to threads when it
                 # can't (e.g. transform_first(lambda ...)).
+                import io as _io
                 import pickle
                 try:
-                    pickle.dumps(self._dataset)
+                    # stream to a sink: no serialized copy is retained
+                    # (a multi-GB dataset would double peak RSS)
+                    class _Sink(_io.RawIOBase):
+                        def write(self, b):
+                            return len(b)
+                    pickle.dump(self._dataset, _Sink())
                 except Exception:
                     import warnings
                     warnings.warn(

@@ -6,9 +6,11 @@ from ...block import HybridBlock
 from ...nn import (HybridSequential, Conv2D, Dense, BatchNorm, Activation,
                    GlobalAvgPool2D, Flatten)
 
-__all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",
-           "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0",
-           "mobilenet_v2_0_75", "mobilenet_v2_0_5", "mobilenet_v2_0_25"]
+__all__ = ["MobileNet", "MobileNetV2", "MobileNetV3", "mobilenet1_0",
+           "mobilenet0_75", "mobilenet0_5", "mobilenet0_25",
+           "mobilenet_v2_1_0", "mobilenet_v2_0_75", "mobilenet_v2_0_5",
+           "mobilenet_v2_0_25", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
 
 
 def _add_conv(out, channels, kernel=1, stride=1, pad=0, num_group=1,
@@ -96,6 +98,111 @@ class MobileNetV2(HybridBlock):
 
     def forward(self, x):
         return self.output(self.features(x))
+
+
+class _HSwish(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x * F.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+class _HSigmoid(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+class _SE(HybridBlock):
+    """Squeeze-excite (ref: gluoncv mobilenetv3 _SE)."""
+
+    def __init__(self, channels, reduction=4, **kwargs):
+        super().__init__(**kwargs)
+        self.pool = GlobalAvgPool2D()
+        self.fc1 = Conv2D(max(channels // reduction, 8), 1)
+        self.act = Activation("relu")
+        self.fc2 = Conv2D(channels, 1)
+        self.gate = _HSigmoid()
+
+    def forward(self, x):
+        w = self.gate(self.fc2(self.act(self.fc1(self.pool(x)))))
+        return x * w
+
+
+class _V3Bottleneck(HybridBlock):
+    def __init__(self, in_c, exp_c, out_c, kernel, stride, se, hs,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.use_res = stride == 1 and in_c == out_c
+        self.body = HybridSequential()
+        if exp_c != in_c:
+            self.body.add(Conv2D(exp_c, 1, use_bias=False), BatchNorm(),
+                          _HSwish() if hs else Activation("relu"))
+        self.body.add(Conv2D(exp_c, kernel, stride, kernel // 2,
+                             groups=exp_c, use_bias=False), BatchNorm(),
+                      _HSwish() if hs else Activation("relu"))
+        if se:
+            self.body.add(_SE(exp_c))
+        self.body.add(Conv2D(out_c, 1, use_bias=False), BatchNorm())
+
+    def forward(self, x):
+        out = self.body(x)
+        return x + out if self.use_res else out
+
+
+# (kernel, exp, out, SE, hard-swish, stride) per gluoncv mobilenet_v3
+_V3_SMALL = [(3, 16, 16, True, False, 2), (3, 72, 24, False, False, 2),
+             (3, 88, 24, False, False, 1), (5, 96, 40, True, True, 2),
+             (5, 240, 40, True, True, 1), (5, 240, 40, True, True, 1),
+             (5, 120, 48, True, True, 1), (5, 144, 48, True, True, 1),
+             (5, 288, 96, True, True, 2), (5, 576, 96, True, True, 1),
+             (5, 576, 96, True, True, 1)]
+_V3_LARGE = [(3, 16, 16, False, False, 1), (3, 64, 24, False, False, 2),
+             (3, 72, 24, False, False, 1), (5, 72, 40, True, False, 2),
+             (5, 120, 40, True, False, 1), (5, 120, 40, True, False, 1),
+             (3, 240, 80, False, True, 2), (3, 200, 80, False, True, 1),
+             (3, 184, 80, False, True, 1), (3, 184, 80, False, True, 1),
+             (3, 480, 112, True, True, 1), (3, 672, 112, True, True, 1),
+             (5, 672, 160, True, True, 2), (5, 960, 160, True, True, 1),
+             (5, 960, 160, True, True, 1)]
+
+
+class MobileNetV3(HybridBlock):
+    """ref: gluoncv model_zoo mobilenetv3 (small/large)."""
+
+    def __init__(self, mode="small", multiplier=1.0, classes=1000,
+                 **kwargs):
+        super().__init__(**kwargs)
+        cfg = _V3_SMALL if mode == "small" else _V3_LARGE
+        last_exp = 576 if mode == "small" else 960
+        head_c = 1024 if mode == "small" else 1280   # per the V3 paper
+
+        def _c(x):
+            return max(8, int(x * multiplier))
+
+        self.features = HybridSequential()
+        self.features.add(Conv2D(_c(16), 3, 2, 1, use_bias=False),
+                          BatchNorm(), _HSwish())
+        in_c = _c(16)
+        for k, e, o, se, hs, s in cfg:
+            self.features.add(_V3Bottleneck(in_c, _c(e), _c(o), k, s,
+                                            se, hs))
+            in_c = _c(o)
+        self.features.add(Conv2D(_c(last_exp), 1, use_bias=False),
+                          BatchNorm(), _HSwish())
+        self.features.add(GlobalAvgPool2D())
+        self.output = HybridSequential()
+        self.output.add(Conv2D(head_c if multiplier <= 1.0
+                               else _c(head_c), 1), _HSwish(),
+                        Conv2D(classes, 1), Flatten())
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def mobilenet_v3_small(**kw):
+    return MobileNetV3("small", **kw)
+
+
+def mobilenet_v3_large(**kw):
+    return MobileNetV3("large", **kw)
 
 
 def mobilenet1_0(**kw):

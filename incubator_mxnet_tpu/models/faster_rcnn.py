@@ -1,0 +1,118 @@
+"""Faster-RCNN two-stage detector (BASELINE config 3, second half).
+
+Parity target: the reference carries the op layer — src/operator/contrib/
+{proposal.cc, proposal_target.cc, roi_align.cc} — with model assembly in
+example/rcnn + GluonCV faster_rcnn.py; this module is the in-tree
+assembly over this framework's `_contrib_Proposal` /
+`_contrib_ProposalTarget` / `ROIAlign` ops.
+
+TPU-first notes: the RPN → proposal → ROIAlign → head chain is entirely
+fixed-shape (padded proposals carry -1 rows and zero-weight targets), so
+train and inference steps trace into single XLA executables; NMS and ROI
+sampling are the vectorised lax implementations in ops/rcnn.py."""
+from __future__ import annotations
+
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+
+__all__ = ["FasterRCNN", "faster_rcnn_toy", "rcnn_training_targets"]
+
+
+def _conv_block(channels, stride=1):
+    blk = nn.HybridSequential()
+    blk.add(nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1))
+    blk.add(nn.BatchNorm(in_channels=channels))
+    blk.add(nn.Activation("relu"))
+    return blk
+
+
+class FasterRCNN(HybridBlock):
+    """Two-stage detector: backbone → RPN → proposals → ROIAlign →
+    class/box heads.
+
+    forward(x, im_info) returns
+      (cls_pred (R, classes+1), box_pred (R, 4*(classes+1)),
+       rois (R, 5), rpn_cls (B, 2A, H, W), rpn_box (B, 4A, H, W))
+    with R = B * rpn_post_nms_top_n — everything downstream (targets,
+    losses, detection decode) consumes these fixed-shape tensors."""
+
+    def __init__(self, classes, backbone_channels=(16, 32, 64),
+                 feature_stride=8, rpn_channels=64,
+                 anchor_scales=(2, 4), anchor_ratios=(0.5, 1, 2),
+                 rpn_pre_nms_top_n=256, rpn_post_nms_top_n=64,
+                 rpn_min_size=4, roi_size=7, top_units=128, **kwargs):
+        super().__init__(**kwargs)
+        self._classes = classes
+        self._stride = feature_stride
+        self._scales = anchor_scales
+        self._ratios = anchor_ratios
+        self._pre = rpn_pre_nms_top_n
+        self._post = rpn_post_nms_top_n
+        self._min_size = rpn_min_size
+        self._roi = roi_size
+        num_anchors = len(anchor_scales) * len(anchor_ratios)
+
+        # backbone: simple strided conv stack (stride = prod of 2s)
+        import math
+        n_down = int(math.log2(feature_stride))
+        self.features = nn.HybridSequential()
+        for i, ch in enumerate(backbone_channels):
+            self.features.add(_conv_block(ch, stride=2 if i < n_down
+                                          else 1))
+        # RPN
+        self.rpn_conv = nn.Conv2D(rpn_channels, kernel_size=3, padding=1,
+                                  activation="relu")
+        self.rpn_cls = nn.Conv2D(2 * num_anchors, kernel_size=1)
+        self.rpn_box = nn.Conv2D(4 * num_anchors, kernel_size=1)
+        # heads
+        self.top = nn.HybridSequential()
+        self.top.add(nn.Dense(top_units, activation="relu"),
+                     nn.Dense(top_units, activation="relu"))
+        self.cls_head = nn.Dense(classes + 1)
+        self.box_head = nn.Dense(4 * (classes + 1))
+
+    def forward(self, x, im_info):
+        from .. import ndarray as F
+        feat = self.features(x)
+        rpn = self.rpn_conv(feat)
+        rpn_cls = self.rpn_cls(rpn)                  # (B, 2A, H, W)
+        rpn_box = self.rpn_box(rpn)                  # (B, 4A, H, W)
+        B, twoA = rpn_cls.shape[0], rpn_cls.shape[1]
+        A = twoA // 2
+        # softmax over {bg, fg} per anchor
+        sig = F.reshape(rpn_cls, (B, 2, -1))
+        prob = F.softmax(sig, axis=1)
+        rpn_prob = F.reshape(prob, (B, twoA) + rpn_cls.shape[2:])
+        rois = F.invoke(
+            "_contrib_Proposal", rpn_prob, rpn_box, im_info,
+            rpn_pre_nms_top_n=self._pre, rpn_post_nms_top_n=self._post,
+            rpn_min_size=self._min_size, scales=self._scales,
+            ratios=self._ratios, feature_stride=self._stride)
+        pooled = F.invoke("ROIAlign", feat, rois,
+                          pooled_size=(self._roi, self._roi),
+                          spatial_scale=1.0 / self._stride)
+        top = self.top(F.reshape(pooled, (pooled.shape[0], -1)))
+        return (self.cls_head(top), self.box_head(top), rois,
+                rpn_cls, rpn_box)
+
+
+def rcnn_training_targets(rois, gt_boxes, num_classes,
+                          batch_rois=64, fg_fraction=0.25,
+                          fg_overlap=0.5):
+    """ROI sampling + targets for the box head (ref: proposal_target.cc
+    consumed by example/rcnn train_end2end)."""
+    from .. import ndarray as F
+    return F.invoke("_contrib_ProposalTarget", rois, gt_boxes,
+                    num_classes=num_classes + 1,
+                    batch_images=int(gt_boxes.shape[0]),
+                    batch_rois=batch_rois, fg_fraction=fg_fraction,
+                    fg_overlap=fg_overlap)
+
+
+def faster_rcnn_toy(classes=3, **kwargs):
+    """Tiny config for tests/smoke training."""
+    return FasterRCNN(classes, backbone_channels=(8, 16),
+                      feature_stride=4, rpn_channels=16,
+                      anchor_scales=(2, 4), anchor_ratios=(0.5, 1, 2),
+                      rpn_pre_nms_top_n=64, rpn_post_nms_top_n=16,
+                      rpn_min_size=2, roi_size=3, top_units=32, **kwargs)

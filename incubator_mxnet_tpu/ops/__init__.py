@@ -16,5 +16,6 @@ from . import rnn             # noqa: F401
 from . import contrib         # noqa: F401
 from . import attention       # noqa: F401
 from . import quantization    # noqa: F401
+from . import rcnn            # noqa: F401
 
 __all__ = ["register", "get", "list_ops", "alias", "OpDef", "registry"]

@@ -57,15 +57,17 @@ def _block_sizes(T):
     """Measured on this chip (PROFILE.md): per-grid-step overhead is
     ~0.1–0.3 ms, so fewer+bigger blocks win.  Defaults keep the f32
     score block ≤ 8 MB of VMEM."""
-    bq = int(os.environ.get("MXNET_FLASH_BLOCK_Q", "0")) \
+    from .. import config as _cfg
+    bq = int(_cfg.get("MXNET_FLASH_BLOCK_Q")) \
         or _largest_divisor(T, 1024)
-    bk = int(os.environ.get("MXNET_FLASH_BLOCK_K", "0")) \
+    bk = int(_cfg.get("MXNET_FLASH_BLOCK_K")) \
         or _largest_divisor(T, max(128, (2 * 1024 * 1024) // max(bq, 1)))
     return min(bq, T), min(bk, T)
 
 
 def _interpret():
-    return os.environ.get("MXNET_PALLAS_INTERPRET", "0") == "1"
+    from .. import config as _cfg
+    return bool(_cfg.get("MXNET_PALLAS_INTERPRET"))
 
 
 def _tiles_ok(T, d):
@@ -81,7 +83,8 @@ def _pallas_enabled(BH, T, d):
     before T=8192; the Pallas kernel streams k/v blocks through VMEM
     and keeps working.  MXNET_USE_PALLAS: 0=never, 1=auto (score bytes
     > MXNET_FLASH_AUTO_BYTES), 2=always."""
-    mode = os.environ.get("MXNET_USE_PALLAS", "1")
+    from .. import config as _cfg
+    mode = _cfg.get("MXNET_USE_PALLAS")
     if mode == "0" or not _PALLAS_OK:
         return False
     if not _tiles_ok(T, d):
@@ -92,7 +95,7 @@ def _pallas_enabled(BH, T, d):
         return False
     if mode == "2":
         return True
-    auto_bytes = float(os.environ.get("MXNET_FLASH_AUTO_BYTES", 4e9))
+    auto_bytes = float(_cfg.get("MXNET_FLASH_AUTO_BYTES"))
     return BH * T * T * 4.0 > auto_bytes
 
 
@@ -229,7 +232,8 @@ def _flash_attention_bwd(scale, causal, res, do):
     qf, kf, vf, dof = (t.astype(f32) for t in (q, k, v, do))
     D = jnp.sum(dof * out.astype(f32), axis=-1, keepdims=True)  # (BH, T, 1)
 
-    limit = float(os.environ.get("MXNET_FLASH_BWD_BYTES", 5e8))
+    from .. import config as _cfg
+    limit = float(_cfg.get("MXNET_FLASH_BWD_BYTES"))
     bk = T
     while BH * T * bk * 4.0 > limit and bk % 2 == 0:
         bk //= 2

@@ -1,0 +1,248 @@
+"""Region-proposal ops for Faster-RCNN
+(ref: src/operator/contrib/{proposal.cc, multi_proposal.cc,
+proposal_target.cc} — RPN proposal generation + ROI sampling).
+
+TPU conventions (SURVEY §7.2): every output is FIXED-shape; selection
+is expressed as top-k + masking (suppressed/invalid entries carry -1s),
+matching the reference's own padded-output contract for box_nms.  All
+control flow is vectorised lax — no host loops, fully jittable."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+
+def _make_anchors(base_size, scales, ratios):
+    """Generate base anchors (ref: proposal.cc GenerateAnchors)."""
+    import numpy as np
+    base = np.array([1, 1, base_size, base_size], np.float32) - 1
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    anchors = []
+    for r in ratios:
+        size = w * h
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            anchors.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                            cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+    return np.array(anchors, np.float32)
+
+
+def _bbox_transform_inv(boxes, deltas):
+    """Apply regression deltas to anchors (ref: bbox_transform_inv)."""
+    w = boxes[..., 2] - boxes[..., 0] + 1.0
+    h = boxes[..., 3] - boxes[..., 1] + 1.0
+    cx = boxes[..., 0] + 0.5 * (w - 1.0)
+    cy = boxes[..., 1] + 0.5 * (h - 1.0)
+    dx, dy, dw, dh = (deltas[..., 0], deltas[..., 1], deltas[..., 2],
+                      deltas[..., 3])
+    pcx = dx * w + cx
+    pcy = dy * h + cy
+    pw = jnp.exp(jnp.clip(dw, -10.0, 10.0)) * w
+    ph = jnp.exp(jnp.clip(dh, -10.0, 10.0)) * h
+    return jnp.stack([pcx - 0.5 * (pw - 1.0), pcy - 0.5 * (ph - 1.0),
+                      pcx + 0.5 * (pw - 1.0), pcy + 0.5 * (ph - 1.0)],
+                     axis=-1)
+
+
+def _nms_keep(boxes, scores, thresh, topk):
+    """Greedy NMS over score-sorted boxes; returns indices into the
+    sorted order with -1 padding (fixed length topk)."""
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    n = b.shape[0]
+
+    area = jnp.maximum(b[:, 2] - b[:, 0] + 1, 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1] + 1, 0)
+
+    def iou_row(i):
+        tl = jnp.maximum(b[i, :2], b[:, :2])
+        br = jnp.minimum(b[i, 2:4], b[:, 2:4])
+        wh = jnp.maximum(br - tl + 1, 0)
+        inter = wh[:, 0] * wh[:, 1]
+        return inter / jnp.maximum(area[i] + area - inter, 1e-12)
+
+    def body(i, keep):
+        alive = keep[i]
+        ious = iou_row(i)
+        suppress = (ious > thresh) & (jnp.arange(n) > i) & alive
+        return keep & ~suppress
+
+    keep0 = jnp.ones((n,), bool)
+    keep = lax.fori_loop(0, n, body, keep0)
+    # first topk kept indices (positions in sorted order), -1 padded
+    idx_sorted = jnp.nonzero(keep, size=topk, fill_value=-1)[0]
+    return order, idx_sorted
+
+
+@register("_contrib_Proposal",
+          ndarray_inputs=("cls_prob", "bbox_pred", "im_info"),
+          differentiable=False)
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             feature_stride=16, output_score=False, iou_loss=False):
+    """RPN proposals (ref: proposal.cc).  cls_prob (N, 2A, H, W) —
+    second half are foreground scores; bbox_pred (N, 4A, H, W);
+    im_info (N, 3) = (height, width, scale).  Output (N*post, 5) rois
+    [batch_idx, x1, y1, x2, y2], -1-padded rows beyond the kept set."""
+    N, twoA, H, W = cls_prob.shape
+    A = twoA // 2
+    anchors = jnp.asarray(_make_anchors(feature_stride, scales, ratios))
+
+    shift_x = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    shift_y = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    sx, sy = jnp.meshgrid(shift_x, shift_y)
+    shifts = jnp.stack([sx.ravel(), sy.ravel(), sx.ravel(), sy.ravel()],
+                       axis=1)                       # (HW, 4)
+    all_anchors = (anchors[None, :, :] +
+                   shifts[:, None, :]).reshape(-1, 4)  # (HW*A, 4)
+
+    def per_image(scores_i, deltas_i, info_i):
+        # scores: (A, H, W) foreground → (HW*A,)
+        fg = scores_i[A:].transpose(1, 2, 0).reshape(-1)
+        dl = deltas_i.reshape(A, 4, H, W).transpose(2, 3, 0, 1) \
+            .reshape(-1, 4)
+        props = _bbox_transform_inv(all_anchors, dl)
+        # clip to image
+        im_h, im_w = info_i[0], info_i[1]
+        props = jnp.stack([
+            jnp.clip(props[:, 0], 0, im_w - 1.0),
+            jnp.clip(props[:, 1], 0, im_h - 1.0),
+            jnp.clip(props[:, 2], 0, im_w - 1.0),
+            jnp.clip(props[:, 3], 0, im_h - 1.0)], axis=1)
+        # min-size filter
+        ws = props[:, 2] - props[:, 0] + 1
+        hs = props[:, 3] - props[:, 1] + 1
+        min_size = rpn_min_size * info_i[2]
+        valid = (ws >= min_size) & (hs >= min_size)
+        fg = jnp.where(valid, fg, -1e10)
+        # pre-nms top-k
+        k = min(rpn_pre_nms_top_n, fg.shape[0])
+        top_scores, top_idx = lax.top_k(fg, k)
+        top_boxes = props[top_idx]
+        # nms → post_nms_top_n
+        order, keep = _nms_keep(top_boxes, top_scores, threshold,
+                                rpn_post_nms_top_n)
+        sorted_boxes = top_boxes[order]
+        sorted_scores = top_scores[order]
+        ok = keep >= 0
+        sel = jnp.clip(keep, 0, k - 1)
+        boxes_out = jnp.where(ok[:, None], sorted_boxes[sel], -1.0)
+        scores_out = jnp.where(ok, sorted_scores[sel], -1.0)
+        return boxes_out, scores_out
+
+    boxes, scores = jax.vmap(per_image)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(N, dtype=jnp.float32),
+                           rpn_post_nms_top_n).reshape(
+                               N, rpn_post_nms_top_n)
+    rois = jnp.concatenate([batch_idx[..., None], boxes], axis=-1) \
+        .reshape(N * rpn_post_nms_top_n, 5)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois
+
+
+alias("_contrib_Proposal", "_contrib_MultiProposal")
+
+
+@register("_contrib_ProposalTarget",
+          ndarray_inputs=("rois", "gt_boxes"),
+          differentiable=False, num_outputs=4)
+def proposal_target(rois, gt_boxes, num_classes=21, batch_images=1,
+                    batch_rois=128, fg_fraction=0.25, fg_overlap=0.5,
+                    box_stds=(0.1, 0.1, 0.2, 0.2)):
+    """Sample ROIs into training batches (ref: proposal_target.cc).
+
+    rois (R, 5), gt_boxes (N, G, 5) [x1,y1,x2,y2,cls].  Outputs:
+    sampled rois (B, 5), labels (B,), bbox_targets (B, 4*num_classes),
+    bbox_weights (B, 4*num_classes) with B = batch_images*batch_rois.
+    Fixed-shape sampling: top fg_rois by overlap, rest background."""
+    N = gt_boxes.shape[0]
+    per_img = batch_rois // batch_images if batch_images > 1 else \
+        batch_rois
+    fg_per_img = int(round(per_img * fg_fraction))
+
+    def per_image(i):
+        gt = gt_boxes[i]                       # (G, 5)
+        gt_valid = gt[:, 4] >= 0
+        # append gt boxes as candidate rois (ref proposal_target.cc does
+        # this so fg samples exist even before the RPN has learned)
+        gt_as_rois = jnp.concatenate(
+            [jnp.full((gt.shape[0], 1), i, rois.dtype).astype(rois.dtype),
+             gt[:, :4]], axis=1)
+        cand = jnp.concatenate([rois, gt_as_rois], axis=0)
+        mask = (cand[:, 0] == i.astype(rois.dtype)) & jnp.concatenate(
+            [jnp.ones((rois.shape[0],), bool), gt_valid])
+        tl = jnp.maximum(cand[:, None, 1:3], gt[None, :, 0:2])
+        br = jnp.minimum(cand[:, None, 3:5], gt[None, :, 2:4])
+        wh = jnp.maximum(br - tl + 1, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        area_r = jnp.maximum(cand[:, 3] - cand[:, 1] + 1, 0) * \
+            jnp.maximum(cand[:, 4] - cand[:, 2] + 1, 0)
+        area_g = jnp.maximum(gt[:, 2] - gt[:, 0] + 1, 0) * \
+            jnp.maximum(gt[:, 3] - gt[:, 1] + 1, 0)
+        iou = inter / jnp.maximum(
+            area_r[:, None] + area_g[None, :] - inter, 1e-12)
+        iou = jnp.where(gt_valid[None, :], iou, 0.0)
+        max_iou = iou.max(axis=1)
+        gt_assign = iou.argmax(axis=1)
+        max_iou = jnp.where(mask, max_iou, -1.0)
+
+        is_fg = max_iou >= fg_overlap
+        fg_score = jnp.where(is_fg, max_iou, -1e10)
+        _, fg_idx = lax.top_k(fg_score, fg_per_img)
+        fg_ok = fg_score[fg_idx] > -1e9
+
+        bg_score = jnp.where(mask & ~is_fg, max_iou, -1e10)
+        _, bg_idx = lax.top_k(bg_score, per_img - fg_per_img)
+        bg_ok = bg_score[bg_idx] > -1e9
+
+        sel = jnp.concatenate([fg_idx, bg_idx])
+        sel_fg = jnp.concatenate([fg_ok, jnp.zeros_like(bg_ok)])
+        sel_ok = jnp.concatenate([fg_ok, bg_ok])
+
+        r = cand[sel]
+        g = gt[gt_assign[sel]]
+        labels = jnp.where(sel_fg, g[:, 4] + 1, 0.0)
+        labels = jnp.where(sel_ok, labels, -1.0)
+
+        # bbox regression targets (class-specific slots)
+        rw = r[:, 3] - r[:, 1] + 1
+        rh = r[:, 4] - r[:, 2] + 1
+        rcx = r[:, 1] + 0.5 * (rw - 1)
+        rcy = r[:, 2] + 0.5 * (rh - 1)
+        gw = g[:, 2] - g[:, 0] + 1
+        gh = g[:, 3] - g[:, 1] + 1
+        gcx = g[:, 0] + 0.5 * (gw - 1)
+        gcy = g[:, 1] + 0.5 * (gh - 1)
+        stds = jnp.asarray(box_stds, jnp.float32)
+        t = jnp.stack([(gcx - rcx) / jnp.maximum(rw, 1) / stds[0],
+                       (gcy - rcy) / jnp.maximum(rh, 1) / stds[1],
+                       jnp.log(jnp.maximum(gw, 1) /
+                               jnp.maximum(rw, 1)) / stds[2],
+                       jnp.log(jnp.maximum(gh, 1) /
+                               jnp.maximum(rh, 1)) / stds[3]], axis=1)
+        cls = jnp.clip(labels, 0, num_classes - 1).astype(jnp.int32)
+        targets = jnp.zeros((per_img, 4 * num_classes), jnp.float32)
+        weights = jnp.zeros((per_img, 4 * num_classes), jnp.float32)
+        cols = cls[:, None] * 4 + jnp.arange(4)[None, :]
+        rowi = jnp.arange(per_img)[:, None]
+        targets = targets.at[rowi, cols].set(
+            jnp.where(sel_fg[:, None], t, 0.0))
+        weights = weights.at[rowi, cols].set(
+            jnp.where(sel_fg[:, None], 1.0, 0.0))
+        return r, labels, targets, weights
+
+    outs = jax.vmap(per_image)(jnp.arange(N, dtype=jnp.int32))
+    r, labels, targets, weights = outs
+    B = N * per_img
+    return (r.reshape(B, 5), labels.reshape(B),
+            targets.reshape(B, -1), weights.reshape(B, -1))

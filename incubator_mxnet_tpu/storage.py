@@ -1,0 +1,90 @@
+"""Storage manager facade (ref: include/mxnet/storage.h,
+src/storage/pooled_storage_manager.h — GPUPooledStorageManager's
+size-bucketed free lists, MXNET_GPU_MEM_POOL_* knobs).
+
+Deliberate TPU re-design: device memory pooling is the PJRT/XLA
+allocator's job (a BFC arena owns HBM; XLA's buffer assignment reuses
+and donates buffers inside executables), so there is no hand-written
+pool here to configure.  What this module preserves from the reference
+surface:
+
+- `Storage.get()` singleton with `alloc`/`free` bookkeeping hooks — the
+  imperative NDArray path doesn't call it (jax.Array owns its buffer),
+  but custom native extensions can use it for host scratch;
+- per-device memory introspection (`memory_info`) mapping
+  `mx.context.gpu_memory_info` onto PJRT's memory stats;
+- the MXNET_GPU_MEM_POOL_* env knobs are registered in `config` and
+  accepted (recorded, no-op) so reference launch scripts run unchanged.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Storage", "memory_info"]
+
+
+def memory_info(device=None):
+    """(bytes_in_use, bytes_limit) for a device (ref:
+    mx.context.gpu_memory_info; backed by PJRT memory_stats)."""
+    import jax
+    if device is None:
+        device = jax.devices()[0]
+    elif isinstance(device, int):
+        device = jax.devices()[device]
+    elif hasattr(device, "jax_device"):
+        device = device.jax_device
+    stats = getattr(device, "memory_stats", lambda: None)()
+    if not stats:
+        return (0, 0)
+    return (stats.get("bytes_in_use", 0),
+            stats.get("bytes_limit", stats.get("bytes_reservable_limit",
+                                               0)))
+
+
+class Storage:
+    """Host-scratch allocator facade (singleton, ref: Storage::Get).
+
+    Tracks outstanding allocations for leak diagnostics; allocation
+    itself is plain bytearray (aligned host memory — device memory is
+    always XLA's)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get(cls):
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def __init__(self):
+        self._outstanding = {}
+        self._next = 0
+        self._mu = threading.Lock()
+
+    def alloc(self, size):
+        """Returns (handle_id, buffer)."""
+        buf = bytearray(size)
+        with self._mu:
+            hid = self._next
+            self._next += 1
+            self._outstanding[hid] = size
+        return hid, buf
+
+    def free(self, handle_id):
+        with self._mu:
+            self._outstanding.pop(handle_id, None)
+
+    def direct_free(self, handle_id):
+        self.free(handle_id)
+
+    @property
+    def outstanding_bytes(self):
+        with self._mu:
+            return sum(self._outstanding.values())
+
+    @property
+    def outstanding_count(self):
+        with self._mu:
+            return len(self._outstanding)

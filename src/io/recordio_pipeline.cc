@@ -139,7 +139,7 @@ void ResizeBilinear(const uint8_t* src, int sh, int sw, uint8_t* dst,
 
 class Pool {
  public:
-  explicit Pool(int n) : stop_(false), pending_(0) {
+  explicit Pool(int n) : stop_(false) {
     for (int i = 0; i < n; ++i)
       threads_.emplace_back([this] { Run(); });
   }
@@ -151,53 +151,70 @@ class Pool {
     cv_.notify_all();
     for (auto& t : threads_) t.join();
   }
+
+  // All round state (fn_/total_/next_i_/pending_) is mutex-guarded and
+  // tagged with a generation counter: a straggler from round N that
+  // wakes after round N+1 is armed sees gen_ != its captured gen and
+  // retires without claiming items or decrementing the new round's
+  // pending count (the cross-round lost-decrement hang).  Per-item
+  // locking is noise next to a JPEG decode.
   void ParallelFor(int n, const std::function<void(int)>& fn) {
+    uint64_t gen;
     {
       std::lock_guard<std::mutex> lk(m_);
       fn_ = &fn;
-      next_.store(0);
       total_ = n;
+      next_i_ = 0;
       pending_ = n;
+      gen = ++gen_;
     }
     cv_.notify_all();
-    // caller participates
-    Work();
+    Work(gen);                 // caller participates
     std::unique_lock<std::mutex> lk(m_);
     done_cv_.wait(lk, [this] { return pending_ == 0; });
-    fn_ = nullptr;   // under lock: workers read fn_ in their predicate
+    fn_ = nullptr;
   }
 
  private:
-  void Work() {
+  void Work(uint64_t gen) {
     while (true) {
-      const int i = next_.fetch_add(1);
-      if (i >= total_) break;
-      (*fn_)(i);
-      if (--pending_ == 0) {
+      int i;
+      const std::function<void(int)>* fn;
+      {
         std::lock_guard<std::mutex> lk(m_);
-        done_cv_.notify_all();
+        if (gen != gen_ || fn_ == nullptr || next_i_ >= total_) return;
+        i = next_i_++;
+        fn = fn_;
+      }
+      (*fn)(i);
+      {
+        std::lock_guard<std::mutex> lk(m_);
+        if (gen == gen_ && --pending_ == 0) done_cv_.notify_all();
       }
     }
   }
   void Run() {
     while (true) {
+      uint64_t gen;
       {
         std::unique_lock<std::mutex> lk(m_);
         cv_.wait(lk, [this] {
-          return stop_ || (fn_ && next_.load() < total_);
+          return stop_ || (fn_ != nullptr && next_i_ < total_);
         });
         if (stop_) return;
+        gen = gen_;
       }
-      Work();
+      Work(gen);
     }
   }
   std::vector<std::thread> threads_;
   std::mutex m_;
   std::condition_variable cv_, done_cv_;
   const std::function<void(int)>* fn_ = nullptr;
-  std::atomic<int> next_{0};
   int total_ = 0;
-  std::atomic<int> pending_;
+  int next_i_ = 0;
+  int pending_ = 0;
+  uint64_t gen_ = 0;
   bool stop_;
 };
 

@@ -1,0 +1,109 @@
+"""contrib.text tests (ref: tests/python/unittest/test_contrib_text.py)."""
+import collections
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.contrib import text
+
+
+def test_count_tokens_from_str():
+    c = text.utils.count_tokens_from_str(" Life is great! \n life is "
+                                         "good. \n", to_lower=False)
+    assert c["is"] == 2 and c["Life"] == 1 and c["life"] == 1
+    c2 = text.utils.count_tokens_from_str("Life is life", to_lower=True)
+    assert c2["life"] == 2
+
+
+def test_vocabulary_basic():
+    counter = collections.Counter(["a", "b", "b", "c", "c", "c"])
+    v = text.Vocabulary(counter, min_freq=2)
+    assert len(v) == 3              # <unk>, c, b
+    assert v.to_indices("c") == 1
+    assert v.to_indices(["b", "zzz"]) == [2, 0]
+    assert v.to_tokens([1, 2]) == ["c", "b"]
+    assert "a" not in v
+
+
+def test_vocabulary_reserved_and_limits():
+    counter = collections.Counter("aabbbcdd")
+    v = text.Vocabulary(counter, most_freq_count=2,
+                        reserved_tokens=["<pad>"])
+    assert v.idx_to_token[:2] == ["<unk>", "<pad>"]
+    assert len(v) == 4              # unk + pad + top-2
+    with pytest.raises(ValueError):
+        text.Vocabulary(counter, unknown_token="<pad>",
+                        reserved_tokens=["<pad>"])
+    with pytest.raises(ValueError):
+        v.to_tokens(99)
+
+
+def test_custom_embedding_and_lookup(tmp_path):
+    f = tmp_path / "emb.txt"
+    f.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = text.embedding.create("customembedding",
+                                pretrained_file_path=str(f))
+    assert emb.vec_len == 3
+    assert len(emb) == 3            # unk + 2
+    v = emb.get_vecs_by_tokens("hello")
+    assert v.asnumpy().tolist() == [1.0, 2.0, 3.0]
+    vs = emb.get_vecs_by_tokens(["world", "nope"])
+    assert vs.asnumpy()[0].tolist() == [4.0, 5.0, 6.0]
+    assert vs.asnumpy()[1].tolist() == [0.0, 0.0, 0.0]
+    emb.update_token_vectors("hello", mx.nd.array([9.0, 9.0, 9.0]))
+    assert emb.get_vecs_by_tokens("hello").asnumpy().tolist() == [9.0] * 3
+
+
+def test_custom_embedding_with_vocab(tmp_path):
+    f = tmp_path / "emb.txt"
+    f.write_text("a 1.0 1.0\nb 2.0 2.0\nc 3.0 3.0\n")
+    counter = collections.Counter(["b", "b", "zzz"])
+    v = text.Vocabulary(counter)
+    emb = text.CustomEmbedding(str(f), vocabulary=v)
+    assert len(emb) == len(v)
+    assert emb.get_vecs_by_tokens("b").asnumpy().tolist() == [2.0, 2.0]
+    # in-vocab but no pretrained vector → zeros
+    assert emb.get_vecs_by_tokens("zzz").asnumpy().tolist() == [0.0, 0.0]
+
+
+def test_composite_embedding(tmp_path):
+    f1 = tmp_path / "e1.txt"
+    f1.write_text("a 1.0\nb 2.0\n")
+    f2 = tmp_path / "e2.txt"
+    f2.write_text("a 10.0 11.0\n")
+    v = text.Vocabulary(collections.Counter(["a", "b"]))
+    comp = text.CompositeEmbedding(v, [
+        text.CustomEmbedding(str(f1)), text.CustomEmbedding(str(f2))])
+    assert comp.vec_len == 3
+    va = comp.get_vecs_by_tokens("a").asnumpy()
+    assert va.tolist() == [1.0, 10.0, 11.0]
+
+
+def test_embedding_feeds_gluon_embedding(tmp_path):
+    f = tmp_path / "emb.txt"
+    f.write_text("x 1.0 0.0\ny 0.0 1.0\n")
+    v = text.Vocabulary(collections.Counter(["x", "y"]))
+    emb = text.CustomEmbedding(str(f), vocabulary=v)
+    layer = mx.gluon.nn.Embedding(len(v), emb.vec_len)
+    layer.initialize()
+    layer.weight.set_data(emb.idx_to_vec)
+    idx = mx.nd.array(v.to_indices(["x", "y"]), dtype="int32")
+    out = layer(idx).asnumpy()
+    assert out[0].tolist() == [1.0, 0.0]
+    assert out[1].tolist() == [0.0, 1.0]
+
+
+def test_pretrained_downloads_gated():
+    with pytest.raises(RuntimeError, match="egress"):
+        text.embedding.create("glove")
+    assert "glove.6B.50d.txt" in \
+        text.embedding.get_pretrained_file_names("glove")
+
+
+def test_onnx_gated():
+    from incubator_mxnet_tpu.contrib import onnx
+    with pytest.raises(NotImplementedError, match="onnx"):
+        onnx.import_model("m.onnx")
+    with pytest.raises(NotImplementedError):
+        onnx.export_model(None, None, None)

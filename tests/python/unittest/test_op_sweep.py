@@ -746,6 +746,8 @@ case("multi_sgd_mom_update", [_W, _G, _S1, _W * 2, _G * 2, _S1 * 2],
 # ---------------------------------------------------------------------------
 
 TESTED_ELSEWHERE = {
+    "_contrib_Proposal": "test_rcnn.py",
+    "_contrib_ProposalTarget": "test_rcnn.py",
     "_contrib_quantize": "test_quantization.py",
     "_contrib_quantize_v2": "test_quantization.py",
     "_contrib_dequantize": "test_quantization.py",

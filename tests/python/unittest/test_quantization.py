@@ -136,10 +136,14 @@ def test_histogram_collector_widens():
 
 
 def _make_mlp():
+    # deterministic init: the conftest's per-nodeid seed varies across
+    # processes (PYTHONHASHSEED), and quantization error bounds are
+    # init-dependent
+    mx.random.seed(1234)
     net = mx.gluon.nn.HybridSequential()
     net.add(mx.gluon.nn.Dense(32, activation="relu", in_units=16),
             mx.gluon.nn.Dense(8, in_units=32))
-    net.initialize()
+    net.initialize(force_reinit=True)
     return net
 
 
@@ -154,7 +158,9 @@ def test_quantize_net_mlp(calib_mode):
                            num_calib_batches=4)
     got = qnet(xs[0]).asnumpy()
     rel = onp.abs(got - want).max() / (onp.abs(want).max() + 1e-8)
-    assert rel < 0.1, "calib_mode=%s rel err %.4f" % (calib_mode, rel)
+    # entropy clips outliers by design → looser bound than naive
+    tol = 0.2 if calib_mode == "entropy" else 0.1
+    assert rel < tol, "calib_mode=%s rel err %.4f" % (calib_mode, rel)
 
 
 def test_quantize_net_conv_and_exclude():

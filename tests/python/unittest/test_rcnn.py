@@ -1,0 +1,165 @@
+"""Faster-RCNN op/model tests (ref: tests/python/unittest/test_operator.py
+Proposal cases + example/rcnn smoke training)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd as ag
+from incubator_mxnet_tpu.models import (faster_rcnn_toy,
+                                        rcnn_training_targets)
+from incubator_mxnet_tpu.ops.rcnn import (_make_anchors,
+                                          _bbox_transform_inv)
+
+
+def test_make_anchors_shapes_and_centers():
+    a = _make_anchors(16, scales=(8, 16), ratios=(0.5, 1, 2))
+    assert a.shape == (6, 4)
+    # all base anchors share the same center
+    cx = (a[:, 0] + a[:, 2]) / 2
+    cy = (a[:, 1] + a[:, 3]) / 2
+    assert onp.allclose(cx, cx[0]) and onp.allclose(cy, cy[0])
+
+
+def test_bbox_transform_inv_identity():
+    import jax.numpy as jnp
+    boxes = jnp.asarray([[0.0, 0.0, 15.0, 15.0], [10.0, 10.0, 29.0, 19.0]])
+    deltas = jnp.zeros((2, 4))
+    out = onp.asarray(_bbox_transform_inv(boxes, deltas))
+    assert onp.allclose(out, onp.asarray(boxes), atol=1e-5)
+
+
+def test_proposal_zero_deltas_returns_clipped_anchors():
+    """With zero bbox deltas and one clearly-best anchor score, the top
+    proposal equals that anchor clipped to the image."""
+    A = 6
+    H = W = 4
+    stride = 16
+    cls = onp.zeros((1, 2 * A, H, W), onp.float32)
+    # make anchor a=2 at cell (1,2) the single hot foreground
+    cls[0, A + 2, 1, 2] = 10.0
+    box = onp.zeros((1, 4 * A, H, W), onp.float32)
+    im_info = onp.array([[64, 64, 1.0]], onp.float32)
+    rois = nd.invoke("_contrib_Proposal", nd.array(cls), nd.array(box),
+                     nd.array(im_info), rpn_pre_nms_top_n=32,
+                     rpn_post_nms_top_n=8, rpn_min_size=0,
+                     scales=(4, 8), ratios=(0.5, 1, 2),
+                     feature_stride=stride)
+    r = rois.asnumpy()
+    assert r.shape == (8, 5)
+    anchors = _make_anchors(stride, (4, 8), (0.5, 1, 2))
+    want = anchors[2] + onp.array([2 * stride, 1 * stride,
+                                   2 * stride, 1 * stride])
+    want = onp.clip(want, 0, 63)
+    assert onp.allclose(r[0, 1:], want, atol=1e-3), (r[0], want)
+
+
+def test_proposal_nms_suppresses_duplicates():
+    """Two identical high-score anchors at the same location: NMS keeps
+    one; the padded remainder is -1."""
+    A = 1
+    H = W = 2
+    cls = onp.zeros((1, 2 * A, H, W), onp.float32)
+    cls[0, A, 0, 0] = 5.0
+    cls[0, A, 0, 1] = 5.0       # stride 4, 16x16 anchors overlap a lot
+    box = onp.zeros((1, 4 * A, H, W), onp.float32)
+    im_info = onp.array([[32, 32, 1.0]], onp.float32)
+    rois = nd.invoke("_contrib_Proposal", nd.array(cls), nd.array(box),
+                     nd.array(im_info), rpn_pre_nms_top_n=4,
+                     rpn_post_nms_top_n=4, rpn_min_size=0,
+                     scales=(4,), ratios=(1,), threshold=0.3,
+                     feature_stride=4)
+    r = rois.asnumpy()
+    kept = (r[:, 1] >= 0).sum()
+    # all four stride-4-shifted 16x16 anchors overlap above the 0.3
+    # threshold → NMS must suppress down from 4, keeping unique boxes
+    assert 1 <= kept < 4
+    xs = r[r[:, 1] >= 0][:, 1:]
+    assert len({tuple(row) for row in xs.tolist()}) == len(xs)
+
+
+def test_proposal_target_labels_and_targets():
+    """Handcrafted rois with known IoU: fg gets class label + finite
+    regression targets; bg gets 0; padding gets -1."""
+    rois = nd.array(onp.array([
+        [0, 5, 5, 30, 30],      # IoU 1.0 with gt0 → fg, class 0 → label 1
+        [0, 6, 6, 31, 31],      # high IoU with gt0 → fg
+        [0, 50, 50, 60, 60],    # no overlap → bg
+        [0, 0, 0, 3, 3],        # no overlap → bg
+    ], onp.float32))
+    gt = nd.array(onp.array([[[5, 5, 30, 30, 0]]], onp.float32))
+    r, labels, targets, weights = nd.invoke(
+        "_contrib_ProposalTarget", rois, gt, num_classes=4,
+        batch_images=1, batch_rois=4, fg_fraction=0.5, fg_overlap=0.5)
+    ln = labels.asnumpy()
+    assert (ln == onp.array([1, 1, 0, 0])).all(), ln
+    w = weights.asnumpy()
+    # fg rows have 4 active weight slots at class 1; bg rows none
+    assert w[0].sum() == 4 and w[1].sum() == 4
+    assert w[2].sum() == 0 and w[3].sum() == 0
+    t = targets.asnumpy()
+    assert onp.isfinite(t).all()
+    # exact-match roi 0 → near-zero regression target
+    assert onp.abs(t[0]).max() < 1e-4
+
+
+def test_faster_rcnn_forward_shapes():
+    net = faster_rcnn_toy(classes=3)
+    net.initialize()
+    x = nd.array(onp.random.RandomState(0).randn(2, 3, 64, 64)
+                 .astype(onp.float32))
+    im_info = nd.array([[64, 64, 1.0], [64, 64, 1.0]])
+    cls_pred, box_pred, rois, rpn_cls, rpn_box = net(x, im_info)
+    assert cls_pred.shape == (32, 4)
+    assert box_pred.shape == (32, 16)
+    assert rois.shape == (32, 5)
+    assert rpn_cls.shape[1] == 2 * 6
+    assert rpn_box.shape[1] == 4 * 6
+
+
+def test_faster_rcnn_train_step():
+    """End-to-end: head losses backward + step run and stay finite, and
+    the ROI head learns on a fixed proposal set."""
+    rs = onp.random.RandomState(1)
+    net = faster_rcnn_toy(classes=3)
+    net.initialize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 1e-3})
+    sce = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    x = nd.array(rs.randn(1, 3, 64, 64).astype(onp.float32))
+    im_info = nd.array([[64, 64, 1.0]])
+    gt = nd.array(onp.array([[[4, 4, 40, 40, 1]]], onp.float32))
+    losses = []
+    for _ in range(5):
+        with ag.record():
+            cls_pred, box_pred, rois, rpn_cls, rpn_box = net(x, im_info)
+            r, labels, targets, weights = rcnn_training_targets(
+                rois, gt, num_classes=3, batch_rois=8)
+            mask = labels >= 0
+            safe_labels = nd.invoke("clip", labels, a_min=0.0,
+                                    a_max=1e9)
+            cls_loss = sce(cls_pred[:8], safe_labels) * mask
+            box_l = nd.invoke("smooth_l1",
+                              (box_pred[:8] - targets) * weights,
+                              scalar=1.0).sum(axis=1)
+            loss = cls_loss.mean() + 0.1 * box_l.mean()
+            loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert all(onp.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_proposal_target_gt_appended_guarantees_fg():
+    """Even when NO roi overlaps gt (untrained RPN), the gt boxes
+    themselves are candidates — fg samples always exist (ref:
+    proposal_target.cc appends gt to the roi set)."""
+    rois = nd.array(onp.array([[0, 50, 50, 60, 60],
+                               [0, 0, 0, 3, 3]], onp.float32))
+    gt = nd.array(onp.array([[[5, 5, 30, 30, 2]]], onp.float32))
+    r, labels, targets, weights = nd.invoke(
+        "_contrib_ProposalTarget", rois, gt, num_classes=4,
+        batch_images=1, batch_rois=4, fg_fraction=0.25, fg_overlap=0.5)
+    ln = labels.asnumpy()
+    assert (ln == 3).sum() == 1          # the gt box itself, class 2+1
+    fg_row = int(onp.argmax(ln == 3))
+    assert r.asnumpy()[fg_row, 1:].tolist() == [5, 5, 30, 30]
