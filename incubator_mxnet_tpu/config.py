@@ -38,8 +38,17 @@ class _Var:
         self.choices = choices
 
 
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off")
+
+
 def _to_bool(s):
-    return str(s).lower() in ("1", "true", "yes", "on")
+    v = str(s).lower()
+    if v in _TRUE:
+        return True
+    if v in _FALSE:
+        return False
+    raise ValueError("not a boolean: %r" % (s,))
 
 
 def register(name: str, type_: Callable = str, default: Any = None,
@@ -82,7 +91,9 @@ def get(name: str, default: Any = None):
     if var is None:
         return raw if raw is not None else default
     if raw is None:
-        return var.default if default is None else default
+        # registered default wins over the argument (per the contract);
+        # the argument only backstops a registration without a default
+        return var.default if var.default is not None else default
     try:
         return _parse(var, raw)
     except (TypeError, ValueError) as e:
@@ -91,7 +102,7 @@ def get(name: str, default: Any = None):
             import warnings
             warnings.warn("ignoring invalid %s=%r (%s); using default %r"
                           % (name, raw, e, var.default))
-        return var.default if default is None else default
+        return var.default
 
 
 def set(name: str, value) -> None:     # noqa: A001 — parity naming

@@ -132,9 +132,12 @@ class TokenEmbedding:
                 idx.append(self._token_to_idx[t.lower()])
             else:
                 idx.append(0)
-        data = self._idx_to_vec.asnumpy()[idx]
-        out = _nd_array(data[0] if single else data)
-        return out
+        # gather ON DEVICE — a glove-sized table must not round-trip
+        # to host per lookup
+        from ...ndarray.ndarray import invoke
+        rows = invoke("take", self._idx_to_vec,
+                      _nd_array(idx, dtype="int32"), axis=0)
+        return rows[0] if single else rows
 
     def update_token_vectors(self, tokens, new_vectors):
         toks = [tokens] if isinstance(tokens, str) else tokens
@@ -142,12 +145,12 @@ class TokenEmbedding:
             else _np.asarray(new_vectors, _np.float32)
         if nv.ndim == 1:
             nv = nv[None, :]
-        table = self._idx_to_vec.asnumpy().copy()   # device view is RO
-        for t, v in zip(toks, nv):
+        for t in toks:
             if t not in self._token_to_idx:
                 raise ValueError("token %r not indexed" % t)
-            table[self._token_to_idx[t]] = v
-        self._idx_to_vec = _nd_array(table)
+        # on-device scatter (functional .at update), no full-table copy
+        for t, v in zip(toks, nv):
+            self._idx_to_vec[self._token_to_idx[t]] = v
 
 
 @register

@@ -71,14 +71,23 @@ class FasterRCNN(HybridBlock):
         self.cls_head = nn.Dense(classes + 1)
         self.box_head = nn.Dense(4 * (classes + 1))
 
-    def forward(self, x, im_info):
+    def forward(self, x, im_info, gt_boxes=None, batch_rois=None,
+                num_classes=None):
+        """Inference: forward(x, im_info) →
+            (cls_pred, box_pred, rois, rpn_cls, rpn_box)
+        over all rpn_post_nms_top_n proposals.
+
+        Training: forward(x, im_info, gt_boxes) runs ProposalTarget
+        BETWEEN proposal and ROIAlign (like the reference's train graph)
+        so head predictions align row-for-row with the sampled rois →
+            (cls_pred, box_pred, rois, labels, bbox_targets,
+             bbox_weights, rpn_cls, rpn_box)."""
         from .. import ndarray as F
         feat = self.features(x)
         rpn = self.rpn_conv(feat)
         rpn_cls = self.rpn_cls(rpn)                  # (B, 2A, H, W)
         rpn_box = self.rpn_box(rpn)                  # (B, 4A, H, W)
         B, twoA = rpn_cls.shape[0], rpn_cls.shape[1]
-        A = twoA // 2
         # softmax over {bg, fg} per anchor
         sig = F.reshape(rpn_cls, (B, 2, -1))
         prob = F.softmax(sig, axis=1)
@@ -88,12 +97,27 @@ class FasterRCNN(HybridBlock):
             rpn_pre_nms_top_n=self._pre, rpn_post_nms_top_n=self._post,
             rpn_min_size=self._min_size, scales=self._scales,
             ratios=self._ratios, feature_stride=self._stride)
+
+        target = None
+        if gt_boxes is not None:
+            target = F.invoke(
+                "_contrib_ProposalTarget", rois, gt_boxes,
+                num_classes=(num_classes or self._classes) + 1,
+                batch_images=B,
+                batch_rois=batch_rois or self._post)
+            rois = target[0]                 # sampled + reordered
+
         pooled = F.invoke("ROIAlign", feat, rois,
                           pooled_size=(self._roi, self._roi),
                           spatial_scale=1.0 / self._stride)
         top = self.top(F.reshape(pooled, (pooled.shape[0], -1)))
-        return (self.cls_head(top), self.box_head(top), rois,
-                rpn_cls, rpn_box)
+        cls_pred = self.cls_head(top)
+        box_pred = self.box_head(top)
+        if target is not None:
+            _, labels, bbox_targets, bbox_weights = target
+            return (cls_pred, box_pred, rois, labels, bbox_targets,
+                    bbox_weights, rpn_cls, rpn_box)
+        return cls_pred, box_pred, rois, rpn_cls, rpn_box
 
 
 def rcnn_training_targets(rois, gt_boxes, num_classes,

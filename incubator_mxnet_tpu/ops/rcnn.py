@@ -133,8 +133,11 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
                                 rpn_post_nms_top_n)
         sorted_boxes = top_boxes[order]
         sorted_scores = top_scores[order]
-        ok = keep >= 0
         sel = jnp.clip(keep, 0, k - 1)
+        # min-size-filtered anchors carry the -1e10 sentinel score; when
+        # fewer valid proposals survive than post_nms_top_n they must
+        # become -1 padding, not leak as real-looking boxes
+        ok = (keep >= 0) & (sorted_scores[sel] > -1e9)
         boxes_out = jnp.where(ok[:, None], sorted_boxes[sel], -1.0)
         scores_out = jnp.where(ok, sorted_scores[sel], -1.0)
         return boxes_out, scores_out
@@ -163,11 +166,12 @@ def proposal_target(rois, gt_boxes, num_classes=21, batch_images=1,
 
     rois (R, 5), gt_boxes (N, G, 5) [x1,y1,x2,y2,cls].  Outputs:
     sampled rois (B, 5), labels (B,), bbox_targets (B, 4*num_classes),
-    bbox_weights (B, 4*num_classes) with B = batch_images*batch_rois.
-    Fixed-shape sampling: top fg_rois by overlap, rest background."""
+    bbox_weights (B, 4*num_classes) with B = batch_rois total
+    (batch_rois // batch_images samples per image, like the reference's
+    rois-per-image accounting).  Fixed-shape sampling: top fg_rois by
+    overlap, rest background."""
     N = gt_boxes.shape[0]
-    per_img = batch_rois // batch_images if batch_images > 1 else \
-        batch_rois
+    per_img = batch_rois // max(batch_images, 1)
     fg_per_img = int(round(per_img * fg_fraction))
 
     def per_image(i):

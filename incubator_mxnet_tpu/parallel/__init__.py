@@ -8,8 +8,11 @@ from .mesh import make_mesh, Mesh, NamedSharding, P, replicated, \
 from .functional import functionalize, extract_params, load_params
 from .trainer import (ShardedTrainer, softmax_ce_loss, sgd_momentum_tree,
                       adam_tree)
+from .ring_attention import (ring_attention, ulysses_attention,
+                             local_attention)
 
 __all__ = ["make_mesh", "Mesh", "NamedSharding", "P", "replicated",
            "batch_sharded", "default_dp_mesh", "functionalize",
            "extract_params", "load_params", "ShardedTrainer",
-           "softmax_ce_loss", "sgd_momentum_tree", "adam_tree"]
+           "softmax_ce_loss", "sgd_momentum_tree", "adam_tree",
+           "ring_attention", "ulysses_attention", "local_attention"]

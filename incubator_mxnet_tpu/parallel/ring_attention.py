@@ -1,0 +1,142 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has NO sequence/context parallelism (SURVEY §2.3 marks
+TP/PP/SP/CP "ABSENT in MXNet" — long sequences were handled only by
+bucketing).  This module is the TPU-first extension the survey calls
+for: attention over sequences sharded across the mesh, so context
+length scales with the number of chips.
+
+Two standard schemes, both pure collectives-over-ICI:
+
+- **ring_attention** (Liu et al., Ring Attention with Blockwise
+  Transformers): K/V blocks rotate around the ring via `lax.ppermute`
+  while each device's Q stays put; partial attention is merged with the
+  flash-attention online-softmax recurrence, so the full T×T score
+  matrix never materializes on any chip.  Memory per chip: O(T_local²),
+  compute overlapped with the rotation by XLA's latency-hiding
+  scheduler.
+- **ulysses_attention** (DeepSpeed-Ulysses): `lax.all_to_all` reshards
+  sequence-sharding → head-sharding, runs ordinary local attention on
+  full sequences for H/n heads, then reshards back.  Cheaper collectives
+  for moderate T when H divides the axis.
+
+Both are written against `shard_map` body semantics: call them INSIDE a
+`shard_map`/`pjit` region with `axis_name` bound to the mesh axis the
+sequence is sharded over (see tests/python/unittest/test_ring_attention.py
+and __graft_entry__.dryrun_multichip for the wiring)."""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention", "ulysses_attention", "local_attention"]
+
+
+def local_attention(q, k, v, *, causal=False, q_offset=0, k_offset=0,
+                    scale=None):
+    """Plain blockwise attention on local tensors.
+
+    q: (B, Tq, H, D), k/v: (B, Tk, H, D).  q_offset/k_offset are the
+    GLOBAL positions of element 0 (for causal masking across shards).
+    Returns (out_unnormalized, running_max (B,Tq,H), denom (B,Tq,H)) so
+    callers can merge partial results with the online-softmax rule."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bqhk", q, k) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]          # (Tq, Tk)
+        s = jnp.where(mask[None, :, None, :], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                            # (B, Tq, H)
+    # fully-masked rows (causal, early shards): keep exp well-defined
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isneginf(s), 0.0, p)
+    l = jnp.sum(p, axis=-1)                            # (B, Tq, H)
+    o = jnp.einsum("bqhk,bkhd->bqhd", p, v)            # unnormalized
+    return o, m_safe, l
+
+
+def _merge(o1, m1, l1, o2, m2, l2):
+    """Online-softmax merge of two partial attention results."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    o = o1 * a1[..., None] + o2 * a2[..., None]
+    l = l1 * a1 + l2 * a2
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name, *, causal=False, scale=None):
+    """Ring attention over a sequence-sharded axis.
+
+    Call inside shard_map. q/k/v: (B, T_local, H, D), the global
+    sequence being the concatenation over `axis_name` in axis-index
+    order. Returns the exact softmax attention output (B, T_local, H, D)
+    for this shard — numerically identical to full attention on the
+    gathered sequence."""
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    q_off = idx * t_local
+
+    o = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full(q.shape[:3], -jnp.inf, jnp.float32)
+    l = jnp.zeros(q.shape[:3], jnp.float32)
+    kc, vc = k, v
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(n):
+        # after `step` rotations device idx holds chunk (idx - step) % n
+        src = (idx - step) % n
+        k_off = src * t_local
+        oi, mi, li = local_attention(
+            q.astype(jnp.float32), kc.astype(jnp.float32),
+            vc.astype(jnp.float32), causal=causal,
+            q_offset=q_off, k_offset=k_off, scale=scale)
+        # first merge: m is -inf → exp(-inf - mi) handled by where
+        mm = jnp.maximum(m, mi)
+        a_prev = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - mm))
+        a_new = jnp.exp(mi - mm)
+        o = o * a_prev[..., None] + oi * a_new[..., None]
+        l = l * a_prev + li * a_new
+        m = mm
+        if step != n - 1:
+            kc = lax.ppermute(kc, axis_name, perm)
+            vc = lax.ppermute(vc, axis_name, perm)
+
+    denom = jnp.maximum(l, 1e-20)[..., None]
+    return (o / denom).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, *, causal=False, scale=None):
+    """DeepSpeed-Ulysses sequence parallelism.
+
+    Inside shard_map with q/k/v (B, T_local, H, D), H divisible by the
+    axis size: all_to_all to (B, T_global, H/n, D), local full-sequence
+    attention, all_to_all back."""
+    n = lax.psum(1, axis_name)
+    # (B, T_l, H, D) -> heads split across devices, sequence gathered
+    def seq_to_head(x):
+        # split heads into n groups along axis 2, exchange with the
+        # sequence dimension
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def head_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg = seq_to_head(q)          # (B, T_global, H/n, D)
+    kg = seq_to_head(k)
+    vg = seq_to_head(v)
+    o, mx_, l = local_attention(qg.astype(jnp.float32),
+                                kg.astype(jnp.float32),
+                                vg.astype(jnp.float32),
+                                causal=causal, scale=scale)
+    out = o / jnp.maximum(l, 1e-20)[..., None]
+    return head_to_seq(out.astype(q.dtype))

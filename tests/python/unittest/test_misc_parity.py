@@ -133,3 +133,35 @@ def test_summary_on_hybridized_block():
     # hybridized fast path restored afterwards
     assert net._active
     assert isinstance(net(nd.ones((2, 8))), mx.nd.NDArray)
+
+
+def test_trainer_horovod_slot_custom_reducer():
+    """The Horovod integration slot (ref: hvd.DistributedTrainer
+    subclasses Trainer, overrides allreduce_grads with its own
+    collective, kvstore=None): a custom reducer's output must be what
+    update() consumes."""
+    calls = []
+
+    class DistributedTrainer(mx.gluon.Trainer):
+        def allreduce_grads(self):
+            # stand-in for hvd.allreduce_: scale grads by 1/world
+            calls.append(1)
+            for p in self._params:
+                if p.grad_req != "null" and p._data is not None:
+                    for g in p.list_grad():
+                        g._data = g._data * 0.5
+
+    net = mx.gluon.nn.Dense(2, in_units=2, use_bias=False)
+    net.initialize()
+    net.weight.set_data(nd.zeros((2, 2)))
+    trainer = DistributedTrainer(net.collect_params(), "sgd",
+                                 {"learning_rate": 1.0}, kvstore=None)
+    x = nd.ones((1, 2))
+    with ag.record():
+        loss = net(x).sum()
+        loss.backward()
+    # raw grad d(sum(Wx))/dW = ones; reducer halves it; lr 1, batch 1
+    trainer.step(1)
+    assert calls, "custom allreduce_grads was not invoked by step()"
+    w = net.weight.data().asnumpy()
+    assert onp.allclose(w, -0.5), w

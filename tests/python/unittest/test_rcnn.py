@@ -117,8 +117,10 @@ def test_faster_rcnn_forward_shapes():
 
 
 def test_faster_rcnn_train_step():
-    """End-to-end: head losses backward + step run and stay finite, and
-    the ROI head learns on a fixed proposal set."""
+    """End-to-end training forward: ProposalTarget runs between
+    proposal and ROIAlign (as in the reference train graph), so head
+    predictions are row-aligned with the sampled rois' labels/targets;
+    losses backward + step stay finite and decrease."""
     rs = onp.random.RandomState(1)
     net = faster_rcnn_toy(classes=3)
     net.initialize()
@@ -131,15 +133,16 @@ def test_faster_rcnn_train_step():
     losses = []
     for _ in range(5):
         with ag.record():
-            cls_pred, box_pred, rois, rpn_cls, rpn_box = net(x, im_info)
-            r, labels, targets, weights = rcnn_training_targets(
-                rois, gt, num_classes=3, batch_rois=8)
+            (cls_pred, box_pred, rois, labels, targets, weights,
+             rpn_cls, rpn_box) = net(x, im_info, gt_boxes=gt,
+                                     batch_rois=8)
+            assert cls_pred.shape[0] == rois.shape[0] == 8
             mask = labels >= 0
             safe_labels = nd.invoke("clip", labels, a_min=0.0,
                                     a_max=1e9)
-            cls_loss = sce(cls_pred[:8], safe_labels) * mask
+            cls_loss = sce(cls_pred, safe_labels) * mask
             box_l = nd.invoke("smooth_l1",
-                              (box_pred[:8] - targets) * weights,
+                              (box_pred - targets) * weights,
                               scalar=1.0).sum(axis=1)
             loss = cls_loss.mean() + 0.1 * box_l.mean()
             loss.backward()
@@ -147,6 +150,20 @@ def test_faster_rcnn_train_step():
         losses.append(float(loss.asnumpy()))
     assert all(onp.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_faster_rcnn_train_forward_has_fg_rows():
+    """The gt-append guarantee flows through the train forward: at
+    least one sampled row carries a positive class label."""
+    rs = onp.random.RandomState(2)
+    net = faster_rcnn_toy(classes=3)
+    net.initialize()
+    x = nd.array(rs.randn(1, 3, 64, 64).astype(onp.float32))
+    im_info = nd.array([[64, 64, 1.0]])
+    gt = nd.array(onp.array([[[10, 10, 30, 30, 2]]], onp.float32))
+    out = net(x, im_info, gt_boxes=gt, batch_rois=8)
+    labels = out[3].asnumpy()
+    assert (labels == 3).sum() >= 1        # class 2 → label 3
 
 
 def test_proposal_target_gt_appended_guarantees_fg():

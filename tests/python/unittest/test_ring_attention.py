@@ -1,0 +1,126 @@
+"""Sequence/context parallelism tests: ring attention and Ulysses
+all-to-all vs single-device full attention, on the virtual 8-device
+CPU mesh (the multi-chip stand-in, see conftest.py)."""
+import functools
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+try:
+    from jax import shard_map            # jax >= 0.6 location
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+from incubator_mxnet_tpu.parallel import (ring_attention,
+                                          ulysses_attention,
+                                          local_attention)
+
+
+def _full_attention(q, k, v, causal=False):
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bqhk", q, k) * scale
+    if causal:
+        T = q.shape[1]
+        mask = onp.tril(onp.ones((T, T), bool))
+        s = jnp.where(jnp.asarray(mask)[None, :, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqhk,bkhd->bqhd", p, v)
+
+
+def _mesh(n=8):
+    devs = jax.devices()[:n]
+    return Mesh(onp.array(devs).reshape(n), ("sp",))
+
+
+def _make_qkv(B=2, T=64, H=8, D=16, seed=0):
+    rs = onp.random.RandomState(seed)
+    q = rs.randn(B, T, H, D).astype(onp.float32)
+    k = rs.randn(B, T, H, D).astype(onp.float32)
+    v = rs.randn(B, T, H, D).astype(onp.float32)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    q, k, v = _make_qkv()
+    want = _full_attention(q, k, v, causal=causal)
+    mesh = _mesh()
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name="sp", causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"))
+    got = jax.jit(fn)(q, k, v)
+    assert onp.allclose(onp.asarray(got), onp.asarray(want),
+                        rtol=2e-4, atol=2e-5), \
+        onp.abs(onp.asarray(got) - onp.asarray(want)).max()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(causal):
+    q, k, v = _make_qkv()
+    want = _full_attention(q, k, v, causal=causal)
+    mesh = _mesh()
+    fn = shard_map(
+        functools.partial(ulysses_attention, axis_name="sp",
+                          causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"))
+    got = jax.jit(fn)(q, k, v)
+    assert onp.allclose(onp.asarray(got), onp.asarray(want),
+                        rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_grads_match_full():
+    q, k, v = _make_qkv(B=1, T=32, H=4, D=8)
+    mesh = _mesh()
+
+    def loss_ring(q, k, v):
+        fn = shard_map(
+            functools.partial(ring_attention, axis_name="sp",
+                              causal=True),
+            mesh=mesh,
+            in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+            out_specs=P(None, "sp"))
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.sum(_full_attention(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf in zip(g_ring, g_full):
+        assert onp.allclose(onp.asarray(gr), onp.asarray(gf),
+                            rtol=1e-3, atol=1e-4)
+
+
+def test_ring_attention_bf16_inputs():
+    q, k, v = _make_qkv(B=1, T=32, H=4, D=8)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    mesh = _mesh()
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name="sp"),
+        mesh=mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"))
+    got = jax.jit(fn)(qb, kb, vb)
+    assert got.dtype == jnp.bfloat16
+    want = _full_attention(q, k, v)
+    assert onp.allclose(onp.asarray(got, dtype=onp.float32),
+                        onp.asarray(want), rtol=0.1, atol=0.05)
+
+
+def test_local_attention_offsets():
+    """Causal masking with global offsets: a k-block entirely in the
+    future contributes nothing."""
+    q, k, v = _make_qkv(B=1, T=8, H=2, D=4)
+    o, m, l = local_attention(q, k, v, causal=True, q_offset=0,
+                              k_offset=100)
+    assert onp.allclose(onp.asarray(l), 0.0)
+    o2, m2, l2 = local_attention(q, k, v, causal=True, q_offset=100,
+                                 k_offset=0)
+    assert (onp.asarray(l2) > 0).all()
