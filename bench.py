@@ -151,6 +151,126 @@ def run_sharded(batch=256, warmup=3, iters=20):
     return batch * iters / (time.perf_counter() - t0)
 
 
+def run_ssd(batch=16, size=300, warmup=2, iters=8):
+    """Config 3a: SSD-300 training step, images/sec/chip (hybridize →
+    CachedOp → Trainer, MultiBoxTarget loss like example/ssd)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, autograd as ag
+    from incubator_mxnet_tpu.models import ssd_300, ssd_training_targets
+
+    ctx = mx.gpu()
+    net = ssd_300(classes=20)
+    net.initialize(ctx=ctx)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01, "momentum": 0.9})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.randn(batch, 3, size, size).astype(np.float32),
+                 ctx=ctx)
+    # one gt box per image: [cls, x1, y1, x2, y2] normalized
+    labels = np.zeros((batch, 1, 5), np.float32)
+    labels[:, 0] = [1, 0.2, 0.2, 0.7, 0.7]
+    y = nd.array(labels, ctx=ctx)
+
+    def step():
+        with ag.record():
+            anchors, cls_preds, box_preds = net(x)
+            loc_t, loc_m, cls_t = ssd_training_targets(anchors,
+                                                       cls_preds, y)
+            B, N = cls_t.shape
+            cls_l = sce(cls_preds.reshape((B * N, -1)),
+                        cls_t.reshape((-1,)))
+            box_l = (nd.smooth_l1(box_preds - loc_t) * loc_m).mean()
+            loss = cls_l.mean() + box_l
+            loss.backward()
+        trainer.step(batch)
+
+    for _ in range(warmup):
+        step()
+    nd.waitall()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        step()
+    nd.waitall()
+    return batch * iters / (time.perf_counter() - t0)
+
+
+def run_gnmt(batch=32, src_len=32, tgt_len=32, warmup=2, iters=8):
+    """Config 4: GNMT-style LSTM seq2seq training, target tokens/sec."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, autograd as ag
+    from incubator_mxnet_tpu.models import Seq2Seq
+
+    ctx = mx.gpu()
+    vocab = 4000
+    net = Seq2Seq(vocab, vocab, embed_dim=128, hidden=256, num_layers=2)
+    net.initialize(ctx=ctx)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    src = nd.array(rs.randint(0, vocab, (batch, src_len)), ctx=ctx,
+                   dtype="int32")
+    tgt = nd.array(rs.randint(0, vocab, (batch, tgt_len)), ctx=ctx,
+                   dtype="int32")
+    lab = nd.array(rs.randint(0, vocab, (batch, tgt_len)).astype(
+        np.float32), ctx=ctx)
+
+    def step():
+        with ag.record():
+            logits = net(src, tgt)
+            loss = sce(logits.reshape((-1, vocab)), lab.reshape((-1,)))
+            loss.backward()
+        trainer.step(batch)
+
+    for _ in range(warmup):
+        step()
+    nd.waitall()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        step()
+    nd.waitall()
+    return batch * tgt_len * iters / (time.perf_counter() - t0)
+
+
+def run_wide_deep(batch=2048, fields=16, warmup=2, iters=10):
+    """Config 5: Wide&Deep recommender with row_sparse embedding grads,
+    samples/sec."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, autograd as ag
+    from incubator_mxnet_tpu.models import wide_deep
+
+    ctx = mx.gpu()
+    num_features = 100000
+    net = wide_deep(num_features=num_features, embed_dim=16)
+    net.initialize(ctx=ctx)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    idx = nd.array(rs.randint(0, num_features, (batch, fields)),
+                   ctx=ctx, dtype="int32")
+    vals = nd.array(rs.rand(batch, fields).astype(np.float32), ctx=ctx)
+    y = nd.array(rs.randint(0, 2, batch).astype(np.float32), ctx=ctx)
+
+    def step():
+        with ag.record():
+            loss = sce(net(idx, vals), y)
+            loss.backward()
+        trainer.step(batch)
+
+    for _ in range(warmup):
+        step()
+    nd.waitall()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        step()
+    nd.waitall()
+    return batch * iters / (time.perf_counter() - t0)
+
+
 def run_io(batch=128, n_images=1024):
     """Input-pipeline throughput: native C++ RecordIO+JPEG pipeline
     (src/io/recordio_pipeline.cc), images/sec/host-core — SURVEY §2.4
@@ -230,6 +350,16 @@ def main():
                       "io_host_cores": os.cpu_count()})
     except Exception as e:
         extra["io_error"] = str(e)[:120]
+    for key, fn, batches in (
+            ("ssd300_train_images_per_sec", run_ssd, (16, 8)),
+            ("gnmt_train_tokens_per_sec", run_gnmt, (32, 16)),
+            ("wide_deep_train_samples_per_sec", run_wide_deep,
+             (2048, 512))):
+        try:
+            val, b = _try_batches(fn, batches)
+            extra[key] = round(val, 2)
+        except Exception as e:
+            extra[key + "_error"] = str(e)[:120]
     print(json.dumps({
         "metric": "resnet50_v1b_train_images_per_sec_per_chip",
         "value": round(imgs, 2),

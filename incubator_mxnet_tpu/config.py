@@ -97,12 +97,13 @@ def get(name: str, default: Any = None):
     try:
         return _parse(var, raw)
     except (TypeError, ValueError) as e:
+        fallback = var.default if var.default is not None else default
         if name not in _warned:
             _warned.add(name)
             import warnings
             warnings.warn("ignoring invalid %s=%r (%s); using default %r"
-                          % (name, raw, e, var.default))
-        return var.default
+                          % (name, raw, e, fallback))
+        return fallback
 
 
 def set(name: str, value) -> None:     # noqa: A001 — parity naming

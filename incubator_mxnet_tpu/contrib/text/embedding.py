@@ -148,9 +148,11 @@ class TokenEmbedding:
         for t in toks:
             if t not in self._token_to_idx:
                 raise ValueError("token %r not indexed" % t)
-        # on-device scatter (functional .at update), no full-table copy
-        for t, v in zip(toks, nv):
-            self._idx_to_vec[self._token_to_idx[t]] = v
+        # ONE batched on-device scatter (per-token .at sets would copy
+        # the whole table once per token)
+        idx = _nd_array([self._token_to_idx[t] for t in toks],
+                        dtype="int32")
+        self._idx_to_vec[idx] = nv
 
 
 @register

@@ -54,7 +54,8 @@ def box_iou(lhs, rhs, format="corner"):
     return out.reshape(lhs.shape[:-1] + rhs.shape[:-1])
 
 
-@register("box_nms", ndarray_inputs=("data",), differentiable=False)
+@register("box_nms", ndarray_inputs=("data",), differentiable=False,
+          jit=True)
 def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
             coord_start=2, score_index=1, id_index=-1,
             background_id=-1, force_suppress=False, in_format="corner",
@@ -226,6 +227,7 @@ def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
 
 
 @register("MultiBoxTarget", ndarray_inputs=("anchor", "label", "cls_pred"),
+          jit=True,
           differentiable=False, num_outputs=3)
 def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
                     ignore_label=-1.0, negative_mining_ratio=-1.0,

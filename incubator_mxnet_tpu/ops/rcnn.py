@@ -84,7 +84,7 @@ def _nms_keep(boxes, scores, thresh, topk):
 
 @register("_contrib_Proposal",
           ndarray_inputs=("cls_prob", "bbox_pred", "im_info"),
-          differentiable=False)
+          differentiable=False, jit=True)
 def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
@@ -158,7 +158,7 @@ alias("_contrib_Proposal", "_contrib_MultiProposal")
 
 @register("_contrib_ProposalTarget",
           ndarray_inputs=("rois", "gt_boxes"),
-          differentiable=False, num_outputs=4)
+          differentiable=False, num_outputs=4, jit=True)
 def proposal_target(rois, gt_boxes, num_classes=21, batch_images=1,
                     batch_rois=128, fg_fraction=0.25, fg_overlap=0.5,
                     box_stds=(0.1, 0.1, 0.2, 0.2)):

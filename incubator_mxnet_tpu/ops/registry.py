@@ -40,9 +40,12 @@ class OpDef:
                  visible_outputs: Optional[int] = None,
                  num_outputs_fn: Optional[Callable] = None,
                  needs_rng: bool = False,
-                 nograd_argnums: Sequence[int] = ()):
+                 nograd_argnums: Sequence[int] = (),
+                 jit: bool = False):
         import inspect
         self.name = name
+        if jit:
+            fn = _jit_composite(fn, ndarray_inputs)
         self.fn = fn
         self.ndarray_inputs = tuple(ndarray_inputs) if ndarray_inputs else None
         self.differentiable = differentiable
@@ -72,6 +75,55 @@ class OpDef:
 
     def __repr__(self):
         return "OpDef(%s)" % self.name
+
+
+def _jit_composite(fn, ndarray_inputs):
+    """Wrap a COMPOSITE op in jax.jit, attrs static.
+
+    Imperative dispatch is eager by design (one primitive ≈ one async
+    PJRT program — the engine role, SURVEY §7.0).  That breaks down for
+    multi-primitive composite ops (MultiBoxTarget, Proposal, NMS, …):
+    eagerly each of their dozens of primitives pays the chip's fixed
+    per-program cost.  `jit=True` compiles the whole op to ONE program,
+    cached by input shapes + attr values (the FCompute-kernel analogue
+    for composites).  Tensor args may be passed as None (optional
+    inputs); each None/non-None pattern is part of the cache key via a
+    wrapper split."""
+    import functools
+    import jax
+
+    cache = {}
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        arr_pos = tuple(i for i, a in enumerate(args)
+                        if isinstance(a, jax.Array))
+        # cache key: arr positions + every static (non-array) arg/attr;
+        # lists normalized to tuples.  Unhashable statics → eager.
+        akey = [(k, tuple(v) if isinstance(v, list) else v)
+                for k, v in sorted(kwargs.items())]
+        skey = [(i, args[i]) for i in range(len(args))
+                if i not in arr_pos]
+        key = (arr_pos, tuple(skey), tuple(akey))
+        try:
+            cached = cache.get(key)
+        except TypeError:           # unhashable static arg
+            return fn(*args, **kwargs)
+        if cached is None:
+            # placeholders at array positions: capturing the first
+            # call's device buffers in the closure would pin them in
+            # HBM for the cache's lifetime
+            template = [None if i in arr_pos else a
+                        for i, a in enumerate(args)]
+
+            def call(arrs):
+                full = list(template)
+                for p, a in zip(arr_pos, arrs):
+                    full[p] = a
+                return fn(*full, **kwargs)
+            cached = cache[key] = jax.jit(call)
+        return cached([args[i] for i in arr_pos])
+    return wrapped
 
 
 _REGISTRY: Dict[str, OpDef] = {}

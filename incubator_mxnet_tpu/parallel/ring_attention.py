@@ -61,16 +61,6 @@ def local_attention(q, k, v, *, causal=False, q_offset=0, k_offset=0,
     return o, m_safe, l
 
 
-def _merge(o1, m1, l1, o2, m2, l2):
-    """Online-softmax merge of two partial attention results."""
-    m = jnp.maximum(m1, m2)
-    a1 = jnp.exp(m1 - m)
-    a2 = jnp.exp(m2 - m)
-    o = o1 * a1[..., None] + o2 * a2[..., None]
-    l = l1 * a1 + l2 * a2
-    return o, m, l
-
-
 def ring_attention(q, k, v, axis_name, *, causal=False, scale=None):
     """Ring attention over a sequence-sharded axis.
 
