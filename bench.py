@@ -358,6 +358,7 @@ def main():
         try:
             val, b = _try_batches(fn, batches)
             extra[key] = round(val, 2)
+            extra[key + "_batch"] = b
         except Exception as e:
             extra[key + "_error"] = str(e)[:120]
     print(json.dumps({

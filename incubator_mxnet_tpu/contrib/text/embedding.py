@@ -149,10 +149,13 @@ class TokenEmbedding:
             if t not in self._token_to_idx:
                 raise ValueError("token %r not indexed" % t)
         # ONE batched on-device scatter (per-token .at sets would copy
-        # the whole table once per token)
-        idx = _nd_array([self._token_to_idx[t] for t in toks],
-                        dtype="int32")
-        self._idx_to_vec[idx] = nv
+        # the whole table once per token); dedupe host-side so repeated
+        # tokens keep deterministic last-wins semantics (scatter order
+        # for duplicate indices is undefined in XLA)
+        last = {self._token_to_idx[t]: v for t, v in zip(toks, nv)}
+        idx = _nd_array(list(last.keys()), dtype="int32")
+        vals = _np.stack(list(last.values()))
+        self._idx_to_vec[idx] = vals
 
 
 @register

@@ -193,7 +193,8 @@ def bipartite_matching(data, threshold=0.5, is_ascend=False, topk=-1):
 # ---------------------------------------------------------------------------
 
 
-@register("MultiBoxPrior", ndarray_inputs=("data",), differentiable=False)
+@register("MultiBoxPrior", ndarray_inputs=("data",), differentiable=False,
+          jit=True)
 def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
     """ref: multibox_prior.cc — anchors for one feature map (1, H*W*A, 4)."""
@@ -280,7 +281,7 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
 
 @register("MultiBoxDetection", ndarray_inputs=("cls_prob", "loc_pred",
                                                "anchor"),
-          differentiable=False)
+          differentiable=False, jit=True)
 def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
                        threshold=0.01, background_id=0, nms_threshold=0.5,
                        force_suppress=False, variances=(0.1, 0.1, 0.2, 0.2),
@@ -321,7 +322,8 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
 # ---------------------------------------------------------------------------
 
 
-@register("ROIAlign", ndarray_inputs=("data", "rois"), nograd_argnums=(1,))
+@register("ROIAlign", ndarray_inputs=("data", "rois"), nograd_argnums=(1,),
+          jit=True)
 def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
               sample_ratio=2, position_sensitive=False, aligned=False):
     """ref: contrib/roi_align.cc — bilinear-sampled ROI pooling.
@@ -376,7 +378,8 @@ def _bilinear_sample(img, gy, gx):
     return jnp.where(inb, out, 0.0)
 
 
-@register("ROIPooling", ndarray_inputs=("data", "rois"), nograd_argnums=(1,))
+@register("ROIPooling", ndarray_inputs=("data", "rois"), nograd_argnums=(1,),
+          jit=True)
 def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
     """ref: src/operator/roi_pooling.cc — quantised max pooling."""
     PH, PW = pooled_size
@@ -413,7 +416,7 @@ def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
 # ---------------------------------------------------------------------------
 
 
-@register("BilinearResize2D", ndarray_inputs=("data",))
+@register("BilinearResize2D", ndarray_inputs=("data",), jit=True)
 def bilinear_resize_2d(data, height=0, width=0, scale_height=None,
                        scale_width=None, mode="size",
                        align_corners=True):

@@ -39,7 +39,7 @@ def _scale_of(mn, mx, out_type):
 
 @register("_contrib_quantize", ndarray_inputs=("data", "min_range",
                                                "max_range"),
-          differentiable=False, num_outputs=3)
+          differentiable=False, num_outputs=3, jit=True)
 def quantize(data, min_range, max_range, out_type="uint8"):
     """ref: quantize.cc — float → int8/uint8 given a range."""
     mn = jnp.min(min_range)
@@ -56,7 +56,7 @@ def quantize(data, min_range, max_range, out_type="uint8"):
 
 
 @register("_contrib_quantize_v2", ndarray_inputs=("data",),
-          differentiable=False, num_outputs=3)
+          differentiable=False, num_outputs=3, jit=True)
 def quantize_v2(data, out_type="int8", min_calib_range=None,
                 max_calib_range=None):
     """ref: quantize_v2.cc — range from calibration attrs, or from the
@@ -72,7 +72,7 @@ def quantize_v2(data, out_type="int8", min_calib_range=None,
 
 @register("_contrib_dequantize", ndarray_inputs=("data", "min_range",
                                                  "max_range"),
-          differentiable=False)
+          differentiable=False, jit=True)
 def dequantize(data, min_range, max_range, out_type="float32"):
     """ref: dequantize.cc — int8/int32/uint8 → float."""
     mn = jnp.min(min_range)
@@ -87,7 +87,7 @@ def dequantize(data, min_range, max_range, out_type="float32"):
 
 @register("_contrib_requantize", ndarray_inputs=("data", "min_range",
                                                  "max_range"),
-          differentiable=False, num_outputs=3)
+          differentiable=False, num_outputs=3, jit=True)
 def requantize(data, min_range, max_range, min_calib_range=None,
                max_calib_range=None, out_type="int8"):
     """ref: requantize.cc — int32 accumulator → int8 with a (calibrated)
@@ -115,7 +115,7 @@ def _int32_out_range(min_d, max_d, min_w, max_w):
           ndarray_inputs=("data", "weight", "bias", "min_data", "max_data",
                           "min_weight", "max_weight", "min_bias",
                           "max_bias"),
-          differentiable=False, num_outputs=3)
+          differentiable=False, num_outputs=3, jit=True)
 def quantized_fully_connected(data, weight, bias, min_data, max_data,
                               min_weight, max_weight, min_bias, max_bias,
                               num_hidden=None, no_bias=False,
@@ -153,7 +153,7 @@ def quantized_fully_connected(data, weight, bias, min_data, max_data,
           ndarray_inputs=("data", "weight", "bias", "min_data", "max_data",
                           "min_weight", "max_weight", "min_bias",
                           "max_bias"),
-          differentiable=False, num_outputs=3)
+          differentiable=False, num_outputs=3, jit=True)
 def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
                    max_weight, min_bias, max_bias, kernel=None,
                    stride=(1, 1), pad=(0, 0), dilate=(1, 1),
@@ -193,7 +193,7 @@ def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
 
 @register("_contrib_quantized_pooling",
           ndarray_inputs=("data", "min_data", "max_data"),
-          differentiable=False, num_outputs=3)
+          differentiable=False, num_outputs=3, jit=True)
 def quantized_pooling(data, min_data, max_data, kernel=(2, 2),
                       pool_type="max", stride=None, pad=(0, 0),
                       global_pool=False, **_):
@@ -228,7 +228,7 @@ def quantized_pooling(data, min_data, max_data, kernel=(2, 2),
 
 @register("_contrib_quantized_flatten",
           ndarray_inputs=("data", "min_data", "max_data"),
-          differentiable=False, num_outputs=3)
+          differentiable=False, num_outputs=3, jit=True)
 def quantized_flatten(data, min_data, max_data):
     return (data.reshape(data.shape[0], -1), jnp.min(min_data),
             jnp.max(max_data))
@@ -236,7 +236,7 @@ def quantized_flatten(data, min_data, max_data):
 
 @register("_contrib_quantized_act",
           ndarray_inputs=("data", "min_data", "max_data"),
-          differentiable=False, num_outputs=3)
+          differentiable=False, num_outputs=3, jit=True)
 def quantized_act(data, min_data, max_data, act_type="relu"):
     """ref: quantized_activation.cc — relu on int8 keeps the scale."""
     if act_type != "relu":
@@ -247,7 +247,7 @@ def quantized_act(data, min_data, max_data, act_type="relu"):
 @register("_contrib_quantized_elemwise_add",
           ndarray_inputs=("lhs", "rhs", "min_lhs", "max_lhs", "min_rhs",
                           "max_rhs"),
-          differentiable=False, num_outputs=3)
+          differentiable=False, num_outputs=3, jit=True)
 def quantized_elemwise_add(lhs, rhs, min_lhs, max_lhs, min_rhs, max_rhs):
     """ref: quantized_elemwise_add.cc — align scales into int32."""
     s_l = _max_abs(jnp.min(min_lhs), jnp.max(max_lhs)) / INT8_Q

@@ -45,7 +45,7 @@ class OpDef:
         import inspect
         self.name = name
         if jit:
-            fn = _jit_composite(fn, ndarray_inputs)
+            fn = _jit_composite(fn)
         self.fn = fn
         self.ndarray_inputs = tuple(ndarray_inputs) if ndarray_inputs else None
         self.differentiable = differentiable
@@ -77,7 +77,7 @@ class OpDef:
         return "OpDef(%s)" % self.name
 
 
-def _jit_composite(fn, ndarray_inputs):
+def _jit_composite(fn):
     """Wrap a COMPOSITE op in jax.jit, attrs static.
 
     Imperative dispatch is eager by design (one primitive ≈ one async
@@ -98,13 +98,18 @@ def _jit_composite(fn, ndarray_inputs):
     def wrapped(*args, **kwargs):
         arr_pos = tuple(i for i, a in enumerate(args)
                         if isinstance(a, jax.Array))
-        # cache key: arr positions + every static (non-array) arg/attr;
-        # lists normalized to tuples.  Unhashable statics → eager.
+        # array-valued kwargs (e.g. _rng_key) are traced args, the rest
+        # are static attrs in the cache key; lists normalized to tuples.
+        # Unhashable statics → eager.
+        arr_kw = {k: v for k, v in kwargs.items()
+                  if isinstance(v, jax.Array)}
+        static_kw = {k: v for k, v in kwargs.items() if k not in arr_kw}
         akey = [(k, tuple(v) if isinstance(v, list) else v)
-                for k, v in sorted(kwargs.items())]
-        skey = [(i, args[i]) for i in range(len(args))
-                if i not in arr_pos]
-        key = (arr_pos, tuple(skey), tuple(akey))
+                for k, v in sorted(static_kw.items())]
+        skey = [(i, tuple(args[i]) if isinstance(args[i], list)
+                 else args[i])
+                for i in range(len(args)) if i not in arr_pos]
+        key = (arr_pos, tuple(sorted(arr_kw)), tuple(skey), tuple(akey))
         try:
             cached = cache.get(key)
         except TypeError:           # unhashable static arg
@@ -116,13 +121,13 @@ def _jit_composite(fn, ndarray_inputs):
             template = [None if i in arr_pos else a
                         for i, a in enumerate(args)]
 
-            def call(arrs):
+            def call(arrs, akw):
                 full = list(template)
                 for p, a in zip(arr_pos, arrs):
                     full[p] = a
-                return fn(*full, **kwargs)
+                return fn(*full, **static_kw, **akw)
             cached = cache[key] = jax.jit(call)
-        return cached([args[i] for i in arr_pos])
+        return cached([args[i] for i in arr_pos], arr_kw)
     return wrapped
 
 

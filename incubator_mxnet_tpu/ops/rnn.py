@@ -148,7 +148,8 @@ def _rnn_num_outputs(attrs):
 
 
 @register("RNN", ndarray_inputs=("data", "parameters", "state", "state_cell"),
-          num_outputs=-1, num_outputs_fn=_rnn_num_outputs, needs_rng=True)
+          num_outputs=-1, num_outputs_fn=_rnn_num_outputs, needs_rng=True,
+          jit=True)
 def rnn(data, parameters, state, state_cell=None, state_size=0,
         num_layers=1, bidirectional=False, mode="lstm", p=0.0,
         state_outputs=True, projection_size=None, use_sequence_length=False,
