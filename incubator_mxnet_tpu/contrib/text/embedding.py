@@ -140,11 +140,18 @@ class TokenEmbedding:
         return rows[0] if single else rows
 
     def update_token_vectors(self, tokens, new_vectors):
-        toks = [tokens] if isinstance(tokens, str) else tokens
+        toks = [tokens] if isinstance(tokens, str) else list(tokens)
+        if not toks:
+            return
         nv = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
             else _np.asarray(new_vectors, _np.float32)
         if nv.ndim == 1:
             nv = nv[None, :]
+        if nv.shape[0] == 1 and len(toks) > 1:
+            nv = _np.broadcast_to(nv, (len(toks), nv.shape[1]))
+        if nv.shape[0] != len(toks):
+            raise ValueError("got %d vectors for %d tokens"
+                             % (nv.shape[0], len(toks)))
         for t in toks:
             if t not in self._token_to_idx:
                 raise ValueError("token %r not indexed" % t)

@@ -22,21 +22,97 @@ __all__ = ["MultiHeadAttention", "PositionwiseFFN",
 
 
 class MultiHeadAttention(HybridBlock):
-    def __init__(self, units, num_heads, dropout=0.0, **kwargs):
+    def __init__(self, units, num_heads, dropout=0.0, seq_parallel=None,
+                 **kwargs):
+        """seq_parallel: optional (mesh, axis_name) — run attention
+        ring-parallel over a sequence-sharded mesh axis
+        (parallel/ring_attention.py), so context length scales with the
+        number of chips on that axis."""
         super().__init__(**kwargs)
         assert units % num_heads == 0
         self._units = units
         self._num_heads = num_heads
+        self._seq_parallel = seq_parallel
+        self._ring_jit = {}          # home device -> jitted ring call
+        if seq_parallel is not None and dropout:
+            import warnings
+            warnings.warn(
+                "MultiHeadAttention(seq_parallel=...): attention-prob "
+                "dropout is not applied on the ring-attention path "
+                "(same contract as fused flash attention); residual/FFN "
+                "dropout still applies")
         self.query = nn.Dense(units, flatten=False, use_bias=True)
         self.key = nn.Dense(units, flatten=False, use_bias=True)
         self.value = nn.Dense(units, flatten=False, use_bias=True)
         self.proj = nn.Dense(units, flatten=False, use_bias=True)
         self.dropout = nn.Dropout(dropout) if dropout else None
 
+    def _get_ring_fn(self, home):
+        """Build (once per home device) the jitted resharding ring-
+        attention call — rebuilding the shard_map per forward would
+        retrace/recompile every step."""
+        if home in self._ring_jit:
+            return self._ring_jit[home]
+        import functools
+        import jax as _jax
+        from jax.sharding import (PartitionSpec as JP, NamedSharding,
+                                  SingleDeviceSharding)
+        try:
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+        from ..parallel import ring_attention
+        mesh, axis = self._seq_parallel
+        spec = JP(None, axis)
+        sh = NamedSharding(mesh, spec)
+        out_sh = SingleDeviceSharding(home)
+        ring = shard_map(
+            functools.partial(ring_attention, axis_name=axis),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+
+        jring = _jax.jit(ring)       # one cached executable per shape
+
+        def _ring(qj, kj, vj):
+            # reshard onto the sequence mesh, run the (cached) ring
+            # executable, come back to the caller's device — the rest of
+            # the model is single-device in imperative mode (under pjit
+            # the compiler owns layouts end-to-end).  The device hops
+            # stay OUTSIDE jit: a jitted computation cannot change
+            # device sets.
+            qj, kj, vj = (_jax.device_put(t, sh) for t in (qj, kj, vj))
+            return _jax.device_put(jring(qj, kj, vj), out_sh)
+        _ring.__name__ = "ring_attention"
+        self._ring_jit[home] = _ring
+        return _ring
+
+    def _ring_forward(self, x):
+        """Context-parallel path: q/k/v (B, T, H, d) sharded on T over
+        the mesh axis, ring attention inside shard_map."""
+        from ..ndarray.ndarray import apply_fn
+        H = self._num_heads
+        B, T, C = x.shape
+        d = C // H
+        q = self.query(x).reshape((B, T, H, d))
+        k = self.key(x).reshape((B, T, H, d))
+        v = self.value(x).reshape((B, T, H, d))
+        fn = self._get_ring_fn(x.context.jax_device)
+        ctx = apply_fn(fn, [q, k, v], {}, name="ring_attention")
+        return self.proj(ctx.reshape((B, T, C)))
+
     def forward(self, x, mask=None):
         from .. import ndarray as F
         from .. import autograd
         H = self._num_heads
+        from ..symbol.symbol import Symbol as _Sym
+        if self._seq_parallel is not None:
+            if mask is None and not isinstance(x, _Sym):
+                return self._ring_forward(x)
+            import warnings
+            warnings.warn(
+                "seq_parallel attention falls back to the single-device "
+                "path (%s): the ring path supports mask=None imperative "
+                "execution" % ("mask given" if mask is not None
+                               else "symbol trace"))
         # fused path: whole softmax(QK^T)V is one kernel (Pallas flash on
         # TPU, fused XLA elsewhere — ops/attention.py); the score matrix
         # never hits HBM.  Attention-prob dropout is only live while
@@ -84,9 +160,10 @@ class PositionwiseFFN(HybridBlock):
 
 class TransformerEncoderLayer(HybridBlock):
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
-                 **kwargs):
+                 seq_parallel=None, **kwargs):
         super().__init__(**kwargs)
-        self.attn = MultiHeadAttention(units, num_heads, dropout)
+        self.attn = MultiHeadAttention(units, num_heads, dropout,
+                                       seq_parallel=seq_parallel)
         self.ffn = PositionwiseFFN(units, hidden_size, dropout)
         self.ln1 = nn.LayerNorm(in_channels=units)
         self.ln2 = nn.LayerNorm(in_channels=units)
@@ -105,12 +182,13 @@ class TransformerEncoderLayer(HybridBlock):
 
 class TransformerEncoder(HybridBlock):
     def __init__(self, num_layers, units, hidden_size, num_heads,
-                 dropout=0.0, **kwargs):
+                 dropout=0.0, seq_parallel=None, **kwargs):
         super().__init__(**kwargs)
         self.layers = nn.HybridSequential()
         for _ in range(num_layers):
             self.layers.add(TransformerEncoderLayer(
-                units, hidden_size, num_heads, dropout))
+                units, hidden_size, num_heads, dropout,
+                seq_parallel=seq_parallel))
 
     def forward(self, x, mask=None):
         for layer in self.layers._children.values():
@@ -123,7 +201,7 @@ class BERTModel(HybridBlock):
 
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
-                 dropout=0.1, **kwargs):
+                 dropout=0.1, seq_parallel=None, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self.word_embed = nn.Embedding(vocab_size, units)
@@ -131,7 +209,8 @@ class BERTModel(HybridBlock):
         self.ln = nn.LayerNorm(in_channels=units)
         self.dropout = nn.Dropout(dropout) if dropout else None
         self.encoder = TransformerEncoder(num_layers, units, hidden_size,
-                                          num_heads, dropout)
+                                          num_heads, dropout,
+                                          seq_parallel=seq_parallel)
         self.mlm_dense = nn.Dense(units, flatten=False, activation=None)
         self.mlm_ln = nn.LayerNorm(in_channels=units)
         self.decoder = nn.Dense(vocab_size, flatten=False)
