@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Post-training INT8 quantization (ref: example/quantization).
+
+Calibrates a float model on a few batches (naive min/max or entropy/KL
+thresholds), swaps Dense/Conv2D for int8 MXU kernels, and compares
+accuracy + latency.
+
+    python examples/quantize_model.py [--calib-mode entropy]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon
+from incubator_mxnet_tpu.contrib import quantization as qz
+
+from train_cnn import make_synthetic, build_net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--calib-mode", default="naive",
+                    choices=["none", "naive", "entropy"])
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+
+    ctx = mx.gpu() if mx.num_gpus() else mx.cpu()
+    x, y = make_synthetic()
+    net = build_net(10)
+    net.initialize(ctx=ctx)
+    # (in a real flow: train or load_parameters here)
+
+    fp32_out = net(nd.array(x[:args.batch], ctx=ctx)).asnumpy()
+
+    calib = [nd.array(x[i * args.batch:(i + 1) * args.batch], ctx=ctx)
+             for i in range(4)]
+    qnet = qz.quantize_net(
+        net, calib_data=calib if args.calib_mode != "none" else None,
+        calib_mode=args.calib_mode)
+
+    xin = nd.array(x[:args.batch], ctx=ctx)
+    int8_out = qnet(xin).asnumpy()
+    rel = np.abs(int8_out - fp32_out).max() / np.abs(fp32_out).max()
+    agree = (int8_out.argmax(1) == fp32_out.argmax(1)).mean()
+    qnet(xin); nd.waitall()
+    t0 = time.perf_counter()
+    for _ in range(10):
+        qnet(xin)
+    nd.waitall()
+    ms = (time.perf_counter() - t0) / 10 * 1000
+    print("calib=%s  max rel err %.4f  argmax agreement %.3f  "
+          "%.1f ms/batch" % (args.calib_mode, rel, agree, ms))
+
+
+if __name__ == "__main__":
+    main()

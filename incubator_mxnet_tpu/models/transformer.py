@@ -91,6 +91,14 @@ class MultiHeadAttention(HybridBlock):
         from ..ndarray.ndarray import apply_fn
         H = self._num_heads
         B, T, C = x.shape
+        mesh, axis = self._seq_parallel
+        n_shards = mesh.shape[axis]
+        if T % n_shards:
+            raise ValueError(
+                "seq_parallel ring attention needs the sequence length "
+                "to divide evenly over the %r mesh axis: T=%d, shards=%d "
+                "(pad the sequence or change the mesh)"
+                % (axis, T, n_shards))
         d = C // H
         q = self.query(x).reshape((B, T, H, d))
         k = self.key(x).reshape((B, T, H, d))
