@@ -169,3 +169,80 @@ class ShardedTrainer:
     def sync_to_block(self):
         """Write trained params back into the Gluon block."""
         load_params(self.block, self.params)
+
+    # ------------------------------------------------------------------
+    # sharded checkpoint/resume (ref: Trainer.save_states/load_states —
+    # at pod scale the states are sharded over the mesh, so the
+    # checkpoint is written/read distributed via orbax instead of the
+    # 0x112 single-host container)
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, path):
+        """Write params + optimizer state + step to `path` (a directory;
+        sharded arrays are gathered/written by orbax per host)."""
+        import os
+        import orbax.checkpoint as ocp
+        path = os.path.abspath(path)
+        ckpt = ocp.PyTreeCheckpointer()
+        ckpt.save(path, {"params": self.params,
+                         "opt_state": self.opt_state,
+                         "n_step": self._n_step},
+                  force=True)
+
+    def load_checkpoint(self, path):
+        """Restore params/opt_state/step saved by save_checkpoint,
+        re-placing every leaf on this trainer's mesh shardings (works
+        across restarts and across a different mesh shape — leaves are
+        restored to host memory first, so the saved device layout does
+        not constrain the restoring topology)."""
+        import os
+        import numpy as _np
+        import orbax.checkpoint as ocp
+        path = os.path.abspath(path)
+        ckpt = ocp.PyTreeCheckpointer()
+        # restore to host numpy against this trainer's tree template:
+        # restoring with the layout recorded at save time would fail on
+        # any topology change
+        template = {"params": dict(self.params),
+                    "opt_state": self.opt_state,
+                    "n_step": self._n_step}
+        restore_args = jax.tree_util.tree_map(
+            lambda _: ocp.RestoreArgs(restore_type=_np.ndarray), template)
+        try:
+            restored = ckpt.restore(path, item=template,
+                                    restore_args=restore_args)
+        except Exception as e:
+            raise ValueError(
+                "checkpoint at %s does not match this trainer's "
+                "param/opt-state tree (%s)" % (path, e)) from None
+        params = restored["params"]
+        if set(params) != set(self.params):
+            raise ValueError(
+                "checkpoint/trainer param name mismatch: only in "
+                "checkpoint %s; only in trainer %s"
+                % (sorted(set(params) - set(self.params))[:5],
+                   sorted(set(self.params) - set(params))[:5]))
+        for n, v in params.items():
+            if tuple(v.shape) != tuple(self.params[n].shape):
+                raise ValueError(
+                    "checkpoint param %s has shape %s but trainer "
+                    "expects %s" % (n, tuple(v.shape),
+                                    tuple(self.params[n].shape)))
+        self.params = {
+            n: jax.device_put(jnp.asarray(v), self._param_shardings[n])
+            for n, v in params.items()}
+
+        # optimizer-state subtrees keyed by param name take the matching
+        # param shardings (sgd: {n: m}; adam: {"m": {...}, "v": {...}});
+        # scalars (step counters) replicate
+        def _place_state(sub):
+            if isinstance(sub, dict):
+                if set(sub) == set(self.params):
+                    return {n: jax.device_put(
+                        jnp.asarray(v), self._param_shardings[n])
+                        for n, v in sub.items()}
+                return {k: _place_state(v) for k, v in sub.items()}
+            return jax.device_put(jnp.asarray(sub),
+                                  NamedSharding(self.mesh, P()))
+        self.opt_state = _place_state(restored["opt_state"])
+        self._n_step = int(restored["n_step"])
+        self._step = None          # rebuild with the restored layouts
