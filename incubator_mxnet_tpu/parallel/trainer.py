@@ -207,13 +207,17 @@ class ShardedTrainer:
                     "n_step": self._n_step}
         restore_args = jax.tree_util.tree_map(
             lambda _: ocp.RestoreArgs(restore_type=_np.ndarray), template)
+        if not os.path.exists(path):
+            raise FileNotFoundError("no checkpoint at %s" % path)
         try:
             restored = ckpt.restore(path, item=template,
                                     restore_args=restore_args)
+        except (OSError, FileNotFoundError):
+            raise                       # I/O problems are not mismatches
         except Exception as e:
             raise ValueError(
                 "checkpoint at %s does not match this trainer's "
-                "param/opt-state tree (%s)" % (path, e)) from None
+                "param/opt-state tree (%s)" % (path, e)) from e
         params = restored["params"]
         if set(params) != set(self.params):
             raise ValueError(

@@ -303,3 +303,36 @@ def test_use_np_decorator():
         return mx.is_np_array()
     assert f() is True
     assert mx.is_np_array() is False
+
+
+def test_np_style_custom_block_hybridizes():
+    """A block written against mx.np functions (the way np-era MXNet
+    models are written) must work imperatively AND under hybridize."""
+    @mx.use_np
+    class GatedMLP(mx.gluon.nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = mx.gluon.nn.Dense(16, in_units=8, flatten=False)
+            self.fc2 = mx.gluon.nn.Dense(4, in_units=16, flatten=False)
+
+        def forward(self, x):
+            h = np.tanh(self.fc1(x))
+            gate = np.exp(-np.square(h))
+            return self.fc2(h * gate)
+
+    net = GatedMLP()
+    net.initialize()
+    x = np.array(onp.random.RandomState(0).randn(2, 8)
+                 .astype(onp.float32))
+    imp = net(x)
+    assert isinstance(imp, np.ndarray)
+    net.hybridize()
+    hyb = net(x)
+    assert onp.allclose(imp.asnumpy(), hyb.asnumpy(), atol=1e-5)
+    # gradients flow through the np ops inside the cached graph
+    x.attach_grad()
+    with ag.record():
+        loss = np.sum(np.square(net(x)))
+    loss.backward()
+    assert onp.isfinite(x.grad.asnumpy()).all()
+    assert onp.abs(x.grad.asnumpy()).sum() > 0
