@@ -99,21 +99,32 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     pad = tuple(pad) if pad else (0,) * k
     adj = tuple(adj) if adj else (0,) * k
     # weight layout (in_channel, out_channel/group, *kernel) as reference
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
-                                    _conv_dim_numbers(nd))
     pads = []
     for i in range(k):
         kk = (weight.shape[2 + i] - 1) * dilate[i] + 1
         pads.append((kk - 1 - pad[i], kk - 1 - pad[i] + adj[i]))
+    weight = weight.astype(data.dtype)       # amp: follow activations
     if num_group != 1:
-        raise NotImplementedError("grouped deconvolution")
-    w = jnp.swapaxes(weight, 0, 1)
+        # grouped transposed conv as ONE grouped conv: weight
+        # (Cin, Cout/g, *k) → per-group (out, in) swap →
+        # (Cout, Cin/g, *k), then feature_group_count does the rest
+        cin_g = weight.shape[0] // num_group
+        out_g = weight.shape[1]
+        w = weight.reshape((num_group, cin_g, out_g) + weight.shape[2:])
+        w = jnp.swapaxes(w, 1, 2).reshape(
+            (num_group * out_g, cin_g) + weight.shape[2:])
+    else:
+        w = jnp.swapaxes(weight, 0, 1)
     w = jnp.flip(w, axis=tuple(range(2, nd)))
+    dn = lax.conv_dimension_numbers(data.shape, w.shape,
+                                    _conv_dim_numbers(nd))
     out = lax.conv_general_dilated(
         data, w, window_strides=(1,) * k, padding=pads,
-        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn)
+        lhs_dilation=stride, rhs_dilation=dilate,
+        feature_group_count=num_group, dimension_numbers=dn)
     if not no_bias and bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * (nd - 2))
+        out = out + bias.reshape((1, -1) + (1,) * (nd - 2)).astype(
+            out.dtype)
     return out
 
 
@@ -633,4 +644,18 @@ def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
         theta = data.reshape(-1, 2, 3)
         out = jnp.matmul(theta, grid)            # (N, 2, HW)
         return out.reshape(-1, 2, h, w)
-    raise NotImplementedError(transform_type)
+    if transform_type == "warp":
+        # ref: grid_generator-inl.h warp — data is an (N, 2, H, W) flow
+        # field added to the identity pixel grid, then normalized to
+        # [-1, 1] (x by (W-1)/2, y by (H-1)/2)
+        n, _two, fh, fw = data.shape
+        xs = jnp.arange(fw, dtype=jnp.float32)
+        ys = jnp.arange(fh, dtype=jnp.float32)
+        gx, gy = jnp.meshgrid(xs, ys)
+        px = data[:, 0] + gx[None]
+        py = data[:, 1] + gy[None]
+        nx = px * 2.0 / jnp.maximum(fw - 1, 1) - 1.0
+        ny = py * 2.0 / jnp.maximum(fh - 1, 1) - 1.0
+        return jnp.stack([nx, ny], axis=1)
+    raise ValueError("GridGenerator: unknown transform_type %r"
+                     % (transform_type,))

@@ -212,7 +212,7 @@ class ShardedTrainer:
         try:
             restored = ckpt.restore(path, item=template,
                                     restore_args=restore_args)
-        except (OSError, FileNotFoundError):
+        except OSError:
             raise                       # I/O problems are not mismatches
         except Exception as e:
             raise ValueError(

@@ -322,3 +322,42 @@ def test_smooth_l1():
     out = nd.smooth_l1(nd.array(x), scalar=1.0)
     expect = np.where(np.abs(x) < 1, 0.5 * x ** 2, np.abs(x) - 0.5)
     assert_almost_equal(out, expect)
+
+
+def test_grouped_deconvolution():
+    """Grouped transposed conv == concat of per-group transposed convs,
+    and matches the gradient-of-conv identity per group."""
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.randn(2, 4, 5, 5).astype(np.float32))
+    w = nd.array(rs.randn(4, 3, 3, 3).astype(np.float32))  # g=2: 2->3 each
+    out = nd.invoke("Deconvolution", x, w, None, kernel=(3, 3),
+                    stride=(2, 2), pad=(1, 1), num_filter=6, num_group=2,
+                    no_bias=True)
+    assert out.shape == (2, 6, 9, 9)
+    # reference: run each group separately with num_group=1
+    parts = []
+    for g in range(2):
+        xg = nd.array(x.asnumpy()[:, g * 2:(g + 1) * 2])
+        wg = nd.array(w.asnumpy()[g * 2:(g + 1) * 2])
+        parts.append(nd.invoke("Deconvolution", xg, wg, None,
+                               kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                               num_filter=3, num_group=1,
+                               no_bias=True).asnumpy())
+    want = np.concatenate(parts, axis=1)
+    assert np.allclose(out.asnumpy(), want, atol=1e-5)
+
+
+def test_grid_generator_warp():
+    """warp grid: zero flow == identity sampling grid in [-1, 1]."""
+    flow = nd.array(np.zeros((1, 2, 3, 5), np.float32))
+    grid = nd.invoke("GridGenerator", flow, transform_type="warp",
+                     target_shape=(3, 5)).asnumpy()
+    assert grid.shape == (1, 2, 3, 5)
+    assert np.allclose(grid[0, 0, 0], np.linspace(-1, 1, 5), atol=1e-6)
+    assert np.allclose(grid[0, 1, :, 0], np.linspace(-1, 1, 3), atol=1e-6)
+    # +1-pixel x flow shifts the normalized grid by 2/(W-1)
+    flow2 = nd.array(np.stack([np.ones((1, 3, 5), np.float32),
+                               np.zeros((1, 3, 5), np.float32)], axis=1))
+    g2 = nd.invoke("GridGenerator", flow2, transform_type="warp",
+                   target_shape=(3, 5)).asnumpy()
+    assert np.allclose(g2[0, 0] - grid[0, 0], 2.0 / 4.0, atol=1e-6)
