@@ -18,6 +18,11 @@ from incubator_mxnet_tpu.parallel import (ring_attention,
                                           ulysses_attention,
                                           local_attention)
 
+# sequence parallelism needs the virtual 8-device mesh (conftest's CPU
+# recipe); on a single real chip these are structurally inapplicable
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs >= 8 devices (virtual mesh)")
+
 
 def _full_attention(q, k, v, causal=False):
     scale = 1.0 / (q.shape[-1] ** 0.5)
