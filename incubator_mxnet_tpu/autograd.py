@@ -28,7 +28,8 @@ from .base import MXNetError
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "backward", "grad", "mark_variables",
-           "set_recording", "set_training", "get_symbol", "Function"]
+           "set_recording", "set_training", "get_symbol", "Function",
+           "flush_pending"]
 
 
 class _State(threading.local):
@@ -38,6 +39,135 @@ class _State(threading.local):
 
 
 _STATE = _State()
+
+
+# ---------------------------------------------------------------------------
+# deferred dispatch (the async-engine analogue, ref: threaded_engine.cc op
+# queue): cached-op forwards and single-program backwards may defer their
+# XLA dispatch so the NEXT consumer can compose with them into ONE
+# executable (loss fused into the net's fwd+vjp; optimizer fused into the
+# backward).  Pendings register here per-thread; reading any lazy
+# NDArray's buffer forces the underlying program.
+# ---------------------------------------------------------------------------
+
+
+class _PendingTL(threading.local):
+    def __init__(self):
+        self.fwd = []       # deferred cached-op forwards (_PendingCall)
+        self.bwd = []       # deferred backward grads (_PendingGrads)
+
+
+_PENDINGS = _PendingTL()
+
+
+def _register_pending(p, kind="fwd"):
+    (_PENDINGS.fwd if kind == "fwd" else _PENDINGS.bwd).append(p)
+
+
+def _unregister_pending(p):
+    for lst in (_PENDINGS.fwd, _PENDINGS.bwd):
+        try:
+            lst.remove(p)
+        except ValueError:
+            pass
+
+
+def flush_pending(kind="fwd"):
+    """Force deferred programs: 'fwd' = pending cached-op forwards (their
+    tape nodes + aux-state writebacks must exist before backward / scope
+    exit); 'all' additionally forces deferred backward grads (waitall
+    barrier semantics)."""
+    for p in list(_PENDINGS.fwd):
+        p.force()
+    if kind == "all":
+        for p in list(_PENDINGS.bwd):
+            p.force()
+
+
+# one shared residual-consuming backward executable applier: jit caches
+# per closure-treedef, so every cached-op / fused program reuses this
+_BWD_APPLY = None
+
+
+def _bwd_apply():
+    global _BWD_APPLY
+    if _BWD_APPLY is None:
+        _BWD_APPLY = jax.jit(lambda v, cots: v(cots))
+    return _BWD_APPLY
+
+
+class _JitVjp:
+    """Pullback of a (possibly fused) cached-op program.
+
+    Applies the jitted residual-consuming backward in ONE executable and
+    keeps only the gradient positions that correspond to tape inputs
+    (rng key-bits / fused-interior grads are dropped).  Exposing the
+    closure lets backward() defer the whole application so the optimizer
+    step can compose with it (ref: CachedOp::Backward feeding the
+    update ops in one bulked segment, SURVEY §3.3)."""
+
+    __slots__ = ("closure", "keep")
+
+    def __init__(self, closure, keep):
+        self.closure = closure
+        self.keep = keep
+
+    def __call__(self, cots):
+        g = _bwd_apply()(self.closure, tuple(cots))
+        return tuple(g[i] for i in self.keep)
+
+
+class _PendingGrads:
+    """A deferred single-program backward: holds the vjp closure + seed
+    cotangents; forcing runs ONE executable and writes every leaf grad.
+    The aggregated optimizer update recognises it and composes backward +
+    update into one program instead (optimizer/optimizer.py)."""
+
+    will_record = False
+
+    def __init__(self, vjp, cots, items):
+        # items: list of (grad_nd, full_grad_index, shape, np_dtype)
+        self.vjp = vjp
+        self.cots = cots
+        self.items = items
+        self.done = False
+        # O(1) lookups — the aggregated optimizer queries every grad
+        # every step (items hold strong nd refs, so id() stays valid)
+        self._by_id = {id(nd): (i, s, dt) for nd, i, s, dt in items}
+        for nd, _i, _s, _dt in items:
+            nd._data_v = None
+            nd._pending = self
+        _register_pending(self, "bwd")
+
+    def aval_of(self, nd):
+        i, s, dt = self._by_id[id(nd)]
+        return (s, dt)
+
+    def index_for(self, nd):
+        return self._by_id[id(nd)][0]
+
+    def covers(self, grad_nds):
+        ids = {id(g) for g in grad_nds}
+        return all(id(nd) in ids for nd, _i, _s, _dt in self.items)
+
+    def force(self):
+        if self.done:
+            return
+        self.done = True
+        _unregister_pending(self)
+        g = _bwd_apply()(self.vjp.closure, self.cots)
+        for nd, i, _s, dt in self.items:
+            if nd._pending is self:
+                nd._data = g[i].astype(dt)
+
+    def fulfill(self, pairs):
+        """Called by the fused backward+optimizer program: grads came out
+        of that executable; write them through by identity."""
+        self.done = True
+        _unregister_pending(self)
+        for nd, val in pairs:
+            if nd._pending is self:
+                nd._data = val
 
 
 def is_recording() -> bool:
@@ -73,6 +203,11 @@ class _RecordingStateScope:
         return self
 
     def __exit__(self, *exc):
+        if self._rec is True and (not exc or exc[0] is None):
+            # leaving a record scope: deferred forwards must materialise
+            # (tape nodes + aux-state writebacks) while their logical
+            # execution context still holds
+            flush_pending("fwd")
         if self._rec is not None:
             set_recording(self._prev_rec)
         if self._train is not None:
@@ -110,10 +245,10 @@ class Node:
     """
 
     __slots__ = ("vjp_fn", "inputs", "n_out", "out_shapes", "out_dtypes",
-                 "name", "out_is_tuple", "raw_fn")
+                 "name", "out_is_tuple", "raw_fn", "op_attrs")
 
     def __init__(self, vjp_fn, inputs, outputs, name="", out_is_tuple=False,
-                 raw_fn=None):
+                 raw_fn=None, op_attrs=None):
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)          # NDArray refs (graph edges)
         self.n_out = len(outputs)
@@ -125,6 +260,10 @@ class Node:
         # create_graph backward can RE-RECORD the pullback application
         # as a differentiable op (jax re-linearizes at the saved inputs)
         self.raw_fn = raw_fn
+        # (registry opname, attr kwargs) for ops invoked through the op
+        # registry — enough to rebuild this node symbolically
+        # (get_symbol); None for opaque pullbacks
+        self.op_attrs = op_attrs
 
 
 def _is_float0(x):
@@ -159,8 +298,14 @@ def _ones_const(shape, dtype):
 
 
 def _requires_tracking(nd) -> bool:
-    return nd is not None and (nd._tape_node is not None or
-                               nd._grad_req not in (None, "null"))
+    if nd is None:
+        return False
+    if nd._tape_node is not None or nd._grad_req not in (None, "null"):
+        return True
+    # a lazy cached-op output records its tape node at force time — it
+    # WILL be tracked, so consumers must record too
+    p = getattr(nd, "_pending", None)
+    return p is not None and getattr(p, "will_record", False)
 
 
 def _is_rsp(x):
@@ -186,11 +331,11 @@ def _densify_cot(c):
 
 
 def record_op(vjp_fn, input_nds, output_nds, name="", out_is_tuple=False,
-              raw_fn=None):
+              raw_fn=None, op_attrs=None):
     """Attach a tape node linking inputs → outputs. Called by the NDArray
     dispatch layer when recording is on and ≥1 input is tracked."""
     node = Node(vjp_fn, input_nds, output_nds, name, out_is_tuple,
-                raw_fn=raw_fn)
+                raw_fn=raw_fn, op_attrs=op_attrs)
     for i, o in enumerate(output_nds):
         o._tape_node = node
         o._out_index = i
@@ -219,6 +364,11 @@ def _seed_cotangents(heads, head_grads, default_grad, unwrap, api):
             % (api, len(head_grads), len(heads)))
     root_nodes, cot = [], {}
     for h, hg in zip(heads, head_grads):
+        p = getattr(h, "_pending", None)
+        if p is not None:
+            # a still-deferred head (e.g. a lazy reshape consumed by a
+            # fused program): materialise it so its tape node exists
+            p.force()
         node = h._tape_node
         if node is None:
             raise MXNetError(
@@ -250,6 +400,53 @@ def _topo_order(root_nodes):
     return order   # parents before children
 
 
+def _try_defer_backward(node, cot):
+    """Single-tape-node backward (the steady-state hybridized step):
+    instead of dispatching the backward executable now, park the vjp
+    closure + seed cotangents as a _PendingGrads.  Returns False when the
+    eager path must run (sparse/add grads, float0 outputs, duplicate
+    inputs, missing grad buffers)."""
+    import jax.numpy as jnp
+    cots = []
+    for i in range(node.n_out):
+        c = cot.get((id(node), i))
+        if c is None:
+            if not jnp.issubdtype(node.out_dtypes[i], jnp.inexact):
+                return False        # float0 cots can't ride through jit args
+            c = _zeros_const(node.out_shapes[i], node.out_dtypes[i])
+        elif _is_rsp(c):
+            return False
+        cots.append(c)
+    targets = []
+    seen = set()
+    for j, inp in enumerate(node.inputs):
+        if inp is None or inp._grad_req in (None, "null"):
+            continue
+        if (inp._grad_req != "write" or inp._grad is None or
+                _is_rsp(inp._grad) or id(inp) in seen):
+            return False
+        seen.add(id(inp))
+        targets.append((j, inp))
+    if not targets:
+        return False
+    for i in range(node.n_out):
+        cot.pop((id(node), i), None)
+    vjp = node.vjp_fn
+    items = []
+    for j, inp in targets:
+        g = inp._grad
+        shp, dt = tuple(g.shape), g.dtype   # aval-aware: no forcing
+        stale = g._pending
+        if stale is not None:           # grad_req=write overwrites: detach
+            stale.items = [it for it in stale.items if it[0] is not g]
+            stale._by_id.pop(id(g), None)
+            g._pending = None
+        items.append((g, vjp.keep[j], shp, dt))
+    _PendingGrads(vjp, tuple(cots), items)
+    node.vjp_fn = None                  # retain_graph=False contract
+    return True
+
+
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
              variables=None):
     """Run backward from `heads`.
@@ -259,12 +456,22 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
     accumulates into leaves' `.grad` per their grad_req.
     """
     import jax.numpy as jnp
+    flush_pending("fwd")
     root_nodes, cot = _seed_cotangents(
         heads, head_grads,
         default_grad=lambda h: _ones_const(h.shape, h.dtype),
         unwrap=lambda hg: hg._data, api="backward")
 
     order = _topo_order(root_nodes)
+
+    from . import config as _cfg
+    if (variables is None and not retain_graph and len(order) == 1
+            and isinstance(order[0].vjp_fn, _JitVjp)
+            and _cfg.get("MXNET_CACHEDOP_FUSION") == "1"
+            and _try_defer_backward(order[0], cot)):
+        # whole backward is ONE deferred program: grads materialise on
+        # first read, or fuse into the optimizer update (Trainer.step)
+        return None
 
     var_ids = None
     var_grads = {}
@@ -376,6 +583,7 @@ def _backward_create_graph(heads, head_grads, variables, train_mode,
     from .ndarray import NDArray
     from .ndarray.ndarray import apply_fn
 
+    flush_pending("fwd")
     if not isinstance(variables, (list, tuple)):
         variables = [variables]
     root_nodes, cot = _seed_cotangents(
@@ -486,9 +694,58 @@ def mark_variables(variables, gradients, grad_reqs="write"):
 
 
 def get_symbol(x):
-    raise NotImplementedError(
-        "autograd.get_symbol: the TPU build records jax pullbacks, not nnvm "
-        "graphs; use HybridBlock.export for a serialisable graph")
+    """Rebuild the recorded imperative computation reaching `x` as a
+    Symbol graph (ref: python/mxnet/autograd.py get_symbol /
+    MXAutogradGetSymbol — there every imperative op IS an nnvm node, so
+    the tape is already a graph; here registry ops record their
+    (opname, attrs) and the tape re-composes through the symbol stubs).
+
+    Supported for chains of registry ops — the reference's own scope.
+    Opaque pullbacks (hybridized cached-op segments, custom
+    autograd.Function, raw getitem) raise with guidance: run the forward
+    unhybridized, or use HybridBlock.export for whole-block graphs."""
+    from .symbol import symbol as _sym
+    flush_pending("fwd")
+    p = getattr(x, "_pending", None)
+    if p is not None:
+        p.force()
+    node = getattr(x, "_tape_node", None)
+    if node is None:
+        raise MXNetError(
+            "get_symbol: array was not computed under autograd.record()")
+    order = _topo_order([node])     # parents before children
+    memo = {}
+    var_syms = {}
+    counter = [0]
+
+    def leaf_sym(nd):
+        k = id(nd)
+        if k not in var_syms:
+            var_syms[k] = _sym.var("var%d" % counter[0],
+                                   shape=tuple(nd.shape))
+            counter[0] += 1
+        return var_syms[k]
+
+    for n in order:
+        if n.op_attrs is None:
+            raise NotImplementedError(
+                "autograd.get_symbol through %r: this tape node is an "
+                "opaque pullback (hybridized block / custom Function / "
+                "indexing); run the forward unhybridized with registry "
+                "ops, or use HybridBlock.export" % (n.name or "op"))
+        opname, attrs = n.op_attrs
+        ins = []
+        for inp in n.inputs:
+            pn = inp._tape_node
+            ins.append(memo[(id(pn), inp._out_index)]
+                       if pn is not None else leaf_sym(inp))
+        s = _sym.apply_stub_args(opname, ins, dict(attrs))
+        if n.n_out > 1:
+            for i in range(n.n_out):
+                memo[(id(n), i)] = s[i]
+        else:
+            memo[(id(n), 0)] = s
+    return memo[(id(node), x._out_index)]
 
 
 class Function:

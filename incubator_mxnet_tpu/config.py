@@ -151,6 +151,11 @@ register("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
 register("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", int, 15,
          "Op count threshold above which the engine emits a bulk-segment "
          "profiler mark (XLA fuses regardless)")
+register("MXNET_CACHEDOP_FUSION", str, "1",
+         "Cross-program fusion of the imperative step: 0=off (every "
+         "cached-op/backward/update dispatches separately, round-2 "
+         "behaviour), 1=on (net+loss one executable, backward+optimizer "
+         "one executable)", choices=("0", "1"))
 register("MXNET_USE_PALLAS", str, "1",
          "Pallas kernel dispatch: 0=never, 1=auto (by score-matrix "
          "bytes), 2=always", choices=("0", "1", "2"))

@@ -67,6 +67,8 @@ def _dispatch_hook(name: str, ctx):
 def wait_all():
     """Engine::WaitForAll — barrier on all outstanding device work."""
     import jax
+    from . import autograd as _ag
+    _ag.flush_pending("all")    # deferred programs must dispatch first
     (jax.device_put(0) + 0).block_until_ready()
     try:
         jax.effects_barrier()

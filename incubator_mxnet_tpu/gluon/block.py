@@ -34,6 +34,7 @@ import numpy as _np
 from ..base import MXNetError
 from ..context import Context, current_context
 from ..ndarray.ndarray import NDArray, apply_fn
+from ..ops import registry as _registry
 from .. import autograd as _ag
 from .. import random as _rnd
 from .parameter import (Parameter, ParameterDict,
@@ -403,6 +404,159 @@ def _flat_symbols(out):
 
 
 # ---------------------------------------------------------------------------
+# deferred dispatch + cross-block fusion
+# ---------------------------------------------------------------------------
+
+class _PendingCall:
+    """A cached-op forward whose XLA dispatch is deferred.
+
+    The async-engine analogue (ref: threaded_engine.cc op queue /
+    cached_op.cc bulked segments, SURVEY §3.2-3.3): deferral exists so
+    the NEXT cached-op call — typically the hybridized loss applied to
+    this block's output — composes with this program into ONE jitted
+    fwd+vjp executable before anything reaches the device.  Any other
+    consumer (``.asnumpy()``, an eager op, scope exit) forces the
+    original single-block program, which is exactly the round-2 path."""
+
+    __slots__ = ("graph", "skey", "leaf_data", "flat_inputs", "ctx",
+                 "out_nds", "done")
+
+    will_record = True
+
+    def __init__(self, graph, skey, leaf_data, flat_inputs, ctx):
+        self.graph = graph
+        self.skey = skey            # (fkey, input avals) — shape-exact
+        self.leaf_data = leaf_data
+        self.flat_inputs = flat_inputs
+        self.ctx = ctx
+        self.done = False
+        avals = graph._out_avals[skey]
+        outs = []
+        for i in range(len(avals)):
+            nd = NDArray.__new__(NDArray)
+            nd._data_v = None
+            nd._pending = self
+            nd._ctx = ctx
+            nd._grad = None
+            nd._grad_req = None
+            nd._tape_node = None
+            nd._out_index = i
+            outs.append(nd)
+        self.out_nds = outs
+        _ag._register_pending(self, "fwd")
+
+    @property
+    def fkey(self):
+        return self.skey[0]
+
+    def aval_of(self, nd):
+        return self.graph._out_avals[self.skey][nd._out_index]
+
+    def force(self):
+        if self.done:
+            return
+        self.done = True
+        _ag._unregister_pending(self)
+        self.graph._dispatch_deferred(self)
+
+
+class _XformPending:
+    """A shape-only unary op (reshape/transpose/cast/...) applied to a
+    lazy cached-op output: carries the (op, kwargs) chain so a consuming
+    cached-op's fused trace applies it inline; forcing replays it through
+    the normal recorded dispatch on the materialised source."""
+
+    __slots__ = ("base", "src", "nd", "base_index", "chain", "_aval",
+                 "done")
+
+    will_record = True
+
+    def __init__(self, base, src, base_index, chain, aval):
+        self.base = base            # originating _PendingCall
+        self.src = src              # immediate source NDArray
+        self.base_index = base_index
+        self.chain = chain          # ((opname, frozen_kwargs), ...)
+        self._aval = aval
+        self.nd = None              # target, set by try_lazy_unary
+        self.done = False
+
+    def aval_of(self, nd):
+        return self._aval
+
+    def force(self):
+        if self.done:
+            return
+        self.done = True
+        _ag._unregister_pending(self)
+        from ..ndarray.ndarray import invoke
+        self.src._data              # materialise the producer chain first
+        opname, fkw = self.chain[-1]
+        # replay under recording regardless of the CURRENT flag: the op
+        # logically executed inside the record scope that deferred it,
+        # so its tape node must exist (backward-head / re-use cases)
+        prev = _ag.set_recording(True)
+        try:
+            out = invoke(opname, self.src, **dict(fkw))
+        finally:
+            _ag.set_recording(prev)
+        nd = self.nd
+        nd._data_v = out._data_v
+        nd._tape_node = out._tape_node
+        nd._out_index = out._out_index
+        nd._pending = None
+
+
+def try_lazy_unary(od, nd, kwargs):
+    """Called from ndarray.invoke for shape-only unary ops whose input is
+    a lazy cached-op output: return a derived lazy NDArray (keeping the
+    net→reshape→loss chain fusable) or None to dispatch normally."""
+    if not _ag.is_recording():
+        return None
+    p = nd._pending
+    if isinstance(p, _PendingCall):
+        if p.done:
+            return None
+        base, base_index, chain = p, nd._out_index, ()
+    elif isinstance(p, _XformPending):
+        if p.done or p.base.done:
+            return None
+        base, base_index, chain = p.base, p.base_index, p.chain
+    else:
+        return None
+    try:
+        fkw = tuple(sorted(
+            (k, tuple(v) if isinstance(v, list) else v)
+            for k, v in kwargs.items()))
+        hash(fkw)
+    except TypeError:
+        return None
+    import jax
+    try:
+        aval = jax.eval_shape(lambda x: od.fn(x, **dict(fkw)),
+                              jax.ShapeDtypeStruct(nd.shape, nd.dtype))
+    except Exception:
+        return None
+    if not hasattr(aval, "shape"):      # multi-output op: dispatch normally
+        return None
+    xp = _XformPending(base, nd, base_index, chain + ((od.name, fkw),),
+                       (tuple(aval.shape), _np.dtype(aval.dtype)))
+    out = NDArray.__new__(NDArray)
+    out._data_v = None
+    out._pending = xp
+    out._ctx = nd._ctx
+    out._grad = None
+    out._grad_req = None
+    out._tape_node = None
+    out._out_index = 0
+    xp.nd = out
+    # registered so an xform used as a backward head (or left dangling)
+    # materialises with its tape node at flush points; a consuming fused
+    # call deregisters it instead (value only needed on later reads)
+    _ag._register_pending(xp, "fwd")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # HybridBlock + cached-op machinery
 # ---------------------------------------------------------------------------
 
@@ -424,7 +578,8 @@ class _CachedGraph:
         self._jitted = {}           # fkey -> jitted forward (inference)
         self._raw = {}              # fkey -> unjitted pure
         self._jit_fwdvjp = {}       # fkey -> jitted fwd returning vjp
-        self._jit_bwd_apply = None  # jitted residual-consuming backward
+        self._out_avals = {}        # fkey -> ((shape, dtype), ...) per leaf
+        self._fused = {}            # (fkey, producer, ...) -> jitted fused
         # fkey -> (out_treedef, state_params): BatchNorm-style state
         # outputs exist only in training mode, so trace metadata MUST be
         # keyed by the same (training, np_, ni_) signature as the jitted
@@ -515,13 +670,10 @@ class _CachedGraph:
             outs, vjp_fn = jax.vjp(pure_flat, *leaves)
             return outs, vjp_fn
         self._jit_fwdvjp[fkey] = jax.jit(fwd)
-        if self._jit_bwd_apply is None:
-            self._jit_bwd_apply = jax.jit(lambda v, cots: v(cots))
         return self._jit_fwdvjp[fkey]
 
     def __call__(self, args):
         import jax
-        import jax.numpy as jnp
         if self.param_names is None:
             self._collect_params()
         training = _ag.is_training()
@@ -538,7 +690,36 @@ class _CachedGraph:
         fkey = (training, np_, ni_)
         record = _ag.is_recording() and any(
             _ag._requires_tracking(a) for a in flat_inputs)
+
+        from .. import config as _cfg
+        fusion_on = _cfg.get("MXNET_CACHEDOP_FUSION") == "1"
+        if record and fusion_on:
+            # an input produced by a still-pending cached-op: compose the
+            # two programs into ONE fwd+vjp executable (net+loss fusion)
+            out = self._try_fused_call(args, param_nds, key_bits, fkey,
+                                       ctx)
+            if out is not NotImplemented:
+                return out
+
+        # shape-exact signature: out avals depend on input shapes, so the
+        # deferred path must never serve avals recorded for another batch
+        skey = (fkey, tuple((tuple(a.shape), str(a.dtype))
+                            for a in args))
+
+        # reading ._data forces any unfusable pending producers
         leaf_data = [a._data for a in flat_inputs] + [key_bits]
+
+        if record and fusion_on and fkey in self._trace_meta \
+                and skey in self._out_avals:
+            # steady state: defer dispatch so a following cached-op call
+            # (the hybridized loss) can fuse with this one; any other
+            # consumer forces the single-block program unchanged
+            pending = _PendingCall(self, skey, leaf_data, flat_inputs,
+                                   ctx)
+            treedef, state_params = self._trace_meta[fkey]
+            n_outs = len(pending.out_nds) - len(state_params)
+            return _unflatten_out(list(pending.out_nds[:n_outs]), treedef)
+
         from .. import engine as _engine
         with _engine._dispatch_hook(self.block.name + "_cachedop", ctx):
             if record:
@@ -557,13 +738,12 @@ class _CachedGraph:
         wrapped = tuple(NDArray(o, ctx=ctx) for o in result)
 
         if record:
-            bwd_apply = self._jit_bwd_apply
-
-            def vjp_fn(cots):
-                # drop the trailing key-bits grad (float0)
-                return tuple(bwd_apply(vjp_closure, tuple(cots)))[:-1]
-
-            _ag.record_op(vjp_fn, flat_inputs, wrapped,
+            self._out_avals[skey] = tuple(
+                (tuple(o.shape), _np.dtype(o.dtype)) for o in result)
+            # drop the trailing key-bits grad position
+            vjp = _ag._JitVjp(vjp_closure,
+                              tuple(range(len(leaf_data) - 1)))
+            _ag.record_op(vjp, flat_inputs, wrapped,
                           name=self.block.name + "_cachedop",
                           out_is_tuple=True)
 
@@ -576,6 +756,168 @@ class _CachedGraph:
             # compute in f32)
             _write_state_all_ctx(p, s._data)
         return _unflatten_out(list(outs), out_treedef)
+
+    def _dispatch_deferred(self, pending):
+        """Force a deferred forward: dispatch the single-block fwd+vjp
+        executable, fill the lazy outputs, record the tape node, write
+        aux state — byte-identical to the eager record path."""
+        from .. import engine as _engine
+        fkey = pending.fkey
+        with _engine._dispatch_hook(self.block.name + "_cachedop",
+                                    pending.ctx):
+            result, vjp_closure = self._get_fwd_vjp(*fkey)(
+                *pending.leaf_data)
+        if _engine.naive_mode():
+            for o in result:
+                o.block_until_ready()
+        for nd, val in zip(pending.out_nds, result):
+            nd._data_v = val
+            nd._pending = None
+        vjp = _ag._JitVjp(vjp_closure,
+                          tuple(range(len(pending.leaf_data) - 1)))
+        _ag.record_op(vjp, pending.flat_inputs, tuple(pending.out_nds),
+                      name=self.block.name + "_cachedop",
+                      out_is_tuple=True)
+        _, state_params = self._trace_meta[fkey]
+        n_states = len(state_params)
+        tail = pending.out_nds[len(pending.out_nds) - n_states:] \
+            if n_states else []
+        for p, s in zip(state_params, tail):
+            _write_state_all_ctx(p, s._data_v)
+
+    def _try_fused_call(self, args, param_nds, key_bits, fkey, ctx):
+        """Compose this cached-op with ONE pending producer into a single
+        jitted fwd+vjp executable (ref: cached_op.cc builds one graph for
+        the whole hybridized segment; here the segment grows across
+        user-level block calls — net(x) then loss(net_out, y) become one
+        program, and their shared backward one more)."""
+        base = None
+        specs = []
+        consumed_xforms = []
+        for a in args:
+            p = getattr(a, "_pending", None) if isinstance(a, NDArray) \
+                else None
+            if p is None:
+                specs.append(None)
+                continue
+            if isinstance(p, _PendingCall) and not p.done:
+                b, idx, chain = p, a._out_index, ()
+            elif isinstance(p, _XformPending) and not p.done \
+                    and not p.base.done:
+                b, idx, chain = p.base, p.base_index, p.chain
+                consumed_xforms.append(p)
+            else:
+                return NotImplemented   # unfusable pending: force path
+            if base is None:
+                base = b
+            elif base is not b:
+                return NotImplemented   # two producers: force path
+            specs.append((idx, chain))
+        if base is None or base.graph is self:
+            return NotImplemented
+
+        import jax
+        training, np_, ni_ = fkey
+        concrete_nds = list(param_nds) + [a for a, s in zip(args, specs)
+                                          if s is None]
+        concrete_leaves = [a._data for a in concrete_nds] + [key_bits]
+        n_net = len(base.leaf_data)
+        n_lc = len(concrete_leaves)
+
+        # cache lives on the PRODUCER graph: in rebuild loops (hyperparam
+        # search) nets die while the loss block lives on — a consumer-side
+        # cache would pin every dead net's params/executables forever.
+        # Keyed by the consumer OBJECT (not id()): an id of a collected
+        # graph can be recycled to a different block and would silently
+        # serve the dead consumer's program
+        store = base.graph._fused
+        cache_key = (self, fkey, base.fkey, tuple(specs))
+        ent = store.get(cache_key)
+        if ent is None:
+            net_flat = base.graph._get_flat(*base.fkey)
+            loss_flat = self._get_flat(training, np_, ni_)
+            # consumer leaf t ∈ [params..., inputs..., key] sourced from
+            # either a concrete leaf or a producer output (+xform chain)
+            src_map = [("c", j) for j in range(np_)]
+            nc = np_
+            for s in specs:
+                if s is None:
+                    src_map.append(("c", nc))
+                    nc += 1
+                else:
+                    src_map.append(("n",) + s)
+            src_map.append(("c", n_lc - 1))     # key bits
+            src_map = tuple(src_map)
+
+            def fused(*leaves):
+                net_res = net_flat(*leaves[:n_net])
+                loss_leaves = []
+                for s in src_map:
+                    if s[0] == "c":
+                        loss_leaves.append(leaves[n_net + s[1]])
+                    else:
+                        v = net_res[s[1]]
+                        for opname, fkw in s[2]:
+                            v = _registry.get(opname).fn(v, **dict(fkw))
+                        loss_leaves.append(v)
+                loss_res = loss_flat(*loss_leaves)
+                return tuple(loss_res) + tuple(net_res)
+
+            def fwd(*leaves):
+                return jax.vjp(fused, *leaves)
+            ent = jax.jit(fwd)
+            store[cache_key] = ent
+
+        from .. import engine as _engine
+        base.done = True
+        _ag._unregister_pending(base)
+        for xp in consumed_xforms:
+            # value computed inside the fused program; a later read
+            # replays cheaply off the now-concrete source instead of
+            # re-dispatching at scope exit
+            _ag._unregister_pending(xp)
+        leaves = list(base.leaf_data) + concrete_leaves
+        with _engine._dispatch_hook(
+                base.graph.block.name + "+" + self.block.name + "_fused",
+                ctx):
+            result, vjp_closure = ent(*leaves)
+        if _engine.naive_mode():
+            for o in result:
+                o.block_until_ready()
+
+        n_net_out = len(base.out_nds)
+        n_loss = len(result) - n_net_out
+        loss_wrapped = tuple(NDArray(v, ctx=ctx) for v in result[:n_loss])
+        for nd, val in zip(base.out_nds, result[n_loss:]):
+            nd._data_v = val
+            nd._pending = None
+
+        # tape: ONE node over both programs' real inputs; key-bit grad
+        # positions dropped, fused-interior grads never materialise
+        keep = tuple(range(n_net - 1)) + \
+            tuple(range(n_net, n_net + n_lc - 1))
+        vjp = _ag._JitVjp(vjp_closure, keep)
+        _ag.record_op(vjp, list(base.flat_inputs) + concrete_nds,
+                      loss_wrapped + tuple(base.out_nds),
+                      name=(base.graph.block.name + "+" +
+                            self.block.name + "_fused"),
+                      out_is_tuple=True)
+
+        # aux-state writebacks for BOTH programs
+        ltd, lsp = self._trace_meta[fkey]
+        if lsp:
+            for p, s in zip(lsp, loss_wrapped[n_loss - len(lsp):]):
+                _write_state_all_ctx(p, s._data_v)
+        _, nsp = base.graph._trace_meta[base.fkey]
+        if nsp:
+            for p, nd in zip(nsp, base.out_nds[n_net_out - len(nsp):]):
+                _write_state_all_ctx(p, nd._data_v)
+
+        skey = (fkey, tuple((tuple(a.shape), str(a.dtype))
+                            for a in args))
+        self._out_avals[skey] = tuple(
+            (tuple(v.shape), _np.dtype(v.dtype)) for v in result[:n_loss])
+        return _unflatten_out(list(loss_wrapped[:n_loss - len(lsp)]), ltd)
 
 
 def _flatten_out(out):

@@ -144,6 +144,23 @@ class Trainer:
             grads = param.list_grad()
             self._kvstore.pushpull(i, grads, out=grads)
 
+    def _grads_pending(self, gs):
+        """The common deferred-backward pending shared by EVERY gradient,
+        or None when any grad is concrete / foreign (then the eager
+        aggregated path runs unchanged)."""
+        if not gs or not getattr(self._optimizer, "supports_bwd_fusion",
+                                 False):
+            return None
+        from .. import autograd as _ag
+        p0 = getattr(gs[0], "_pending", None)
+        if not isinstance(p0, _ag._PendingGrads) or p0.done:
+            return None
+        if not all(getattr(g, "_pending", None) is p0 for g in gs):
+            return None
+        if not p0.covers(gs):
+            return None
+        return p0
+
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
             self._init_kvstore()
@@ -174,7 +191,15 @@ class Trainer:
                 gs.append(param.grad())
                 sts.append(updater.states[i])
             if idxs:
-                self._optimizer.update_multi(idxs, ws, gs, sts)
+                pend = self._grads_pending(gs)
+                if pend is not None:
+                    # steady-state hybridized step: backward + update run
+                    # as ONE executable (the deferred vjp closure feeds
+                    # the aggregated update directly)
+                    self._optimizer.update_multi(idxs, ws, gs, sts,
+                                                 bwd_pending=pend)
+                else:
+                    self._optimizer.update_multi(idxs, ws, gs, sts)
             return
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:

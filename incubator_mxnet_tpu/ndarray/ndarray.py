@@ -45,7 +45,7 @@ def _resolve_ctx(arr_inputs, kwargs) -> Context:
 
 
 def apply_fn(fn, nd_args, kwargs, *, name="", differentiable=True,
-             ctx=None, num_outputs=1):
+             ctx=None, num_outputs=1, attrs=None):
     """Core imperative dispatch (the analogue of Imperative::Invoke →
     PushFCompute, ref src/imperative/imperative_utils.h).
 
@@ -91,8 +91,12 @@ def apply_fn(fn, nd_args, kwargs, *, name="", differentiable=True,
     wrapped = tuple(NDArray(o, ctx=ctx) for o in outs)
 
     if record:
+        # (opname, attrs) only when EVERY positional arg is a tensor —
+        # otherwise the symbol stubs could not re-compose this node
+        op_attrs = attrs if (attrs is not None and
+                             len(arr_pos) == len(nd_args)) else None
         _ag.record_op(vjp_fn, arr_nds, wrapped, name=name,
-                      out_is_tuple=multi, raw_fn=pure)
+                      out_is_tuple=multi, raw_fn=pure, op_attrs=op_attrs)
 
     if out_nd is not None:
         if multi:
@@ -108,6 +112,12 @@ def apply_fn(fn, nd_args, kwargs, *, name="", differentiable=True,
     return wrapped if multi else wrapped[0]
 
 
+# unary ops cheap enough to defer through a pending cached-op output
+# (consumed inside the fused executable; replayed eagerly if forced)
+_LAZY_UNARY = frozenset({"reshape", "Flatten", "expand_dims", "squeeze",
+                         "transpose", "cast"})
+
+
 def invoke(opname, *args, **kwargs):
     """Invoke a registered operator imperatively (the generated-stub entry,
     ref: python/mxnet/_ctypes/ndarray.py _imperative_invoke).
@@ -121,6 +131,15 @@ def invoke(opname, *args, **kwargs):
     if any(isinstance(a, _Sym) for a in args) or \
             any(isinstance(v, _Sym) for v in kwargs.values()):
         return apply_stub_args(opname, args, kwargs)
+    if (opname in _LAZY_UNARY and len(args) == 1 and "out" not in kwargs
+            and isinstance(args[0], NDArray)
+            and args[0]._pending is not None):
+        # shape-only op on a deferred cached-op output: stay lazy so the
+        # net→reshape→loss chain still fuses into one executable
+        from ..gluon.block import try_lazy_unary
+        lazy = try_lazy_unary(od, args[0], kwargs)
+        if lazy is not None:
+            return lazy
     if od.sparse_invoke is not None:
         # FComputeEx analogue: ops with a registered sparse path get
         # first refusal; NotImplemented falls through to dense dispatch
@@ -128,19 +147,22 @@ def invoke(opname, *args, **kwargs):
         if res is not NotImplemented:
             return res
     ctx = _resolve_ctx(args, kwargs)
+    sym_attrs = (od.name, {k: v for k, v in kwargs.items()
+                           if k != "out" and not k.startswith("_")})
     if od.needs_rng and "_rng_key" not in kwargs:
         kwargs["_rng_key"] = _rnd.split_key(ctx)
     if od.needs_training and "_training" not in kwargs:
         kwargs["_training"] = _ag.is_training()
     return apply_fn(od.fn, list(args), kwargs, name=od.name,
-                    differentiable=od.differentiable, ctx=ctx)
+                    differentiable=od.differentiable, ctx=ctx,
+                    attrs=sym_attrs)
 
 
 class NDArray:
     """Multi-dimensional array on a Context (ref: mx.nd.NDArray)."""
 
-    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_tape_node",
-                 "_out_index", "__weakref__")
+    __slots__ = ("_data_v", "_pending", "_ctx", "_grad", "_grad_req",
+                 "_tape_node", "_out_index", "__weakref__")
 
     def __init__(self, data, ctx: Optional[Context] = None, dtype=None):
         if isinstance(data, NDArray):
@@ -159,7 +181,8 @@ class NDArray:
             data = jax.device_put(npd, ctx.jax_device)
         elif dtype is not None:
             data = data.astype(dtype_np(dtype))
-        self._data = data
+        self._pending = None
+        self._data_v = data
         self._ctx = ctx or current_context()
         self._grad = None
         self._grad_req = None
@@ -167,23 +190,48 @@ class NDArray:
         self._out_index = 0
 
     # ------------------------------------------------------------------
-    # properties
+    # buffer access: lazy (deferred-dispatch) arrays force their pending
+    # program on first read — the async-engine WaitForVar analogue
+    # ------------------------------------------------------------------
+    @property
+    def _data(self):
+        if self._pending is not None:
+            self._pending.force()
+        return self._data_v
+
+    @_data.setter
+    def _data(self, value):
+        self._data_v = value
+        self._pending = None
+
+    # ------------------------------------------------------------------
+    # properties (answered from the pending program's avals when lazy —
+    # shape/dtype queries must not force a dispatch)
     # ------------------------------------------------------------------
     @property
     def shape(self):
-        return tuple(self._data.shape)
+        p = self._pending
+        if p is not None:
+            return tuple(p.aval_of(self)[0])
+        return tuple(self._data_v.shape)
 
     @property
     def dtype(self):
-        return _np.dtype(self._data.dtype)
+        p = self._pending
+        if p is not None:
+            return _np.dtype(p.aval_of(self)[1])
+        return _np.dtype(self._data_v.dtype)
 
     @property
     def size(self):
-        return int(self._data.size)
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
 
     @property
     def ndim(self):
-        return self._data.ndim
+        return len(self.shape)
 
     @property
     def context(self):

@@ -120,6 +120,44 @@ def _jit_multi_update(opname: str, static_kv: tuple, nparam: int,
     return jax.jit(f, donate_argnums=(0, 2))
 
 
+@functools.lru_cache(maxsize=None)
+def _jit_bwd_multi_update(opname: str, static_kv: tuple, nparam: int,
+                          nstates: int, gidx: tuple, gdtypes: tuple):
+    """Backward + aggregated update as ONE executable: applies the parked
+    vjp closure (the whole model backward) and feeds its gradients
+    straight into every parameter's update — the reference's bulked
+    backward segment flowing into multi_sgd_mom_update without touching
+    HBM-to-dispatch boundaries in between (SURVEY §3.3, §7.1 stage 4).
+
+    Weights are NOT donated: the same buffers appear inside the vjp
+    residuals, and donating a buffer that is also read elsewhere voids
+    the alias on real TPU.  States are safely donated.  The raw grads are
+    returned as outputs so Parameter.grad() keeps reference semantics."""
+    fn = _registry.get(opname).fn
+
+    def f(vjp_closure, cots, ws, states, lrs, wds, scalars):
+        g_all = vjp_closure(cots)
+        new_ws = []
+        new_states = tuple([] for _ in range(nstates))
+        gouts = []
+        for i in range(nparam):
+            g = g_all[gidx[i]].astype(gdtypes[i])
+            gouts.append(g)
+            sargs = tuple(states[j][i] for j in range(nstates))
+            out = fn(ws[i], g, *sargs, lr=lrs[i], wd=wds[i],
+                     **scalars, **dict(static_kv))
+            if nstates:
+                new_ws.append(out[0].astype(ws[i].dtype))
+                for j in range(nstates):
+                    new_states[j].append(out[1 + j].astype(
+                        states[j][i].dtype))
+            else:
+                new_ws.append(out.astype(ws[i].dtype))
+        return (tuple(new_ws), tuple(tuple(s) for s in new_states),
+                tuple(gouts))
+    return jax.jit(f, donate_argnums=(3,))
+
+
 _HYPER_CACHE = {}
 
 
@@ -142,18 +180,34 @@ def _hyper_array(values):
 
 
 def _fused_multi(opname, weights, grads, state_cols, lr_list, wd_list,
-                 scalars, static):
+                 scalars, static, bwd_pending=None):
     """Run the aggregated update.  `state_cols`: one list per state slot
-    (e.g. adam: [means, vars]), each parallel to `weights`."""
-    jf = _jit_multi_update(opname, tuple(sorted(static.items())),
-                           len(weights), len(state_cols))
-    ws = tuple(w._data for w in weights)
-    gs = tuple(g._data for g in grads)
-    sts = tuple(tuple(s._data for s in col) for col in state_cols)
+    (e.g. adam: [means, vars]), each parallel to `weights`.
+
+    When `bwd_pending` (a deferred autograd._PendingGrads) is given, the
+    whole model backward composes into the SAME executable as the update
+    — the imperative step's last two dispatches become one."""
     lrs = _hyper_array(lr_list)
     wds = _hyper_array(wd_list)
     scal = {k: _hyper_array(v) for k, v in scalars.items()}
-    new_ws, new_sts = jf(ws, gs, sts, lrs, wds, scal)
+    sts = tuple(tuple(s._data for s in col) for col in state_cols)
+    if bwd_pending is not None:
+        gidx = tuple(bwd_pending.index_for(g) for g in grads)
+        gdt = tuple(str(_np.dtype(g.dtype)) for g in grads)
+        jf = _jit_bwd_multi_update(opname, tuple(sorted(static.items())),
+                                   len(weights), len(state_cols), gidx,
+                                   gdt)
+        ws = tuple(w._data for w in weights)
+        new_ws, new_sts, gouts = jf(bwd_pending.vjp.closure,
+                                    bwd_pending.cots, ws, sts, lrs, wds,
+                                    scal)
+        bwd_pending.fulfill(zip(grads, gouts))
+    else:
+        jf = _jit_multi_update(opname, tuple(sorted(static.items())),
+                               len(weights), len(state_cols))
+        ws = tuple(w._data for w in weights)
+        gs = tuple(g._data for g in grads)
+        new_ws, new_sts = jf(ws, gs, sts, lrs, wds, scal)
     for w, nw in zip(weights, new_ws):
         w._data = nw
     for col, ncol in zip(state_cols, new_sts):
@@ -284,10 +338,16 @@ class Optimizer:
     # aggregated update: True on subclasses providing an update_multi
     # that batches every parameter into one executable
     aggregatable = False
+    # True on subclasses whose update_multi can compose a deferred
+    # backward (autograd._PendingGrads) into the update executable
+    supports_bwd_fusion = False
 
-    def update_multi(self, indices, weights, grads, states):
+    def update_multi(self, indices, weights, grads, states,
+                     bwd_pending=None):
         """Update many parameters at once (ref: aggregate_num /
         multi_sgd_* ops).  Default: per-param loop."""
+        if bwd_pending is not None:
+            bwd_pending.force()
         for i, w, g, s in zip(indices, weights, grads, states):
             self.update_multi_precision(i, w, g, s)
 
@@ -351,9 +411,14 @@ class SGD(Optimizer):
             weight._data, state._data = new_w, new_m
 
     aggregatable = True
+    supports_bwd_fusion = True
 
-    def update_multi(self, indices, weights, grads, states):
+    def update_multi(self, indices, weights, grads, states,
+                     bwd_pending=None):
         dense, sparse = self._split_sparse(indices, weights, grads, states)
+        if sparse and bwd_pending is not None:
+            bwd_pending.force()
+            bwd_pending = None
         for k in sparse:
             self.update(indices[k], weights[k], grads[k], states[k])
         if not dense:
@@ -368,12 +433,13 @@ class SGD(Optimizer):
         ws = [weights[k] for k in dense]
         gs = [grads[k] for k in dense]
         if self.momentum == 0.0:
-            _fused_multi("sgd_update", ws, gs, [], lrs, wds, scal, static)
+            _fused_multi("sgd_update", ws, gs, [], lrs, wds, scal, static,
+                         bwd_pending=bwd_pending)
         else:
             scal["momentum"] = self.momentum
             _fused_multi("sgd_mom_update", ws, gs,
                          [[states[k] for k in dense]], lrs, wds, scal,
-                         static)
+                         static, bwd_pending=bwd_pending)
 
 
 @register
@@ -440,9 +506,14 @@ class Adam(Optimizer):
         weight._data, mean._data, var._data = new_w, new_m, new_v
 
     aggregatable = True
+    supports_bwd_fusion = True
 
-    def update_multi(self, indices, weights, grads, states):
+    def update_multi(self, indices, weights, grads, states,
+                     bwd_pending=None):
         dense, sparse = self._split_sparse(indices, weights, grads, states)
+        if sparse and bwd_pending is not None:
+            bwd_pending.force()
+            bwd_pending = None
         for k in sparse:
             self.update(indices[k], weights[k], grads[k], states[k])
         if not dense:
@@ -464,7 +535,8 @@ class Adam(Optimizer):
                      [grads[k] for k in dense],
                      [[states[k][0] for k in dense],
                       [states[k][1] for k in dense]],
-                     lrs, wds, scal, static)
+                     lrs, wds, scal, static,
+                     bwd_pending=bwd_pending)
 
 
 @register

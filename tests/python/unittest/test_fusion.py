@@ -1,0 +1,310 @@
+"""Cross-block program fusion (deferred cached-op dispatch).
+
+The steady-state hybridized training step must run as TWO executables:
+net+loss forward(+vjp) fused into one program, backward+optimizer fused
+into one program (ref: cached_op.cc whole-segment graphs + bulked
+backward feeding multi_sgd_mom_update, SURVEY §3.2-3.3).  These tests
+pin (a) that fusion engages, (b) that every observable result — params,
+grads, BatchNorm running stats — is bit-comparable to the eager
+imperative path, and (c) that every bail-out path (forced reads, sparse
+grads, grad accumulation) stays correct.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon, autograd as ag, engine
+
+
+def _build(hybridize, seed=7):
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(32, activation="relu"))
+        net.add(gluon.nn.BatchNorm())
+        net.add(gluon.nn.Dense(10))
+    net.initialize()
+    if hybridize:
+        net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    if hybridize:
+        loss_fn.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    return net, loss_fn, trainer
+
+
+X = np.random.RandomState(11).randn(8, 16).astype(np.float32)
+Y = np.random.RandomState(12).randint(0, 10, 8).astype(np.float32)
+
+
+def _run_steps(hybridize, steps=5):
+    net, loss_fn, trainer = _build(hybridize)
+    x, y = nd.array(X), nd.array(Y)
+    for _ in range(steps):
+        with ag.record():
+            l = loss_fn(net(x), y)
+            l.backward()
+        trainer.step(8)
+    nd.waitall()
+    params = [p.data().asnumpy()
+              for p in net.collect_params().values()]
+    return params, float(l.asnumpy().mean())
+
+
+def test_fused_step_matches_imperative():
+    """Params (incl. momentum effects and BN running stats) after 5
+    fused-hybridized steps match the eager imperative path."""
+    p_h, l_h = _run_steps(True)
+    p_i, l_i = _run_steps(False)
+    assert np.isclose(l_h, l_i, rtol=1e-5)
+    for a, b in zip(p_h, p_i):
+        np.testing.assert_allclose(a, b, rtol=3e-5, atol=3e-6)
+
+
+def test_fusion_engages():
+    """Steady state dispatches ONE hooked forward program whose name
+    marks the net+loss fusion, and the trainer consumes the deferred
+    backward (grads concrete after step with no extra hook events)."""
+    net, loss_fn, trainer = _build(True)
+    x, y = nd.array(X), nd.array(Y)
+    events = []
+    listener = lambda name, ctx, dt: events.append(name)  # noqa: E731
+    engine.add_dispatch_listener(listener)
+    try:
+        for i in range(3):
+            events.clear()
+            with ag.record():
+                l = loss_fn(net(x), y)
+                l.backward()
+            trainer.step(8)
+        assert any("_fused" in e for e in events), events
+        # steady state: exactly one hooked dispatch (trace-time replays
+        # are gone after iteration 2)
+        assert len(events) == 1, events
+    finally:
+        engine.remove_dispatch_listener(listener)
+    for p in net.collect_params().values():
+        if p.grad_req != "null":
+            assert p.grad()._pending is None
+
+
+def test_reshape_chain_fuses():
+    """net(x).reshape(...) feeding a hybridized loss stays ONE fused
+    program (the BERT/GNMT benchmark pattern)."""
+    np.random.seed(3)
+    mx.random.seed(3)
+    net = gluon.nn.Dense(20)
+    net.initialize()
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    x = nd.array(np.random.randn(4, 6).astype(np.float32))
+    y = nd.array(np.random.randint(0, 10, (4, 2)).astype(np.float32))
+    events = []
+    listener = lambda name, ctx, dt: events.append(name)  # noqa: E731
+
+    def step():
+        with ag.record():
+            out = net(x)                       # (4, 20)
+            l = loss_fn(out.reshape((8, 10)), y.reshape((-1,)))
+            l.backward()
+        trainer.step(4)
+        return l
+
+    step(), step()
+    engine.add_dispatch_listener(listener)
+    try:
+        with ag.record():
+            out = net(x)
+            l = loss_fn(out.reshape((8, 10)), y.reshape((-1,)))
+            l.backward()
+    finally:
+        engine.remove_dispatch_listener(listener)
+    fused = [e for e in events if "_fused" in e]
+    assert fused, events
+    # parity with the unfused eager computation at the SAME params
+    ref = loss_fn(net(x).reshape((8, 10)), y.reshape((-1,)))
+    np.testing.assert_allclose(l.asnumpy(), ref.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    trainer.step(4)
+
+
+def test_forced_read_between_net_and_loss():
+    """Reading the net output (metrics pattern) forces the single-block
+    program; training still matches the imperative path.  NOTE: configs
+    run sequentially — deferred param init draws RNG at first forward,
+    so interleaved builds would shift the streams."""
+    def run_forced(steps=3):
+        net, loss_fn, trainer = _build(True, seed=21)
+        x, y = nd.array(X), nd.array(Y)
+        for _ in range(steps):
+            with ag.record():
+                out = net(x)
+                _ = out.asnumpy()      # force: breaks fusion, not math
+                l = loss_fn(out, y)
+                l.backward()
+            trainer.step(8)
+        nd.waitall()
+        return [p.data().asnumpy()
+                for p in net.collect_params().values()]
+
+    def run_imperative(steps=3):
+        net, loss_fn, trainer = _build(False, seed=21)
+        x, y = nd.array(X), nd.array(Y)
+        for _ in range(steps):
+            with ag.record():
+                l = loss_fn(net(x), y)
+                l.backward()
+            trainer.step(8)
+        nd.waitall()
+        return [p.data().asnumpy()
+                for p in net.collect_params().values()]
+
+    for a, b in zip(run_forced(), run_imperative()):
+        np.testing.assert_allclose(a, b, rtol=3e-5, atol=3e-6)
+
+
+def test_deferred_grads_force_on_read():
+    """param.grad() read before trainer.step (grad clipping pattern)
+    forces the deferred backward and yields correct gradients."""
+    x, y = nd.array(X), nd.array(Y)
+
+    def grads(n, lf):
+        with ag.record():
+            l = lf(n(x), y)
+            l.backward()
+        return {k: p.grad().asnumpy()
+                for k, p in n.collect_params().items()
+                if p.grad_req != "null"}
+
+    net, loss_fn, _tr = _build(True, seed=5)
+    grads(net, loss_fn)          # warmup: builds caches, second defers
+    g_h = grads(net, loss_fn)
+    net_i, loss_i, _tri = _build(False, seed=5)
+    grads(net_i, loss_i)
+    g_i = grads(net_i, loss_i)
+    for a, b in zip(sorted(g_h), sorted(g_i)):
+        np.testing.assert_allclose(g_h[a], g_i[b], rtol=3e-5, atol=3e-6)
+
+
+def test_two_backwards_without_step():
+    """grad_req='write': a second backward overwrites a still-deferred
+    first backward without corrupting either."""
+    net, loss_fn, trainer = _build(True, seed=9)
+    x, y = nd.array(X), nd.array(Y)
+    for _ in range(2):
+        with ag.record():
+            l = loss_fn(net(x), y)
+            l.backward()
+    with ag.record():
+        l = loss_fn(net(x), y)
+        l.backward()
+    trainer.step(8)
+    nd.waitall()
+    for p in net.collect_params().values():
+        if p.grad_req != "null":
+            assert np.isfinite(p.grad().asnumpy()).all()
+
+
+def test_record_scope_exit_flushes():
+    """A pending forward left unconsumed materialises at record-scope
+    exit (BatchNorm running stats must update exactly once)."""
+    net, _lf, _tr = _build(True, seed=13)
+    x = nd.array(X)
+    net(x)  # trace (eager first call)
+    bn = [p for k, p in net.collect_params().items()
+          if "running_mean" in k][0]
+    before = bn.data().asnumpy().copy()
+    with ag.record():
+        out = net(x)        # deferred; never consumed
+    after = bn.data().asnumpy()
+    assert out._pending is None     # flushed at scope exit
+    assert not np.allclose(before, after)   # stats updated
+
+
+def test_grad_add_falls_back():
+    """grad_req='add' (gradient accumulation) takes the eager backward
+    and accumulates across two backwards."""
+    net, loss_fn, _tr = _build(True, seed=17)
+    for p in net.collect_params().values():
+        p.grad_req = "add"
+    x, y = nd.array(X), nd.array(Y)
+    with ag.record():
+        l = loss_fn(net(x), y)
+        l.backward()
+    g1 = {k: p.grad().asnumpy().copy()
+          for k, p in net.collect_params().items()}
+    with ag.record():
+        l = loss_fn(net(x), y)
+        l.backward()
+    for k, p in net.collect_params().items():
+        np.testing.assert_allclose(p.grad().asnumpy(), 2 * g1[k],
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_xform_as_backward_head():
+    """A lazy reshape of a deferred cached-op output used directly as
+    the backward head must materialise with a tape node (review r3)."""
+    np.random.seed(23)
+    mx.random.seed(23)
+    net = gluon.nn.Dense(6)
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.randn(4, 3).astype(np.float32))
+    for p in net.collect_params().values():
+        p.grad_req = "write"
+    with ag.record():          # warmup: trace + avals
+        y = net(x)
+        y.backward()
+    g_ref = {k: p.grad().asnumpy().copy()
+             for k, p in net.collect_params().items()}
+    with ag.record():          # steady state: deferred + lazy reshape
+        y = net(x).reshape((2, 12))
+        y.backward()
+    for k, p in net.collect_params().items():
+        np.testing.assert_allclose(p.grad().asnumpy(), g_ref[k],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_dangling_xform_materialises_at_scope_exit():
+    """An unconsumed lazy reshape still yields data (and a tape node)
+    after the record scope closes."""
+    np.random.seed(29)
+    mx.random.seed(29)
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.randn(2, 3).astype(np.float32))
+    with ag.record():
+        net(x)                 # warmup
+    with ag.record():
+        y = net(x).reshape((4, 2))
+    assert y.shape == (4, 2)
+    assert np.isfinite(y.asnumpy()).all()
+    assert y._tape_node is not None
+
+
+def test_batch_size_change_reports_true_shapes():
+    """The deferred path must never serve avals recorded for another
+    batch size (review r3): a final partial batch reports its own
+    shapes and trains correctly."""
+    net, loss_fn, trainer = _build(True, seed=31)
+    x8, y8 = nd.array(X), nd.array(Y)
+    x4, y4 = nd.array(X[:4]), nd.array(Y[:4])
+    for _ in range(2):                 # steady state at b8
+        with ag.record():
+            l = loss_fn(net(x8), y8)
+            l.backward()
+        trainer.step(8)
+    with ag.record():
+        out = net(x4)                  # partial batch
+        assert out.shape == (4, 10), out.shape
+        l = loss_fn(out, y4)
+        l.backward()
+    trainer.step(4)
+    assert l.shape == (4,)
+    assert np.isfinite(l.asnumpy()).all()
