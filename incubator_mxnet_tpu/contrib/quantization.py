@@ -382,8 +382,17 @@ def quantize_net(net, quantized_dtype="int8", exclude_layers=None,
         for h in hooks:
             h.detach()
         for _parent, _name, path, _child in sites:
-            ranges[path] = (collector.range_of(path + ":in"),
-                            collector.range_of(path + ":out"))
+            try:
+                ranges[path] = (collector.range_of(path + ":in"),
+                                collector.range_of(path + ":out"))
+            except KeyError:
+                # child never exercised by the calibration forwards
+                # (dead / conditional branch): fall back to dynamic
+                # ranges, matching quantize_model's tolerance
+                log.warning(
+                    "layer %s saw no calibration data; using dynamic "
+                    "quantization ranges", path)
+                ranges[path] = (None, None)
         log.info("calibrated %d layers over %d batches (%s)",
                  len(sites), n, calib_mode)
 
@@ -479,6 +488,11 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
              else built[nodes[h[0]]["name"]][h[1]]
              for h in graph["heads"]]
     qsym = heads[0] if len(heads) == 1 else S.Group(heads)
+    # drop params the rewritten graph no longer consumes (the fp32
+    # weights of quantized layers live on as *_quantize tensors only) —
+    # keeping both would double checkpoint/param memory vs the reference
+    live = set(qsym.list_arguments())
+    qarg = {k: v for k, v in qarg.items() if k in live}
     return qsym, qarg, dict(aux_params)
 
 

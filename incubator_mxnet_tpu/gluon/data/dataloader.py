@@ -107,17 +107,15 @@ class DataLoader:
                 # spawn, not fork: forking a process that holds live JAX
                 # runtime threads deadlocks the child (the reference used
                 # fork + cpu_shared IPC; PJRT rules that out).  Spawn
-                # must pickle the dataset — fall back to threads when it
-                # can't (e.g. transform_first(lambda ...)).
-                import io as _io
-                import pickle
+                # pickles the dataset into each worker at pool start, so
+                # that attempt IS the picklability probe — no separate
+                # serialization pass (a multi-GB in-memory dataset would
+                # pay a full extra pickle walk just to pre-check).
                 try:
-                    # stream to a sink: no serialized copy is retained
-                    # (a multi-GB dataset would double peak RSS)
-                    class _Sink(_io.RawIOBase):
-                        def write(self, b):
-                            return len(b)
-                    pickle.dump(self._dataset, _Sink())
+                    ctx = _mp.get_context("spawn")
+                    self._pool = ctx.Pool(self._num_workers,
+                                          initializer=_worker_init,
+                                          initargs=(self._dataset,))
                 except Exception:
                     import warnings
                     warnings.warn(
@@ -129,11 +127,6 @@ class DataLoader:
             if thread_pool:
                 from multiprocessing.dummy import Pool as _ThreadPool
                 self._pool = _ThreadPool(self._num_workers)
-            else:
-                ctx = _mp.get_context("spawn")
-                self._pool = ctx.Pool(self._num_workers,
-                                      initializer=_worker_init,
-                                      initargs=(self._dataset,))
 
     def __iter__(self):
         if self._pool is not None:

@@ -197,10 +197,23 @@ class ndarray(NDArray):
                       differentiable=False)
 
     def __eq__(self, o):
-        return self._cmp(o, jnp.equal, "np_equal")
+        r = self._cmp(o, jnp.equal, "np_equal")
+        if r is NotImplemented:
+            # NumPy semantics: comparing against a non-numeric operand
+            # (None, str, object) yields an elementwise all-False array,
+            # never Python's identity fallback
+            return _apply(lambda x: jnp.zeros(x.shape, jnp.bool_),
+                          (self,), {}, name="np_equal",
+                          differentiable=False)
+        return r
 
     def __ne__(self, o):
-        return self._cmp(o, jnp.not_equal, "np_not_equal")
+        r = self._cmp(o, jnp.not_equal, "np_not_equal")
+        if r is NotImplemented:
+            return _apply(lambda x: jnp.ones(x.shape, jnp.bool_),
+                          (self,), {}, name="np_not_equal",
+                          differentiable=False)
+        return r
 
     def __lt__(self, o):
         return self._cmp(o, jnp.less, "np_less")

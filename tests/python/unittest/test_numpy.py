@@ -336,3 +336,18 @@ def test_np_style_custom_block_hybridizes():
     loss.backward()
     assert onp.isfinite(x.grad.asnumpy()).all()
     assert onp.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_np_eq_ne_non_numeric_operand():
+    """NumPy semantics: == / != against None or a string returns an
+    elementwise boolean array, never Python's identity fallback
+    (advisor round-2)."""
+    a = np.array([1.0, 2.0, 3.0])
+    eq = a == "not-an-array"
+    ne = a != "not-an-array"
+    assert eq.shape == (3,) and eq.dtype == onp.bool_
+    assert not eq.asnumpy().any()
+    assert ne.asnumpy().all()
+    eq_none = a == None                                   # noqa: E711
+    assert eq_none.shape == (3,) and not eq_none.asnumpy().any()
+    assert (a != None).asnumpy().all()                    # noqa: E711

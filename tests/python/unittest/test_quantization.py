@@ -251,3 +251,49 @@ def test_quantized_elemwise_add_vs_fp32():
     got = nd.invoke("_contrib_dequantize", acc, mn, mx_).asnumpy()
     want = a + b
     assert onp.abs(got - want).max() / onp.abs(want).max() < 0.05
+
+
+def test_quantize_net_unexercised_child():
+    """A quantizable child never reached by the calibration forwards
+    (dead/conditional branch) must fall back to dynamic ranges instead
+    of raising KeyError (advisor round-2, medium)."""
+    rs = onp.random.RandomState(9)
+
+    class Branchy(mx.gluon.nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.used = mx.gluon.nn.Dense(8, in_units=16)
+                self.dead = mx.gluon.nn.Dense(8, in_units=16)
+
+        def hybrid_forward(self, F, x):
+            return self.used(x)          # self.dead never called
+
+    net = Branchy()
+    net.initialize()
+    xs = [nd.array(rs.randn(4, 16).astype(onp.float32))
+          for _ in range(2)]
+    want = net(xs[0]).asnumpy()
+    qnet = qz.quantize_net(net, calib_data=xs, calib_mode="naive",
+                           num_calib_batches=2)
+    got = qnet(xs[0]).asnumpy()
+    rel = onp.abs(got - want).max() / (onp.abs(want).max() + 1e-8)
+    assert rel < 0.1
+
+
+def test_quantize_model_drops_replaced_fp32_params():
+    """quantize_model must not keep fp32 weights the rewritten graph no
+    longer references (advisor round-2: ~2x checkpoint size)."""
+    import incubator_mxnet_tpu.symbol as S
+    rs = onp.random.RandomState(10)
+    data = S.var("data")
+    fc = S.FullyConnected(data, S.var("fc_weight"), S.var("fc_bias"),
+                          num_hidden=8, name="fc")
+    arg_params = {"fc_weight": nd.array(rs.randn(8, 16)
+                                        .astype(onp.float32)),
+                  "fc_bias": nd.array(rs.randn(8).astype(onp.float32))}
+    qsym, qarg, _aux = qz.quantize_model(
+        fc, arg_params, {}, calib_mode="none")
+    live = set(qsym.list_arguments())
+    assert set(qarg) <= live
+    assert "fc_weight" not in qarg or "fc_weight" in live
