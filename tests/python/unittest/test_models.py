@@ -34,6 +34,11 @@ def test_bert_mlm_convergence_smoke():
         losses.append(float(l.asnumpy().mean()))
     assert losses[-1] < losses[0] * 0.5, \
         "MLM loss did not converge: %s -> %s" % (losses[0], losses[-1])
+    # quality threshold, not just loss movement (ref:
+    # tests/python/train asserts accuracy > threshold)
+    pred = net(tokens).reshape((B * T, -1)).asnumpy().argmax(axis=1)
+    acc = float((pred == labels.asnumpy().reshape(-1)).mean())
+    assert acc >= 0.9, "MLM train accuracy %.3f < 0.9" % acc
 
 
 def test_resnet_classification_convergence_smoke():
@@ -63,6 +68,14 @@ def test_resnet_classification_convergence_smoke():
             first = float(l.asnumpy().mean())
     last = float(l.asnumpy().mean())
     assert last < first * 0.5, (first, last)
+    # accuracy threshold (ref: tests/python/train/test_conv.py asserts
+    # final train accuracy > 0.93 on MNIST; same contract, synthetic).
+    # train_mode: batch statistics — predict-mode BN running stats need
+    # ~80 steps to catch up (momentum 0.9), which this smoke doesn't run
+    with ag.train_mode():
+        pred = net(xb).asnumpy().argmax(axis=1)
+    acc = float((pred == y).mean())
+    assert acc >= 0.93, "train accuracy %.3f < 0.93" % acc
 
 
 def test_seq2seq_copy_convergence():
@@ -93,6 +106,11 @@ def test_seq2seq_copy_convergence():
         if first is None:
             first = last
     assert last < first * 0.3, (first, last)
+    # copy-task token accuracy ≥ 0.9 (quality threshold, ref:
+    # tests/python/train contract)
+    pred = net(src, dec_in).reshape((B * T, -1)).asnumpy().argmax(axis=1)
+    tok_acc = float((pred == src_np.reshape(-1)).mean())
+    assert tok_acc >= 0.9, "copy-task token accuracy %.3f < 0.9" % tok_acc
 
 
 def test_gnmt_bucketing_module_training():
@@ -136,3 +154,32 @@ def test_gnmt_bucketing_module_training():
     assert len(bm._buckets) == 3                # all buckets compiled
     assert np.mean(losses[-9:]) < np.mean(losses[:3]) * 0.75, \
         (np.mean(losses[:3]), np.mean(losses[-9:]))
+
+
+def test_wide_deep_accuracy_threshold():
+    """Config 5 quality threshold: Wide&Deep separates a synthetic
+    feature-presence rule to ≥0.9 train accuracy (ref:
+    tests/python/train contract — accuracy, not loss movement)."""
+    from incubator_mxnet_tpu.models import wide_deep
+    rs = np.random.RandomState(4)
+    B, F, V = 64, 8, 200
+    idx_np = rs.randint(0, V, (B, F)).astype(np.int32)
+    val_np = rs.rand(B, F).astype(np.float32)
+    # label: does the row contain any "hot" feature id (< 20)?
+    y_np = (idx_np < 20).any(axis=1).astype(np.float32)
+
+    net = wide_deep(num_features=V, embed_dim=8, hidden=(32,))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    idx, vals, y = (nd.array(idx_np, dtype="int32"), nd.array(val_np),
+                    nd.array(y_np))
+    for _ in range(80):
+        with ag.record():
+            l = loss_fn(net(idx, vals), y)
+            l.backward()
+        trainer.step(B)
+    pred = net(idx, vals).asnumpy().argmax(axis=1)
+    acc = float((pred == y_np).mean())
+    assert acc >= 0.9, "wide&deep train accuracy %.3f < 0.9" % acc
