@@ -1,30 +1,79 @@
-"""mx.contrib.onnx (ref: python/mxnet/contrib/onnx/ — import_model /
-export_model over the onnx package).
+"""mx.contrib.onnx — ONNX interchange WITHOUT the onnx package.
 
-The `onnx` package is not part of this build's frozen environment, so
-both directions raise with a pointer to the supported interchange paths
-(HybridBlock.export symbol+params JSON, and DLPack for in-memory
-tensors).  The API names match the reference so callers fail at the
-call site, not at import."""
+ref: python/mxnet/contrib/onnx/ (mx2onnx.export_model /
+onnx2mx.import_model).  The frozen environment ships no `onnx` or
+`protobuf` package, so this build speaks the stable protobuf wire
+format directly (_proto.py) and converts ops through explicit tables
+(_export.py / _import.py) — opset 13, ir_version 7.  Everything this
+build exports round-trips through import_model; foreign models using
+the common CNN/MLP op subset import too.  Ops outside the tables raise
+with the supported list — loud, not lossy.
+"""
 from __future__ import annotations
 
-__all__ = ["import_model", "export_model", "get_model_metadata"]
+from ...base import MXNetError
 
-_MSG = ("mx.contrib.onnx requires the 'onnx' package, which is not "
-        "available in this environment (no egress to install it). "
-        "Supported interchange: HybridBlock.export()/SymbolBlock.imports "
-        "for whole models, mx.nd.to_dlpack_for_read/from_dlpack for "
-        "tensors.")
+__all__ = ["import_model", "export_model", "get_model_metadata",
+           "import_to_gluon"]
+
+
+def export_model(sym, params, input_shape, input_type="float32",
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export a Symbol (or a path to an exported ``-symbol.json``) +
+    params (dict of NDArray, or a ``.params`` file path) to an ONNX
+    file.  Returns the output path (ref: mx2onnx.export_model API)."""
+    from ... import symbol as S
+    from ... import ndarray as nd
+    from ._export import convert_symbol
+
+    if isinstance(sym, str):
+        sym = S.load(sym)
+    if isinstance(params, str):
+        params = nd.load(params)
+    model_bytes = convert_symbol(sym, dict(params or {}), input_shape,
+                                 input_dtype=input_type)
+    with open(onnx_file_path, "wb") as f:
+        f.write(model_bytes)
+    if verbose:
+        print("exported %d bytes to %s" % (len(model_bytes),
+                                           onnx_file_path))
+    return onnx_file_path
 
 
 def import_model(model_file):
-    raise NotImplementedError(_MSG)
+    """Load an ONNX file → (sym, arg_params, aux_params)
+    (ref: onnx2mx.import_model API)."""
+    from ._import import import_graph
+    with open(model_file, "rb") as f:
+        data = f.read()
+    return import_graph(data)
 
 
-def export_model(sym, params, input_shape, input_type=None,
-                 onnx_file_path="model.onnx", verbose=False):
-    raise NotImplementedError(_MSG)
+def import_to_gluon(model_file, ctx=None):
+    """Load an ONNX file as a ready-to-run SymbolBlock
+    (ref: onnx2mx.import_to_gluon)."""
+    from ...gluon import SymbolBlock
+    from ... import symbol as S
+    sym, arg_params, aux_params = import_model(model_file)
+    data_names = [n for n in sym.list_arguments()
+                  if n not in arg_params and n not in aux_params]
+    inputs = [S.var(n) for n in data_names]
+    net = SymbolBlock(sym, inputs)
+    for name, arr in {**arg_params, **aux_params}.items():
+        if name in net._params._params:
+            net._params._params[name]._load_and_set(arr, ctx)
+    return net
 
 
 def get_model_metadata(model_file):
-    raise NotImplementedError(_MSG)
+    """Input/output tensor names+shapes of an ONNX file
+    (ref: onnx2mx.get_model_metadata)."""
+    from ._import import parse_model
+    with open(model_file, "rb") as f:
+        model = parse_model(f.read())
+    init_names = set(model["initializers"])
+    return {
+        "input_tensor_data": [(n, s) for n, s in model["inputs"]
+                              if n not in init_names],
+        "output_tensor_data": list(model["outputs"]),
+    }

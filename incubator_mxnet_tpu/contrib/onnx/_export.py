@@ -1,0 +1,371 @@
+"""Symbol graph → ONNX ModelProto bytes (mx2onnx).
+
+ref: python/mxnet/contrib/onnx/mx2onnx/ — an op-conversion registry
+walking the nnvm json graph.  Same shape here: walk `sym.tojson()`
+topologically, convert each node through _CONVERTERS, serialise with the
+dependency-free wire codec in _proto.py (no onnx package needed to
+WRITE the format).  Target: opset 13, ir_version 7.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import _proto as P
+
+OPSET = 13
+IR_VERSION = 7
+
+# ONNX TensorProto.DataType
+DT_FLOAT, DT_INT32, DT_INT64 = 1, 6, 7
+_NP2DT = {"float32": DT_FLOAT, "int32": DT_INT32, "int64": DT_INT64}
+_DT2NP = {v: k for k, v in _NP2DT.items()}
+
+# AttributeProto.AttributeType
+_AT_FLOAT, _AT_INT, _AT_STRING, _AT_TENSOR = 1, 2, 3, 4
+_AT_FLOATS, _AT_INTS, _AT_STRINGS = 6, 7, 8
+
+
+def _attr(name, value):
+    b = P.f_string(1, name)
+    if isinstance(value, bool):
+        value = int(value)
+    if isinstance(value, float):
+        b += P.f_float(2, value) + P.f_varint(20, _AT_FLOAT)
+    elif isinstance(value, int):
+        b += P.f_varint(3, value) + P.f_varint(20, _AT_INT)
+    elif isinstance(value, str):
+        b += P.f_bytes(4, value.encode()) + P.f_varint(20, _AT_STRING)
+    elif isinstance(value, bytes):      # pre-serialised TensorProto
+        b += P.f_bytes(5, value) + P.f_varint(20, _AT_TENSOR)
+    elif isinstance(value, (tuple, list)):
+        if value and isinstance(value[0], float):
+            b += b"".join(P.f_float(7, v) for v in value)
+            b += P.f_varint(20, _AT_FLOATS)
+        else:
+            b += b"".join(P.f_varint(8, int(v)) for v in value)
+            b += P.f_varint(20, _AT_INTS)
+    else:
+        raise MXNetError("onnx attr %s: unsupported type %r"
+                         % (name, type(value)))
+    return b
+
+
+def _node(op_type, inputs, outputs, name, **attrs):
+    b = b"".join(P.f_string(1, i) for i in inputs)
+    b += b"".join(P.f_string(2, o) for o in outputs)
+    b += P.f_string(3, name) + P.f_string(4, op_type)
+    b += b"".join(P.f_bytes(5, _attr(k, v)) for k, v in attrs.items())
+    return b
+
+
+def tensor_proto(name, arr):
+    arr = _np.ascontiguousarray(arr)
+    dt = _NP2DT.get(str(arr.dtype))
+    if dt is None:
+        arr = arr.astype(_np.float32)
+        dt = DT_FLOAT
+    b = P.f_packed_varints(1, arr.shape)
+    b += P.f_varint(2, dt)
+    b += P.f_string(8, name)
+    b += P.f_bytes(9, arr.tobytes())
+    return b
+
+
+def _value_info(name, shape, dt=DT_FLOAT):
+    """shape=None omits the TensorShapeProto entirely ("shape unknown");
+    an empty tuple would declare RANK 0 — a scalar — which strict ONNX
+    checkers reject for non-scalar tensors."""
+    ttype = P.f_varint(1, dt)
+    if shape is not None:
+        dims = b"".join(P.f_bytes(1, P.f_varint(1, int(d)))
+                        for d in shape)
+        ttype += P.f_bytes(2, dims)
+    return P.f_string(1, name) + P.f_bytes(2, P.f_bytes(1, ttype))
+
+
+def _parse(v):
+    if not isinstance(v, str):
+        return v
+    import ast
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def _pads2(attrs, default=(0, 0)):
+    p = tuple(attrs.get("pad", default) or default)
+    return list(p) + list(p)        # (h, w) → [h, w, h, w]
+
+
+class _Ctx:
+    """Per-export state a converter can touch: extra initializers and a
+    monotone counter for synthesized tensor names."""
+
+    def __init__(self):
+        self.extra_init = []
+        self.n = 0
+
+    def const(self, arr, hint="const"):
+        name = "_onnx_%s_%d" % (hint, self.n)
+        self.n += 1
+        self.extra_init.append(tensor_proto(name, arr))
+        return name
+
+
+def _cv_fc(name, ins, attrs, ctx):
+    nh = int(attrs["num_hidden"])
+    no_bias = bool(attrs.get("no_bias", False))
+    flat = name + "_flat"
+    nodes = [_node("Flatten", [ins[0]], [flat], name + "_flatten",
+                   axis=1)]
+    gemm_in = [flat, ins[1]]
+    gemm_in.append(ctx.const(_np.zeros(nh, _np.float32), "zb")
+                   if no_bias else ins[2])
+    nodes.append(_node("Gemm", gemm_in, [name], name, alpha=1.0,
+                       beta=1.0, transA=0, transB=1))
+    return nodes
+
+
+def _cv_conv(name, ins, attrs, ctx):
+    kw = dict(kernel_shape=list(attrs["kernel"]),
+              strides=list(attrs.get("stride") or (1, 1)),
+              pads=_pads2(attrs),
+              dilations=list(attrs.get("dilate") or (1, 1)),
+              group=int(attrs.get("num_group", 1)))
+    inputs = list(ins[:2]) if attrs.get("no_bias") else list(ins[:3])
+    return [_node("Conv", inputs, [name], name, **kw)]
+
+
+def _cv_act(name, ins, attrs, ctx):
+    m = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+         "softrelu": "Softplus", "softsign": "Softsign"}
+    t = attrs.get("act_type", "relu")
+    if t not in m:
+        raise MXNetError("onnx export: Activation act_type %r" % t)
+    return [_node(m[t], [ins[0]], [name], name)]
+
+
+def _cv_bn(name, ins, attrs, ctx):
+    # inputs: data, gamma, beta, moving_mean, moving_var
+    return [_node("BatchNormalization", list(ins[:5]), [name], name,
+                  epsilon=float(attrs.get("eps", 1e-5)),
+                  momentum=float(attrs.get("momentum", 0.9)))]
+
+
+def _cv_pool(name, ins, attrs, ctx):
+    pt = attrs.get("pool_type", "max")
+    if attrs.get("global_pool"):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[pt]
+        return [_node(op, [ins[0]], [name], name)]
+    kw = dict(kernel_shape=list(attrs["kernel"]),
+              strides=list(attrs.get("stride") or (1, 1)),
+              pads=_pads2(attrs))
+    if pt == "avg":
+        kw["count_include_pad"] = 1 \
+            if attrs.get("count_include_pad", True) else 0
+        return [_node("AveragePool", [ins[0]], [name], name, **kw)]
+    return [_node("MaxPool", [ins[0]], [name], name, **kw)]
+
+
+def _cv_reshape(name, ins, attrs, ctx):
+    shape = [int(s) for s in attrs["shape"]]
+    if any(s in (-2, -3, -4) for s in shape):
+        raise MXNetError("onnx export: reshape special codes -2/-3/-4 "
+                         "have no ONNX equivalent")
+    shp = ctx.const(_np.asarray(shape, _np.int64), "shape")
+    return [_node("Reshape", [ins[0], shp], [name], name)]
+
+
+def _cv_leaky(name, ins, attrs, ctx):
+    t = attrs.get("act_type", "leaky")
+    slope = float(attrs.get("slope", 0.25))
+    if t == "leaky":
+        return [_node("LeakyRelu", [ins[0]], [name], name, alpha=slope)]
+    if t == "elu":
+        return [_node("Elu", [ins[0]], [name], name, alpha=slope)]
+    if t == "prelu":
+        return [_node("PRelu", list(ins[:2]), [name], name)]
+    raise MXNetError("onnx export: LeakyReLU act_type %r" % t)
+
+
+def _cv_scalar(onnx_op, swap=False):
+    def cv(name, ins, attrs, ctx):
+        c = ctx.const(_np.asarray(float(attrs["scalar"]), _np.float32),
+                      "scalar")
+        inputs = [c, ins[0]] if swap else [ins[0], c]
+        return [_node(onnx_op, inputs, [name], name)]
+    return cv
+
+
+def _cv_simple(onnx_op, n_in=1, **fixed):
+    """fixed: onnx_attr_name=(mxnet_attr_key, default, converter)."""
+    def cv(name, ins, attrs, ctx):
+        kw = {}
+        for onnx_key, (mx_key, default, conv) in fixed.items():
+            v = attrs.get(mx_key, default)
+            if v is not None:
+                kw[onnx_key] = conv(v)
+        return [_node(onnx_op, list(ins[:n_in]), [name], name, **kw)]
+    return cv
+
+
+def _cv_axes_input(onnx_op, attr_key="axis", **extra):
+    """opset-13 ops whose axes moved from attribute to int64 input.
+    extra: onnx_attr=(mxnet_key, default, conv) passthroughs."""
+    def cv(name, ins, attrs, ctx):
+        kw = {}
+        for onnx_key, (mx_key, default, conv) in extra.items():
+            v = attrs.get(mx_key, default)
+            if v is not None:
+                kw[onnx_key] = conv(v)
+        ax = attrs.get(attr_key)
+        if ax is None:
+            return [_node(onnx_op, [ins[0]], [name], name, **kw)]
+        if isinstance(ax, int):
+            ax = [ax]
+        c = ctx.const(_np.asarray(list(ax), _np.int64), "axes")
+        return [_node(onnx_op, [ins[0], c], [name], name, **kw)]
+    return cv
+
+
+def _cv_dropout(name, ins, attrs, ctx):
+    # inference export: dropout is identity
+    return [_node("Identity", [ins[0]], [name], name)]
+
+
+_CONVERTERS = {
+    "FullyConnected": _cv_fc,
+    "Convolution": _cv_conv,
+    "Activation": _cv_act,
+    "BatchNorm": _cv_bn,
+    "Pooling": _cv_pool,
+    "reshape": _cv_reshape,
+    "Reshape": _cv_reshape,
+    "LeakyReLU": _cv_leaky,
+    "Dropout": _cv_dropout,
+    "Flatten": _cv_simple("Flatten", axis=("axis", 1, int)),
+    "flatten": _cv_simple("Flatten", axis=("axis", 1, int)),
+    "softmax": _cv_simple("Softmax", axis=("axis", -1, int)),
+    "log_softmax": _cv_simple("LogSoftmax", axis=("axis", -1, int)),
+    "relu": _cv_simple("Relu"),
+    "sigmoid": _cv_simple("Sigmoid"),
+    "tanh": _cv_simple("Tanh"),
+    "exp": _cv_simple("Exp"),
+    "sqrt": _cv_simple("Sqrt"),
+    "elemwise_add": _cv_simple("Add", n_in=2),
+    "broadcast_add": _cv_simple("Add", n_in=2),
+    "elemwise_sub": _cv_simple("Sub", n_in=2),
+    "broadcast_sub": _cv_simple("Sub", n_in=2),
+    "elemwise_mul": _cv_simple("Mul", n_in=2),
+    "broadcast_mul": _cv_simple("Mul", n_in=2),
+    "elemwise_div": _cv_simple("Div", n_in=2),
+    "broadcast_div": _cv_simple("Div", n_in=2),
+    "dot": _cv_simple("MatMul", n_in=2),
+    "_plus_scalar": _cv_scalar("Add"),
+    "_minus_scalar": _cv_scalar("Sub"),
+    "_mul_scalar": _cv_scalar("Mul"),
+    "_div_scalar": _cv_scalar("Div"),
+    "Concat": _cv_simple("Concat", n_in=99, axis=("dim", 1, int)),
+    "concat": _cv_simple("Concat", n_in=99, axis=("dim", 1, int)),
+    "transpose": _cv_simple("Transpose", perm=("axes", None, list)),
+    "expand_dims": _cv_axes_input("Unsqueeze"),
+    "squeeze": _cv_axes_input("Squeeze"),
+    "sum": _cv_axes_input("ReduceSum",
+                          keepdims=("keepdims", False, int)),
+    "add_n": _cv_simple("Sum", n_in=99),
+    "identity": _cv_simple("Identity"),
+    "_copy": _cv_simple("Identity"),
+    "BlockGrad": _cv_simple("Identity"),
+}
+
+
+def convert_symbol(sym, params, input_shapes, input_dtype="float32",
+                   graph_name="mxnet_graph"):
+    """Build ONNX ModelProto bytes from a Symbol + params dict.
+
+    `input_shapes`: dict name→shape, or a list of shapes matched to the
+    graph's non-param variable nodes in argument order."""
+    graph = json.loads(sym.tojson())
+    nodes_j = graph["nodes"]
+    heads = graph["heads"]
+
+    params = {(k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
+               else k): v for k, v in params.items()}
+
+    data_names = [n["name"] for n in nodes_j
+                  if n["op"] == "null" and n["name"] not in params]
+    if not isinstance(input_shapes, dict):
+        if len(input_shapes) and not isinstance(
+                input_shapes[0], (list, tuple)):
+            input_shapes = [input_shapes]
+        if len(input_shapes) != len(data_names):
+            raise MXNetError(
+                "onnx export: %d input shapes for inputs %s"
+                % (len(input_shapes), data_names))
+        input_shapes = dict(zip(data_names, input_shapes))
+
+    ctx = _Ctx()
+    onnx_nodes = []
+    out_name = {}               # (node_idx, out_idx) -> tensor name
+
+    for idx, nj in enumerate(nodes_j):
+        op, name = nj["op"], nj["name"]
+        if op == "null":
+            out_name[(idx, 0)] = name
+            continue
+        attrs = {k: _parse(v) for k, v in nj.get("attrs", {}).items()}
+        ins = []
+        for e in nj["inputs"]:
+            ekey = (e[0], e[1] if len(e) > 1 else 0)
+            if ekey not in out_name:
+                raise MXNetError(
+                    "onnx export: node %s consumes output %d of %s — "
+                    "secondary outputs of multi-output ops are not "
+                    "convertible" % (name, ekey[1],
+                                     nodes_j[e[0]]["name"]))
+            ins.append(out_name[ekey])
+        cv = _CONVERTERS.get(op)
+        if cv is None:
+            raise MXNetError(
+                "onnx export: no converter for op %r (node %s); "
+                "supported: %s" % (op, name,
+                                   sorted(_CONVERTERS)))
+        onnx_nodes.extend(cv(name, ins, attrs, ctx))
+        out_name[(idx, 0)] = name
+
+    dt = _NP2DT[str(_np.dtype(input_dtype))]
+    g = b"".join(P.f_bytes(1, n) for n in onnx_nodes)
+    g += P.f_string(2, graph_name)
+    for pname, arr in params.items():
+        npv = arr.asnumpy() if hasattr(arr, "asnumpy") else \
+            _np.asarray(arr)
+        g += P.f_bytes(5, tensor_proto(pname, npv))
+    for dname in data_names:
+        g += P.f_bytes(11, _value_info(dname, input_shapes[dname], dt))
+    # params are graph inputs too in ONNX (with matching initializers)
+    for pname, arr in params.items():
+        npv = arr.asnumpy() if hasattr(arr, "asnumpy") else \
+            _np.asarray(arr)
+        g += P.f_bytes(11, _value_info(
+            pname, npv.shape, _NP2DT.get(str(npv.dtype), DT_FLOAT)))
+    for t in ctx.extra_init:
+        g += P.f_bytes(5, t)
+    for h in heads:
+        hkey = (h[0], h[1] if len(h) > 1 else 0)
+        if hkey not in out_name:
+            raise MXNetError(
+                "onnx export: graph output %d of %s — secondary outputs "
+                "of multi-output ops are not convertible"
+                % (hkey[1], nodes_j[h[0]]["name"]))
+        g += P.f_bytes(12, _value_info(out_name[hkey], None, dt))
+
+    model = P.f_varint(1, IR_VERSION)
+    model += P.f_string(2, "incubator-mxnet-tpu")
+    model += P.f_string(3, "3.0")
+    model += P.f_bytes(7, g)
+    model += P.f_bytes(8, P.f_string(1, "") + P.f_varint(2, OPSET))
+    return model

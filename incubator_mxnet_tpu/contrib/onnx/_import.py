@@ -1,0 +1,363 @@
+"""ONNX ModelProto bytes → Symbol graph + params (onnx2mx).
+
+ref: python/mxnet/contrib/onnx/onnx2mx/ — per-op translation onto the
+symbol front-end.  The wire format is parsed with _proto.py (no onnx
+package); op coverage mirrors _export.py's table, so everything this
+build exports round-trips, plus the common CNN/MLP subset of foreign
+opset-11..13 models.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import _proto as P
+from ._export import DT_FLOAT, DT_INT32, DT_INT64, _DT2NP
+
+
+# ---------------------------------------------------------------------------
+# proto → python structs
+# ---------------------------------------------------------------------------
+
+def parse_tensor(buf):
+    g = P.group(buf)
+    dims = P.ints_of(g.get(1, []))
+    dt = int(g[2][0]) if 2 in g else DT_FLOAT
+    name = P.str_of(g[8][0]) if 8 in g else ""
+    np_dt = _np.dtype(_DT2NP.get(dt, "float32"))
+    if 9 in g:                                   # raw_data
+        arr = _np.frombuffer(g[9][0], dtype=np_dt)
+    elif 4 in g and dt == DT_FLOAT:              # float_data
+        arr = _np.asarray(P.floats_of(g[4]), _np.float32)
+    elif 7 in g and dt == DT_INT64:              # int64_data
+        arr = _np.asarray(P.ints_of(g[7]), _np.int64)
+    elif 5 in g:                                 # int32_data
+        arr = _np.asarray(P.ints_of(g[5]), np_dt)
+    else:
+        arr = _np.zeros(0, np_dt)
+    return name, arr.reshape(dims) if dims else arr.reshape(())
+
+
+def parse_attr(buf):
+    g = P.group(buf)
+    name = P.str_of(g[1][0])
+    if 2 in g:                  # f
+        return name, struct.unpack("<f", g[2][0])[0]
+    if 3 in g:                  # i
+        return name, P.to_int64(int(g[3][0]))
+    if 4 in g:                  # s
+        return name, P.str_of(g[4][0])
+    if 5 in g:                  # t
+        return name, parse_tensor(g[5][0])[1]
+    if 7 in g:                  # floats
+        return name, P.floats_of(g[7])
+    if 8 in g:                  # ints
+        return name, P.ints_of(g[8])
+    if 9 in g:                  # strings
+        return name, [P.str_of(s) for s in g[9]]
+    return name, None
+
+
+def parse_node(buf):
+    g = P.group(buf)
+    return {
+        "inputs": [P.str_of(s) for s in g.get(1, [])],
+        "outputs": [P.str_of(s) for s in g.get(2, [])],
+        "name": P.str_of(g[3][0]) if 3 in g else "",
+        "op": P.str_of(g[4][0]) if 4 in g else "",
+        "attrs": dict(parse_attr(a) for a in g.get(5, [])),
+    }
+
+
+def parse_value_info(buf):
+    g = P.group(buf)
+    name = P.str_of(g[1][0])
+    shape = []
+    if 2 in g:
+        tg = P.group(g[2][0])
+        if 1 in tg:                          # tensor_type
+            tt = P.group(tg[1][0])
+            if 2 in tt:                      # shape
+                for dim in P.group(tt[2][0]).get(1, []):
+                    dg = P.group(dim)
+                    shape.append(int(dg[1][0]) if 1 in dg else -1)
+    return name, tuple(shape)
+
+
+def parse_model(data: bytes):
+    m = P.group(data)
+    if 7 not in m:
+        raise MXNetError("onnx: no graph in model")
+    g = P.group(m[7][0])
+    return {
+        "nodes": [parse_node(n) for n in g.get(1, [])],
+        "initializers": dict(parse_tensor(t) for t in g.get(5, [])),
+        "inputs": [parse_value_info(v) for v in g.get(11, [])],
+        "outputs": [parse_value_info(v) for v in g.get(12, [])],
+    }
+
+
+# ---------------------------------------------------------------------------
+# op translation (ONNX → symbol stubs)
+# ---------------------------------------------------------------------------
+
+def _pads_mx(attrs, name):
+    pads = attrs.get("pads")
+    if not pads:
+        return (0, 0)
+    half = len(pads) // 2
+    begin, end = pads[:half], pads[half:]
+    if list(begin) != list(end):
+        raise MXNetError("onnx import %s: asymmetric pads %r" %
+                         (name, pads))
+    return tuple(int(p) for p in begin)
+
+
+def _axes_arg(node, consts):
+    """opset-13 axes-as-input; fall back to the axes attribute."""
+    if len(node["inputs"]) > 1:
+        return [int(v) for v in consts[node["inputs"][1]].reshape(-1)]
+    ax = node["attrs"].get("axes")
+    return None if ax is None else [int(v) for v in ax]
+
+
+def _tl_gemm(S, node, ins, consts, shapes):
+    a = node["attrs"]
+    if a.get("alpha", 1.0) not in (1, 1.0) or \
+            a.get("beta", 1.0) not in (1, 1.0) or a.get("transA", 0):
+        raise MXNetError("onnx import Gemm: only alpha=beta=1, transA=0")
+    if not a.get("transB", 0):
+        raise MXNetError("onnx import Gemm: transB=0 (use MatMul)")
+    w_shape = shapes.get(node["inputs"][1])
+    if w_shape is None:
+        raise MXNetError("onnx import Gemm: weight must be an "
+                         "initializer")
+    return S.FullyConnected(*ins, num_hidden=int(w_shape[0]),
+                            name=node["name"] or None)
+
+
+def _tl_conv(S, node, ins, consts, shapes):
+    a = node["attrs"]
+    w_shape = shapes.get(node["inputs"][1])
+    if w_shape is None:
+        raise MXNetError("onnx import Conv: weight must be an "
+                         "initializer")
+    kernel = tuple(int(k) for k in a.get("kernel_shape", w_shape[2:]))
+    return S.Convolution(
+        *ins, kernel=kernel,
+        num_filter=int(w_shape[0]),
+        stride=tuple(int(s) for s in a.get("strides", (1,) * len(kernel))),
+        pad=_pads_mx(a, "Conv"),
+        dilate=tuple(int(d) for d in a.get("dilations",
+                                           (1,) * len(kernel))),
+        num_group=int(a.get("group", 1)),
+        no_bias=(len(ins) == 2), name=node["name"] or None)
+
+
+def _tl_pool(pool_type, global_pool):
+    def tl(S, node, ins, consts, shapes):
+        a = node["attrs"]
+        kw = dict(pool_type=pool_type, name=node["name"] or None)
+        if global_pool:
+            kw.update(global_pool=True, kernel=(1, 1))
+        else:
+            kw.update(kernel=tuple(int(k) for k in a["kernel_shape"]),
+                      stride=tuple(int(s) for s in
+                                   a.get("strides", (1, 1))),
+                      pad=_pads_mx(a, "Pool"))
+            if pool_type == "avg":
+                kw["count_include_pad"] = bool(
+                    a.get("count_include_pad", 0))
+        return S.Pooling(ins[0], **kw)
+    return tl
+
+
+def _tl_bn(S, node, ins, consts, shapes):
+    a = node["attrs"]
+    return S.BatchNorm(*ins, eps=float(a.get("epsilon", 1e-5)),
+                       momentum=float(a.get("momentum", 0.9)),
+                       fix_gamma=False, name=node["name"] or None)
+
+
+def _tl_reshape(S, node, ins, consts, shapes):
+    shp = consts.get(node["inputs"][1])
+    if shp is None:
+        raise MXNetError("onnx import Reshape: dynamic shape input")
+    return S.reshape(ins[0], shape=tuple(int(v) for v in
+                                         shp.reshape(-1)),
+                     name=node["name"] or None)
+
+
+def _tl_unary(op):
+    def tl(S, node, ins, consts, shapes):
+        return getattr(S, op)(ins[0], name=node["name"] or None)
+    return tl
+
+
+def _tl_binary(op):
+    def tl(S, node, ins, consts, shapes):
+        return getattr(S, op)(ins[0], ins[1],
+                              name=node["name"] or None)
+    return tl
+
+
+def _tl_axis(op, onnx_key="axis", mx_key="axis", default=-1):
+    def tl(S, node, ins, consts, shapes):
+        kw = {mx_key: int(node["attrs"].get(onnx_key, default)),
+              "name": node["name"] or None}
+        return getattr(S, op)(*ins, **kw)
+    return tl
+
+
+def _tl_leaky(act, alpha_default):
+    def tl(S, node, ins, consts, shapes):
+        return S.LeakyReLU(
+            *ins, act_type=act,
+            slope=float(node["attrs"].get("alpha", alpha_default)),
+            name=node["name"] or None)
+    return tl
+
+
+def _tl_squeeze_like(op, single_axis=False):
+    def tl(S, node, ins, consts, shapes):
+        axes = _axes_arg(node, consts)
+        kw = {"name": node["name"] or None}
+        if axes is not None:
+            kw["axis"] = axes[0] if single_axis else tuple(axes)
+        return getattr(S, op)(ins[0], **kw)
+    return tl
+
+
+def _tl_reduce_sum(S, node, ins, consts, shapes):
+    axes = _axes_arg(node, consts)
+    return S.sum(ins[0],
+                 axis=tuple(axes) if axes is not None else None,
+                 keepdims=bool(node["attrs"].get("keepdims", 1)),
+                 name=node["name"] or None)
+
+
+def _tl_dropout(S, node, ins, consts, shapes):
+    return S.Dropout(ins[0], p=float(node["attrs"].get("ratio", 0.5)),
+                     name=node["name"] or None)
+
+
+def _tl_transpose(S, node, ins, consts, shapes):
+    perm = node["attrs"].get("perm")
+    kw = {"name": node["name"] or None}
+    if perm is not None:
+        kw["axes"] = tuple(int(p) for p in perm)
+    return S.transpose(ins[0], **kw)
+
+
+_TRANSLATORS = {
+    "Gemm": _tl_gemm,
+    "MatMul": _tl_binary("dot"),
+    "Conv": _tl_conv,
+    "BatchNormalization": _tl_bn,
+    "MaxPool": _tl_pool("max", False),
+    "AveragePool": _tl_pool("avg", False),
+    "GlobalMaxPool": _tl_pool("max", True),
+    "GlobalAveragePool": _tl_pool("avg", True),
+    "Relu": _tl_unary("relu"),
+    "Sigmoid": _tl_unary("sigmoid"),
+    "Tanh": _tl_unary("tanh"),
+    "Exp": _tl_unary("exp"),
+    "Sqrt": _tl_unary("sqrt"),
+    "Softplus": (lambda S, node, ins, consts, shapes:
+                 S.Activation(ins[0], act_type="softrelu",
+                              name=node["name"] or None)),
+    "Identity": _tl_unary("identity"),
+    "Flatten": _tl_unary("Flatten"),
+    "Softmax": _tl_axis("softmax"),
+    "LogSoftmax": _tl_axis("log_softmax"),
+    "Concat": _tl_axis("Concat", onnx_key="axis", mx_key="dim",
+                       default=1),
+    "Add": _tl_binary("broadcast_add"),
+    "Sub": _tl_binary("broadcast_sub"),
+    "Mul": _tl_binary("broadcast_mul"),
+    "Div": _tl_binary("broadcast_div"),
+    "Reshape": _tl_reshape,
+    "Transpose": _tl_transpose,
+    "LeakyRelu": _tl_leaky("leaky", 0.01),
+    "Elu": _tl_leaky("elu", 1.0),
+    "PRelu": (lambda S, node, ins, consts, shapes:
+              S.LeakyReLU(*ins, act_type="prelu",
+                          name=node["name"] or None)),
+    "Unsqueeze": _tl_squeeze_like("expand_dims", single_axis=True),
+    "Squeeze": _tl_squeeze_like("squeeze"),
+    "ReduceSum": _tl_reduce_sum,
+    "Dropout": _tl_dropout,
+    "Sum": (lambda S, node, ins, consts, shapes:
+            S.add_n(*ins, name=node["name"] or None)),
+}
+
+# aux (running-stat) input positions per ONNX op
+_AUX_INPUTS = {"BatchNormalization": (3, 4)}
+
+
+def import_graph(data: bytes):
+    """Parse ONNX bytes → (Symbol, arg_params, aux_params)."""
+    from ... import symbol as S
+    from ... import ndarray as nd
+
+    model = parse_model(data)
+    inits = model["initializers"]
+    aux_names = set()
+    for node in model["nodes"]:
+        for pos in _AUX_INPUTS.get(node["op"], ()):
+            if pos < len(node["inputs"]):
+                aux_names.add(node["inputs"][pos])
+
+    shapes = {k: v.shape for k, v in inits.items()}
+    syms = {}           # tensor name -> Symbol
+    consumed = set()    # initializer names folded into attrs (Reshape..)
+
+    for name, shape in model["inputs"]:
+        if name not in inits:
+            syms[name] = S.var(name, shape=shape or None)
+
+    for node in model["nodes"]:
+        tl = _TRANSLATORS.get(node["op"])
+        if tl is None:
+            raise MXNetError(
+                "onnx import: unsupported op %r (node %s); supported: %s"
+                % (node["op"], node["name"], sorted(_TRANSLATORS)))
+        # attr-folded constant inputs (Reshape shape, axes tensors)
+        if node["op"] in ("Reshape", "Unsqueeze", "Squeeze",
+                          "ReduceSum") and len(node["inputs"]) > 1:
+            consumed.add(node["inputs"][1])
+        ins = []
+        for iname in node["inputs"]:
+            if iname in syms:
+                ins.append(syms[iname])
+            elif iname in inits:
+                syms[iname] = S.var(iname, shape=inits[iname].shape)
+                ins.append(syms[iname])
+            elif iname == "":
+                ins.append(None)
+            else:
+                raise MXNetError("onnx import: undefined tensor %r"
+                                 % iname)
+        out = tl(S, node, ins, inits, shapes)
+        outs = node["outputs"]
+        if len(outs) == 1:
+            syms[outs[0]] = out
+        else:
+            for i, oname in enumerate(outs):
+                if oname:
+                    syms[oname] = out[i]
+
+    heads = [syms[name] for name, _ in model["outputs"]]
+    sym = heads[0] if len(heads) == 1 else S.Group(heads)
+
+    live = set(sym.list_arguments()) | set(getattr(
+        sym, "list_auxiliary_states", lambda: [])())
+    arg_params, aux_params = {}, {}
+    for name, arr in inits.items():
+        if name in consumed or name not in live:
+            continue
+        nd_arr = nd.array(arr)
+        (aux_params if name in aux_names else arg_params)[name] = nd_arr
+    return sym, arg_params, aux_params

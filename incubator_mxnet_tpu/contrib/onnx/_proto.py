@@ -1,0 +1,155 @@
+"""Minimal protobuf wire-format codec for ONNX graphs.
+
+The frozen environment has no `onnx` (or `protobuf`) package, but the
+ONNX serialisation is plain protobuf wire format with a small, stable
+schema (onnx.proto3) — writing and reading the subset a framework
+exchange needs takes ~200 lines and zero dependencies.  This module is
+schema-agnostic plumbing: varints, tagged fields, length-delimited
+messages; the ONNX field numbers live in _export.py/_import.py.
+
+Wire types: 0 = varint, 2 = length-delimited, 5 = 32-bit (float).
+ref: python/mxnet/contrib/onnx/ serialises through the onnx package;
+byte-level compatibility is the contract here, not API mimicry of that
+package.
+"""
+from __future__ import annotations
+
+import struct
+
+_MASK64 = (1 << 64) - 1
+
+
+# ---------------------------------------------------------------------------
+# writer
+# ---------------------------------------------------------------------------
+
+def varint(n: int) -> bytes:
+    n &= _MASK64                        # two's-complement negatives
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def tag(field: int, wire_type: int) -> bytes:
+    return varint((field << 3) | wire_type)
+
+
+def f_varint(field: int, value: int) -> bytes:
+    return tag(field, 0) + varint(int(value))
+
+
+def f_bytes(field: int, payload: bytes) -> bytes:
+    return tag(field, 2) + varint(len(payload)) + payload
+
+
+def f_string(field: int, s: str) -> bytes:
+    return f_bytes(field, s.encode("utf-8"))
+
+
+def f_float(field: int, value: float) -> bytes:
+    return tag(field, 5) + struct.pack("<f", float(value))
+
+
+def f_packed_varints(field: int, values) -> bytes:
+    payload = b"".join(varint(int(v)) for v in values)
+    return f_bytes(field, payload)
+
+
+def f_packed_floats(field: int, values) -> bytes:
+    payload = b"".join(struct.pack("<f", float(v)) for v in values)
+    return f_bytes(field, payload)
+
+
+# ---------------------------------------------------------------------------
+# reader
+# ---------------------------------------------------------------------------
+
+def read_varint(buf: bytes, i: int):
+    shift = 0
+    val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val & _MASK64, i
+        shift += 7
+        if shift > 70:
+            raise ValueError("malformed varint")
+
+
+def to_int64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def fields(buf: bytes):
+    """Yield (field_number, wire_type, raw_value) triples.
+
+    raw_value: int for wire type 0, bytes for 2, 4 raw bytes for 5.
+    Unknown wire types raise — better loud than silently skewed."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = read_varint(buf, i)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = read_varint(buf, i)
+            yield field, wt, v
+        elif wt == 2:
+            ln, i = read_varint(buf, i)
+            yield field, wt, buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            yield field, wt, buf[i:i + 4]
+            i += 4
+        elif wt == 1:
+            yield field, wt, buf[i:i + 8]
+            i += 8
+        else:
+            raise ValueError("unsupported wire type %d (field %d)"
+                             % (wt, field))
+
+
+def group(buf: bytes):
+    """Collect fields into {field_number: [raw_value, ...]}."""
+    out = {}
+    for field, _wt, val in fields(buf):
+        out.setdefault(field, []).append(val)
+    return out
+
+
+def ints_of(raw_list):
+    """Repeated int64: handles both packed (bytes) and unpacked (int)
+    encodings, concatenated in field order."""
+    vals = []
+    for raw in raw_list:
+        if isinstance(raw, int):
+            vals.append(to_int64(raw))
+        else:
+            i = 0
+            while i < len(raw):
+                v, i = read_varint(raw, i)
+                vals.append(to_int64(v))
+    return vals
+
+
+def floats_of(raw_list):
+    vals = []
+    for raw in raw_list:
+        if isinstance(raw, bytes):
+            if len(raw) % 4:
+                raise ValueError("bad packed float payload")
+            vals.extend(struct.unpack("<%df" % (len(raw) // 4), raw))
+        else:
+            raise ValueError("unexpected scalar float encoding")
+    return vals
+
+
+def str_of(raw) -> str:
+    return raw.decode("utf-8")

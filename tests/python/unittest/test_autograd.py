@@ -255,3 +255,41 @@ def test_third_order_grad():
         d3s = d2.sum()
     d3s.backward()
     assert_almost_equal(x.grad.asnumpy(), 24 * x.asnumpy(), rtol=1e-4)
+
+
+def test_get_symbol_registry_chain():
+    """autograd.get_symbol rebuilds a recorded registry-op chain as a
+    Symbol graph that recomputes identically (ref:
+    python/mxnet/autograd.py get_symbol / MXAutogradGetSymbol)."""
+    from incubator_mxnet_tpu.symbol import _eval_symbol
+    rs = np.random.RandomState(3)
+    x = nd.array(rs.randn(4, 5).astype(np.float32))
+    w = nd.array(rs.randn(5, 3).astype(np.float32))
+    x.attach_grad()
+    w.attach_grad()
+    with ag.record():
+        y = nd.invoke("dot", x, w)
+        z = nd.invoke("relu", y)
+        out = nd.invoke("sum", z, axis=1)
+    sym = ag.get_symbol(out)
+    args = sym.list_arguments()
+    assert set(args) == {"var0", "var1"}
+    got = _eval_symbol(sym, {"var0": x, "var1": w}).asnumpy()
+    np.testing.assert_allclose(got, out.asnumpy(), rtol=1e-6)
+    # graph serialises like any Symbol
+    assert "dot" in sym.tojson()
+
+
+def test_get_symbol_opaque_raises():
+    """Hybridized (cached-op) segments are opaque pullbacks: get_symbol
+    must raise with guidance, not return a wrong graph."""
+    import pytest
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.ones((2, 3), np.float32))
+    x.attach_grad()
+    with ag.record():
+        out = net(x)
+    with pytest.raises(NotImplementedError):
+        ag.get_symbol(out)

@@ -1,0 +1,123 @@
+"""contrib.onnx — hand-rolled protobuf ONNX interchange
+(ref: tests/python-pytest/onnx/ — export/import round-trips with
+numerical comparison)."""
+import os
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.contrib import onnx as mxonnx
+from incubator_mxnet_tpu.symbol import _eval_symbol
+
+
+def _roundtrip(net, x, tmp_path, rtol=1e-4, atol=1e-5):
+    """export() → export_model → import_model → compare outputs."""
+    net(x)
+    net.hybridize()
+    want = net(x).asnumpy()
+    pfx = os.path.join(str(tmp_path), "m")
+    net.export(pfx)
+    path = mxonnx.export_model(
+        pfx + "-symbol.json", pfx + "-0000.params", [tuple(x.shape)],
+        onnx_file_path=os.path.join(str(tmp_path), "m.onnx"))
+    meta = mxonnx.get_model_metadata(path)
+    (in_name, in_shape), = meta["input_tensor_data"]
+    assert tuple(in_shape) == tuple(x.shape)
+    sym, arg_p, aux_p = mxonnx.import_model(path)
+    feed = {in_name: x, **arg_p, **aux_p}
+    got = _eval_symbol(sym, feed).asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+    return path, want
+
+
+def test_onnx_mlp_roundtrip(tmp_path):
+    onp.random.seed(0)
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu"))
+        net.add(gluon.nn.Dense(8, activation="tanh"))
+        net.add(gluon.nn.Dense(4))
+    net.initialize()
+    x = nd.array(onp.random.randn(3, 12).astype(onp.float32))
+    _roundtrip(net, x, tmp_path)
+
+
+def test_onnx_cnn_roundtrip(tmp_path):
+    onp.random.seed(1)
+    mx.random.seed(1)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Conv2D(8, 3, padding=1, in_channels=3,
+                                activation="relu"))
+        net.add(gluon.nn.BatchNorm())
+        net.add(gluon.nn.MaxPool2D(2))
+        net.add(gluon.nn.Conv2D(4, 1, in_channels=8))
+        net.add(gluon.nn.GlobalAvgPool2D())
+        net.add(gluon.nn.Flatten())
+        net.add(gluon.nn.Dense(10))
+    net.initialize()
+    x = nd.array(onp.random.randn(2, 3, 8, 8).astype(onp.float32))
+    path, want = _roundtrip(net, x, tmp_path)
+    # BatchNorm running stats must land in aux_params
+    _sym, _arg, aux = mxonnx.import_model(path)
+    assert len(aux) == 2
+
+
+def test_onnx_import_to_gluon(tmp_path):
+    onp.random.seed(2)
+    mx.random.seed(2)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(6, activation="sigmoid"))
+        net.add(gluon.nn.Dense(3))
+    net.initialize()
+    x = nd.array(onp.random.randn(2, 5).astype(onp.float32))
+    net(x)
+    net.hybridize()
+    want = net(x).asnumpy()
+    pfx = os.path.join(str(tmp_path), "g")
+    net.export(pfx)
+    path = mxonnx.export_model(pfx + "-symbol.json",
+                               pfx + "-0000.params", [(2, 5)],
+                               onnx_file_path=pfx + ".onnx")
+    gnet = mxonnx.import_to_gluon(path)
+    got = gnet(x).asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_symbol_ops_roundtrip(tmp_path):
+    """Raw symbol graph with transform/broadcast ops."""
+    import incubator_mxnet_tpu.symbol as S
+    rs = onp.random.RandomState(3)
+    data = S.var("data")
+    w = S.var("w")
+    y = S.FullyConnected(data, w, S.var("b"), num_hidden=6, name="fc")
+    y = S.Activation(y, act_type="relu")
+    y = S.reshape(y, shape=(-1, 2, 3))
+    y = S.transpose(y, axes=(0, 2, 1))
+    y = S.softmax(y, axis=-1)
+    arg = {"w": nd.array(rs.randn(6, 4).astype(onp.float32)),
+           "b": nd.array(rs.randn(6).astype(onp.float32))}
+    x = nd.array(rs.randn(2, 4).astype(onp.float32))
+    want = _eval_symbol(y, {"data": x, **arg}).asnumpy()
+    path = mxonnx.export_model(y, arg, [(2, 4)],
+                               onnx_file_path=os.path.join(
+                                   str(tmp_path), "s.onnx"))
+    sym, arg_p, aux_p = mxonnx.import_model(path)
+    meta = mxonnx.get_model_metadata(path)
+    (in_name, _), = meta["input_tensor_data"]
+    got = _eval_symbol(sym, {in_name: x, **arg_p}).asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_unsupported_op_raises(tmp_path):
+    import incubator_mxnet_tpu.symbol as S
+    y = S.topk(S.var("data"), k=2)
+    with pytest.raises(MXNetError, match="no converter"):
+        mxonnx.export_model(y, {}, [(2, 4)],
+                            onnx_file_path=os.path.join(
+                                str(tmp_path), "x.onnx"))
