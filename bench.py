@@ -25,6 +25,15 @@ import numpy as np
 V100_IMAGES_PER_SEC = 1400.0   # BASELINE.md north-star denominator [L]
 
 
+def _dependent_sync(net):
+    """Block on a buffer the LAST step's program produced.  On this PJRT
+    plugin, block_until_ready on an independent op (nd.waitall) can
+    return before enqueued work completes (PROFILE.md timing pitfall) —
+    a parameter is rebound to each step's output, so waiting on it
+    drains the whole dependent chain."""
+    next(iter(net.collect_params().values())).data().wait_to_read()
+
+
 def run_cachedop(batch=128, warmup=3, iters=20):
     """North-star config 1: hybridized Gluon net + autograd + Trainer."""
     import incubator_mxnet_tpu as mx
@@ -50,14 +59,14 @@ def run_cachedop(batch=128, warmup=3, iters=20):
             l = loss_fn(net(x), y)
             l.backward()
         trainer.step(batch)
-    nd.waitall()
+    _dependent_sync(net)
     t0 = time.perf_counter()
     for _ in range(iters):
         with ag.record():
             l = loss_fn(net(x), y)
             l.backward()
         trainer.step(batch)
-    nd.waitall()
+    _dependent_sync(net)
     return batch * iters / (time.perf_counter() - t0)
 
 
@@ -97,11 +106,11 @@ def run_bert(batch=8, seq=512, warmup=2, iters=8):
 
     for _ in range(warmup):
         step()
-    nd.waitall()
+    _dependent_sync(net)
     t0 = time.perf_counter()
     for _ in range(iters):
         step()
-    nd.waitall()
+    _dependent_sync(net)
     return batch * seq * iters / (time.perf_counter() - t0)
 
 
@@ -188,11 +197,11 @@ def run_ssd(batch=16, size=300, warmup=2, iters=8):
 
     for _ in range(warmup):
         step()
-    nd.waitall()
+    _dependent_sync(net)
     t0 = time.perf_counter()
     for _ in range(iters):
         step()
-    nd.waitall()
+    _dependent_sync(net)
     return batch * iters / (time.perf_counter() - t0)
 
 
@@ -227,11 +236,11 @@ def run_gnmt(batch=32, src_len=32, tgt_len=32, warmup=2, iters=8):
 
     for _ in range(warmup):
         step()
-    nd.waitall()
+    _dependent_sync(net)
     t0 = time.perf_counter()
     for _ in range(iters):
         step()
-    nd.waitall()
+    _dependent_sync(net)
     return batch * tgt_len * iters / (time.perf_counter() - t0)
 
 
@@ -263,11 +272,11 @@ def run_wide_deep(batch=2048, fields=16, warmup=2, iters=10):
 
     for _ in range(warmup):
         step()
-    nd.waitall()
+    _dependent_sync(net)
     t0 = time.perf_counter()
     for _ in range(iters):
         step()
-    nd.waitall()
+    _dependent_sync(net)
     return batch * iters / (time.perf_counter() - t0)
 
 

@@ -76,11 +76,17 @@ def flush_pending(kind="fwd"):
     """Force deferred programs: 'fwd' = pending cached-op forwards (their
     tape nodes + aux-state writebacks must exist before backward / scope
     exit); 'all' additionally forces deferred backward grads (waitall
-    barrier semantics)."""
+    barrier semantics).  A forward pending CLAIMED by a deferred
+    backward is skipped at 'fwd' flushes — the claim guarantees a later
+    step/force materialises it (or the 'all' flush does, through the
+    backward pending)."""
     for p in list(_PENDINGS.fwd):
-        p.force()
+        if not getattr(p, "claimed", False):
+            p.force()
     if kind == "all":
         for p in list(_PENDINGS.bwd):
+            p.force()
+        for p in list(_PENDINGS.fwd):
             p.force()
 
 
@@ -125,11 +131,15 @@ class _PendingGrads:
 
     will_record = False
 
-    def __init__(self, vjp, cots, items):
+    def __init__(self, vjp, cots, items, producer=None):
         # items: list of (grad_nd, full_grad_index, shape, np_dtype)
+        # producer: a still-deferred fused forward (gluon block layer) —
+        # force() runs it first; the fused optimizer path composes
+        # forward+backward+update into ONE executable instead
         self.vjp = vjp
         self.cots = cots
         self.items = items
+        self.producer = producer
         self.done = False
         # O(1) lookups — the aggregated optimizer queries every grad
         # every step (items hold strong nd refs, so id() stays valid)
@@ -155,10 +165,29 @@ class _PendingGrads:
             return
         self.done = True
         _unregister_pending(self)
-        g = _bwd_apply()(self.vjp.closure, self.cots)
+        if self.producer is not None:
+            self.producer.force()           # fwd program + tape + states
+            closure = self.producer.vjp_closure
+        else:
+            closure = self.vjp.closure
+        g = _bwd_apply()(closure, self.cots)
         for nd, i, _s, dt in self.items:
             if nd._pending is self:
                 nd._data = g[i].astype(dt)
+
+    def detach_target(self, g):
+        """A newer backward overwrites this grad (grad_req=write): drop
+        it here.  If nothing is left to produce, release the claim on
+        the deferred forward so normal flushes materialise its
+        aux-state writebacks."""
+        self.items = [it for it in self.items if it[0] is not g]
+        self._by_id.pop(id(g), None)
+        g._pending = None
+        if not self.items and not self.done:
+            self.done = True
+            _unregister_pending(self)
+            if self.producer is not None:
+                self.producer.claimed = False
 
     def fulfill(self, pairs):
         """Called by the fused backward+optimizer program: grads came out
@@ -438,9 +467,7 @@ def _try_defer_backward(node, cot):
         shp, dt = tuple(g.shape), g.dtype   # aval-aware: no forcing
         stale = g._pending
         if stale is not None:           # grad_req=write overwrites: detach
-            stale.items = [it for it in stale.items if it[0] is not g]
-            stale._by_id.pop(id(g), None)
-            g._pending = None
+            stale.detach_target(g)
         items.append((g, vjp.keep[j], shp, dt))
     _PendingGrads(vjp, tuple(cots), items)
     node.vjp_fn = None                  # retain_graph=False contract
@@ -456,6 +483,23 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
     accumulates into leaves' `.grad` per their grad_req.
     """
     import jax.numpy as jnp
+    from . import config as _cfg
+    fusion_on = _cfg.get("MXNET_CACHEDOP_FUSION") == "1"
+
+    if variables is None and not retain_graph and fusion_on:
+        hs = heads if isinstance(heads, (list, tuple)) else [heads]
+        if len(hs) == 1:
+            p = getattr(hs[0], "_pending", None)
+            if p is not None and hasattr(p, "defer_backward"):
+                hg = None
+                if head_grads is not None:
+                    hg = head_grads[0] if isinstance(
+                        head_grads, (list, tuple)) else head_grads
+                if p.defer_backward(hs[0], hg):
+                    # forward AND backward both deferred: Trainer.step
+                    # composes fwd+vjp+update into ONE executable
+                    return None
+
     flush_pending("fwd")
     root_nodes, cot = _seed_cotangents(
         heads, head_grads,
@@ -464,10 +508,9 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
 
     order = _topo_order(root_nodes)
 
-    from . import config as _cfg
     if (variables is None and not retain_graph and len(order) == 1
             and isinstance(order[0].vjp_fn, _JitVjp)
-            and _cfg.get("MXNET_CACHEDOP_FUSION") == "1"
+            and fusion_on
             and _try_defer_backward(order[0], cot)):
         # whole backward is ONE deferred program: grads materialise on
         # first read, or fuse into the optimizer update (Trainer.step)

@@ -460,6 +460,184 @@ class _PendingCall:
         self.graph._dispatch_deferred(self)
 
 
+class _FusedProgram:
+    """One producer→consumer composition (net+loss), cached on the
+    producer graph.  Holds the raw composed pure function, its jitted
+    fwd+vjp, result avals (via jax.eval_shape — no dispatch needed), and
+    the jitted whole-train-step executables the optimizer layer builds
+    over it (fwd+vjp+update in ONE program, ref: SURVEY §3.3 bulked
+    segments ≙ ShardedTrainer's step assembled from the imperative
+    tape)."""
+
+    __slots__ = ("raw", "fwd_jit", "keep", "n_net", "n_loss",
+                 "loss_graph", "loss_fkey", "net_graph", "net_fkey",
+                 "avals", "train_step_jits")
+
+    def __init__(self, raw, keep, n_net_leaves, loss_graph, loss_fkey,
+                 net_graph, net_fkey, avals, n_loss):
+        import jax
+        self.raw = raw
+
+        def fwd(*leaves):
+            return jax.vjp(raw, *leaves)
+        self.fwd_jit = jax.jit(fwd)
+        self.keep = keep
+        self.n_net = n_net_leaves
+        self.n_loss = n_loss
+        self.loss_graph = loss_graph
+        self.loss_fkey = loss_fkey
+        self.net_graph = net_graph
+        self.net_fkey = net_fkey
+        self.avals = avals          # ((shape, np_dtype), ...) full result
+        self.train_step_jits = {}
+
+
+class _PendingFused:
+    """A deferred net+loss fused forward.  Three consumers:
+
+    - ``backward()`` on its loss head defers too (``defer_backward``),
+      letting ``Trainer.step`` compose forward+backward+update into ONE
+      executable — residuals never round-trip through HBM as program
+      outputs, matching the pure-jax fused trainer;
+    - any buffer read forces the fwd+vjp program (tape recorded, aux
+      states written) — the stage-A behaviour;
+    - scope-exit flush skips it only while a deferred backward claims it
+      (the claim guarantees a later force/step materialises it)."""
+
+    __slots__ = ("prog", "leaves", "inputs", "ctx", "out_nds", "done",
+                 "claimed", "vjp_closure")
+
+    will_record = True
+
+    def __init__(self, prog, leaves, inputs, ctx):
+        self.prog = prog
+        self.leaves = leaves
+        self.inputs = inputs        # tape inputs (no key-bits)
+        self.ctx = ctx
+        self.done = False
+        self.claimed = False
+        self.vjp_closure = None
+        outs = []
+        for i in range(len(prog.avals)):
+            nd = NDArray.__new__(NDArray)
+            nd._data_v = None
+            nd._pending = self
+            nd._ctx = ctx
+            nd._grad = None
+            nd._grad_req = None
+            nd._tape_node = None
+            nd._out_index = i
+            outs.append(nd)
+        self.out_nds = outs
+        _ag._register_pending(self, "fwd")
+
+    def aval_of(self, nd):
+        return self.prog.avals[nd._out_index]
+
+    def force(self):
+        if self.done:
+            return
+        self.done = True
+        _ag._unregister_pending(self)
+        prog = self.prog
+        from .. import engine as _engine
+        with _engine._dispatch_hook(
+                prog.net_graph.block.name + "+" +
+                prog.loss_graph.block.name + "_fused", self.ctx):
+            result, vjp_closure = prog.fwd_jit(*self.leaves)
+        if _engine.naive_mode():
+            for o in result:
+                o.block_until_ready()
+        self.vjp_closure = vjp_closure
+        for nd, val in zip(self.out_nds, result):
+            nd._data_v = val
+            nd._pending = None
+        vjp = _ag._JitVjp(vjp_closure, prog.keep)
+        _ag.record_op(vjp, self.inputs, tuple(self.out_nds),
+                      name=(prog.net_graph.block.name + "+" +
+                            prog.loss_graph.block.name + "_fused"),
+                      out_is_tuple=True)
+        self._writeback_states()
+
+    def _writeback_states(self):
+        prog = self.prog
+        _, lsp = prog.loss_graph._trace_meta[prog.loss_fkey]
+        if lsp:
+            tail = self.out_nds[prog.n_loss - len(lsp):prog.n_loss]
+            for p, nd in zip(lsp, tail):
+                _write_state_all_ctx(p, nd._data_v)
+        _, nsp = prog.net_graph._trace_meta[prog.net_fkey]
+        if nsp:
+            for p, nd in zip(nsp, self.out_nds[len(self.out_nds) -
+                                               len(nsp):]):
+                _write_state_all_ctx(p, nd._data_v)
+
+    def finish_from_train_step(self, result):
+        """The whole-step executable already ran fwd+bwd+update: fill
+        the outputs and write aux states; no tape node (the step is
+        complete — a second backward through it would be a freed-graph
+        error in eager semantics too)."""
+        self.done = True
+        _ag._unregister_pending(self)
+        for nd, val in zip(self.out_nds, result):
+            nd._data_v = val
+            nd._pending = None
+        self._writeback_states()
+
+    def defer_backward(self, head, head_grad):
+        """backward() on the (still-deferred) loss head: park the seed
+        cotangents as a producer-linked _PendingGrads.  Returns False
+        when the eager path must run."""
+        import jax.numpy as jnp
+        if self.done or head._pending is not self:
+            return False
+        prog = self.prog
+        cots = []
+        for i, (shape, dt) in enumerate(prog.avals):
+            if not jnp.issubdtype(jnp.dtype(dt), jnp.inexact):
+                return False
+            if i == head._out_index:
+                cots.append(_ag._ones_const(shape, dt)
+                            if head_grad is None else head_grad._data)
+            else:
+                cots.append(_ag._zeros_const(shape, dt))
+        targets = []
+        seen = set()
+        for j, inp in enumerate(self.inputs):
+            if inp is None:
+                continue
+            p_in = getattr(inp, "_pending", None)
+            if inp._tape_node is not None or (
+                    p_in is not None and getattr(p_in, "will_record",
+                                                 False)):
+                # upstream recorded history: gradients must flow PAST
+                # this program — only the full tape walk does that
+                return False
+            if inp._grad_req in (None, "null"):
+                continue
+            if (inp._grad_req != "write" or inp._grad is None or
+                    getattr(inp._grad, "stype", "default") != "default"
+                    or id(inp) in seen):
+                return False
+            seen.add(id(inp))
+            targets.append((j, inp))
+        if not targets:
+            return False
+        items = []
+        for j, inp in targets:
+            g = inp._grad
+            shp, dt = tuple(g.shape), g.dtype
+            stale = g._pending
+            if stale is not None:
+                if not hasattr(stale, "detach_target"):
+                    return False
+                stale.detach_target(g)
+            items.append((g, prog.keep[j], shp, dt))
+        self.claimed = True
+        _ag._PendingGrads(None, tuple(cots), items, producer=self)
+        return True
+
+
 class _XformPending:
     """A shape-only unary op (reshape/transpose/cast/...) applied to a
     lazy cached-op output: carries the (op, kwargs) chain so a consuming
@@ -827,13 +1005,14 @@ class _CachedGraph:
         # cache lives on the PRODUCER graph: in rebuild loops (hyperparam
         # search) nets die while the loss block lives on — a consumer-side
         # cache would pin every dead net's params/executables forever.
-        # Keyed by the consumer OBJECT (not id()): an id of a collected
-        # graph can be recycled to a different block and would silently
-        # serve the dead consumer's program
+        # Keyed by the consumer OBJECT (not id(): a collected graph's id
+        # can be recycled) and by input avals (out avals are shape-exact).
         store = base.graph._fused
-        cache_key = (self, fkey, base.fkey, tuple(specs))
-        ent = store.get(cache_key)
-        if ent is None:
+        cavals = tuple((tuple(a.shape), str(a.dtype))
+                       for a in concrete_leaves)
+        cache_key = (self, fkey, base.skey, tuple(specs), cavals)
+        prog = store.get(cache_key)
+        if prog is None:
             net_flat = base.graph._get_flat(*base.fkey)
             loss_flat = self._get_flat(training, np_, ni_)
             # consumer leaf t ∈ [params..., inputs..., key] sourced from
@@ -863,61 +1042,50 @@ class _CachedGraph:
                 loss_res = loss_flat(*loss_leaves)
                 return tuple(loss_res) + tuple(net_res)
 
-            def fwd(*leaves):
-                return jax.vjp(fused, *leaves)
-            ent = jax.jit(fwd)
-            store[cache_key] = ent
+            # result avals via abstract eval — zero device work; the
+            # same trace populates the loss graph's _trace_meta
+            in_avals = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                        for a in list(base.leaf_data) + concrete_leaves]
+            res_avals = jax.eval_shape(fused, *in_avals)
+            avals = tuple((tuple(v.shape), _np.dtype(v.dtype))
+                          for v in res_avals)
+            n_loss = len(avals) - len(base.out_nds)
+            # key-bit grad positions dropped, fused-interior grads never
+            # materialise
+            keep = tuple(range(n_net - 1)) + \
+                tuple(range(n_net, n_net + n_lc - 1))
+            prog = _FusedProgram(fused, keep, n_net, self, fkey,
+                                 base.graph, base.fkey, avals, n_loss)
+            store[cache_key] = prog
 
-        from .. import engine as _engine
+        # defer: nothing dispatches until something reads a value — the
+        # usual consumer is backward()+Trainer.step, which compose the
+        # WHOLE step (fwd+vjp+update) into one executable
+        inputs = list(base.flat_inputs) + concrete_nds
+        pending = _PendingFused(prog,
+                                list(base.leaf_data) + concrete_leaves,
+                                inputs, ctx)
+        # absorb the producer pending: its user-held outputs re-point
+        # into the fused result
         base.done = True
         _ag._unregister_pending(base)
+        for i, nd in enumerate(base.out_nds):
+            if nd._pending is base:
+                nd._pending = pending
+                nd._out_index = prog.n_loss + i
+                pending.out_nds[prog.n_loss + i] = nd
         for xp in consumed_xforms:
             # value computed inside the fused program; a later read
-            # replays cheaply off the now-concrete source instead of
+            # replays cheaply off the materialised source instead of
             # re-dispatching at scope exit
             _ag._unregister_pending(xp)
-        leaves = list(base.leaf_data) + concrete_leaves
-        with _engine._dispatch_hook(
-                base.graph.block.name + "+" + self.block.name + "_fused",
-                ctx):
-            result, vjp_closure = ent(*leaves)
-        if _engine.naive_mode():
-            for o in result:
-                o.block_until_ready()
 
-        n_net_out = len(base.out_nds)
-        n_loss = len(result) - n_net_out
-        loss_wrapped = tuple(NDArray(v, ctx=ctx) for v in result[:n_loss])
-        for nd, val in zip(base.out_nds, result[n_loss:]):
-            nd._data_v = val
-            nd._pending = None
-
-        # tape: ONE node over both programs' real inputs; key-bit grad
-        # positions dropped, fused-interior grads never materialise
-        keep = tuple(range(n_net - 1)) + \
-            tuple(range(n_net, n_net + n_lc - 1))
-        vjp = _ag._JitVjp(vjp_closure, keep)
-        _ag.record_op(vjp, list(base.flat_inputs) + concrete_nds,
-                      loss_wrapped + tuple(base.out_nds),
-                      name=(base.graph.block.name + "+" +
-                            self.block.name + "_fused"),
-                      out_is_tuple=True)
-
-        # aux-state writebacks for BOTH programs
         ltd, lsp = self._trace_meta[fkey]
-        if lsp:
-            for p, s in zip(lsp, loss_wrapped[n_loss - len(lsp):]):
-                _write_state_all_ctx(p, s._data_v)
-        _, nsp = base.graph._trace_meta[base.fkey]
-        if nsp:
-            for p, nd in zip(nsp, base.out_nds[n_net_out - len(nsp):]):
-                _write_state_all_ctx(p, nd._data_v)
-
         skey = (fkey, tuple((tuple(a.shape), str(a.dtype))
                             for a in args))
-        self._out_avals[skey] = tuple(
-            (tuple(v.shape), _np.dtype(v.dtype)) for v in result[:n_loss])
-        return _unflatten_out(list(loss_wrapped[:n_loss - len(lsp)]), ltd)
+        self._out_avals[skey] = prog.avals[:prog.n_loss]
+        outs = pending.out_nds[:prog.n_loss - len(lsp)]
+        return _unflatten_out(list(outs), ltd)
 
 
 def _flatten_out(out):

@@ -91,6 +91,30 @@ def _zeros_state(weight):
 # aggregated (multi-tensor) fused update
 # ---------------------------------------------------------------------------
 
+def _update_one(fn, w, g, sargs, lr, wd, scalars, static_kv):
+    """One parameter's fused update, dtype-preserving (f32 hyper arrays
+    must not promote low-precision weight/state buffers).  Shared by
+    every aggregated-update executable so cast/donation semantics can't
+    diverge between the fused and unfused paths."""
+    out = fn(w, g, *sargs, lr=lr, wd=wd, **scalars, **dict(static_kv))
+    if sargs:
+        return (out[0].astype(w.dtype),
+                tuple(o.astype(s.dtype) for o, s in zip(out[1:], sargs)))
+    return out.astype(w.dtype), ()
+
+
+def _transpose_states(per_param, nstates):
+    return tuple(tuple(p[j] for p in per_param) for j in range(nstates))
+
+
+def _rebind_updated(weights, new_ws, state_cols, new_sts):
+    for w, nw in zip(weights, new_ws):
+        w._data = nw
+    for col, ncol in zip(state_cols, new_sts):
+        for s, ns in zip(col, ncol):
+            s._data = ns
+
+
 @functools.lru_cache(maxsize=None)
 def _jit_multi_update(opname: str, static_kv: tuple, nparam: int,
                       nstates: int):
@@ -101,22 +125,14 @@ def _jit_multi_update(opname: str, static_kv: tuple, nparam: int,
     fn = _registry.get(opname).fn
 
     def f(ws, gs, states, lrs, wds, scalars):
-        new_ws = []
-        new_states = tuple([] for _ in range(nstates))
+        new_ws, per_param = [], []
         for i in range(nparam):
             sargs = tuple(states[j][i] for j in range(nstates))
-            out = fn(ws[i], gs[i], *sargs, lr=lrs[i], wd=wds[i],
-                     **scalars, **dict(static_kv))
-            # dtype-preserving like _jit_update: f32 hyper arrays must
-            # not promote low-precision weight/state buffers
-            if nstates:
-                new_ws.append(out[0].astype(ws[i].dtype))
-                for j in range(nstates):
-                    new_states[j].append(out[1 + j].astype(
-                        states[j][i].dtype))
-            else:
-                new_ws.append(out.astype(ws[i].dtype))
-        return tuple(new_ws), tuple(tuple(s) for s in new_states)
+            nw, ns = _update_one(fn, ws[i], gs[i], sargs, lrs[i],
+                                 wds[i], scalars, static_kv)
+            new_ws.append(nw)
+            per_param.append(ns)
+        return tuple(new_ws), _transpose_states(per_param, nstates)
     return jax.jit(f, donate_argnums=(0, 2))
 
 
@@ -137,25 +153,89 @@ def _jit_bwd_multi_update(opname: str, static_kv: tuple, nparam: int,
 
     def f(vjp_closure, cots, ws, states, lrs, wds, scalars):
         g_all = vjp_closure(cots)
-        new_ws = []
-        new_states = tuple([] for _ in range(nstates))
-        gouts = []
+        new_ws, per_param, gouts = [], [], []
         for i in range(nparam):
             g = g_all[gidx[i]].astype(gdtypes[i])
             gouts.append(g)
             sargs = tuple(states[j][i] for j in range(nstates))
-            out = fn(ws[i], g, *sargs, lr=lrs[i], wd=wds[i],
-                     **scalars, **dict(static_kv))
-            if nstates:
-                new_ws.append(out[0].astype(ws[i].dtype))
-                for j in range(nstates):
-                    new_states[j].append(out[1 + j].astype(
-                        states[j][i].dtype))
-            else:
-                new_ws.append(out.astype(ws[i].dtype))
-        return (tuple(new_ws), tuple(tuple(s) for s in new_states),
+            nw, ns = _update_one(fn, ws[i], g, sargs, lrs[i], wds[i],
+                                 scalars, static_kv)
+            new_ws.append(nw)
+            per_param.append(ns)
+        return (tuple(new_ws), _transpose_states(per_param, nstates),
                 tuple(gouts))
     return jax.jit(f, donate_argnums=(3,))
+
+
+def _build_train_step(raw, opname, static_kv, nparam, nstates, gidx,
+                      gdtypes, n_leaves):
+    """Whole imperative step as ONE executable: forward, vjp, and every
+    parameter's update — the residuals never leave the program, and the
+    parameter/state buffers are donated for in-place updates.  This is
+    ShardedTrainer's one-program structure (SURVEY §3.3) reached from
+    the user-facing record()/backward()/step() loop via the deferred
+    fused forward (gluon/block.py _PendingFused)."""
+    fn = _registry.get(opname).fn
+
+    def f(*args):
+        leaves = args[:n_leaves]
+        cots, states, lrs, wds, scalars = args[n_leaves:]
+        outs, vjp = jax.vjp(raw, *leaves)
+        g_all = vjp(tuple(cots))
+        new_ws, per_param, gouts = [], [], []
+        for i in range(nparam):
+            li = gidx[i]
+            g = g_all[li].astype(gdtypes[i])
+            gouts.append(g)
+            sargs = tuple(states[j][i] for j in range(nstates))
+            nw, ns = _update_one(fn, leaves[li], g, sargs, lrs[i],
+                                 wds[i], scalars, static_kv)
+            new_ws.append(nw)
+            per_param.append(ns)
+        return (tuple(outs), tuple(new_ws),
+                _transpose_states(per_param, nstates), tuple(gouts))
+
+    # donate the parameter leaves (updated in place) and the optimizer
+    # states; NOT the input/cotangent leaves (reused across steps)
+    donate = tuple(gidx) + (n_leaves + 1,)
+    return jax.jit(f, donate_argnums=donate)
+
+
+def _train_step_dispatch(prod, pending, opname, static_kv, weights,
+                         grads, sts, state_cols, lrs, wds, scal):
+    """Compose the deferred forward + deferred backward + this update
+    into one program.  Returns False when identity guards fail (a param
+    buffer was rebound between forward and step) — callers then force
+    the pending chain and take the eager path."""
+    prog = prod.prog
+    try:
+        gidx = tuple(pending.index_for(g) for g in grads)
+    except KeyError:
+        return False
+    if len(set(gidx)) != len(gidx):
+        return False
+    for w, li in zip(weights, gidx):
+        if w._data_v is not prod.leaves[li]:
+            return False
+    gdt = tuple(str(_np.dtype(g.dtype)) for g in grads)
+    n_leaves = len(prod.leaves)
+    key = (opname, static_kv, len(weights), len(state_cols), gidx, gdt,
+           n_leaves)
+    jf = prog.train_step_jits.get(key)
+    if jf is None:
+        jf = _build_train_step(prog.raw, opname, static_kv,
+                               len(weights), len(state_cols), gidx,
+                               gdt, n_leaves)
+        prog.train_step_jits[key] = jf
+    from .. import engine as _engine
+    with _engine._dispatch_hook(opname + "_train_step",
+                                weights[0].context):
+        outs, new_ws, new_sts, gouts = jf(*prod.leaves, pending.cots,
+                                          sts, lrs, wds, scal)
+    prod.finish_from_train_step(outs)
+    pending.fulfill(zip(grads, gouts))
+    _rebind_updated(weights, new_ws, state_cols, new_sts)
+    return True
 
 
 _HYPER_CACHE = {}
@@ -191,28 +271,37 @@ def _fused_multi(opname, weights, grads, state_cols, lr_list, wd_list,
     wds = _hyper_array(wd_list)
     scal = {k: _hyper_array(v) for k, v in scalars.items()}
     sts = tuple(tuple(s._data for s in col) for col in state_cols)
-    if bwd_pending is not None:
-        gidx = tuple(bwd_pending.index_for(g) for g in grads)
-        gdt = tuple(str(_np.dtype(g.dtype)) for g in grads)
-        jf = _jit_bwd_multi_update(opname, tuple(sorted(static.items())),
-                                   len(weights), len(state_cols), gidx,
-                                   gdt)
-        ws = tuple(w._data for w in weights)
-        new_ws, new_sts, gouts = jf(bwd_pending.vjp.closure,
-                                    bwd_pending.cots, ws, sts, lrs, wds,
-                                    scal)
-        bwd_pending.fulfill(zip(grads, gouts))
-    else:
-        jf = _jit_multi_update(opname, tuple(sorted(static.items())),
-                               len(weights), len(state_cols))
-        ws = tuple(w._data for w in weights)
-        gs = tuple(g._data for g in grads)
-        new_ws, new_sts = jf(ws, gs, sts, lrs, wds, scal)
-    for w, nw in zip(weights, new_ws):
-        w._data = nw
-    for col, ncol in zip(state_cols, new_sts):
-        for s, ns in zip(col, ncol):
-            s._data = ns
+    static_kv = tuple(sorted(static.items()))
+    if bwd_pending is not None and not bwd_pending.done:
+        prod = getattr(bwd_pending, "producer", None)
+        if prod is not None and not prod.done:
+            # forward still deferred too: the WHOLE step becomes one
+            # executable (fwd + vjp + update, params donated)
+            if _train_step_dispatch(prod, bwd_pending, opname,
+                                    static_kv, weights, grads, sts,
+                                    state_cols, lrs, wds, scal):
+                return
+            bwd_pending.force()
+        else:
+            closure = (bwd_pending.vjp.closure
+                       if bwd_pending.vjp is not None
+                       else prod.vjp_closure)
+            gidx = tuple(bwd_pending.index_for(g) for g in grads)
+            gdt = tuple(str(_np.dtype(g.dtype)) for g in grads)
+            jf = _jit_bwd_multi_update(opname, static_kv, len(weights),
+                                       len(state_cols), gidx, gdt)
+            ws = tuple(w._data for w in weights)
+            new_ws, new_sts, gouts = jf(closure, bwd_pending.cots, ws,
+                                        sts, lrs, wds, scal)
+            bwd_pending.fulfill(zip(grads, gouts))
+            _rebind_updated(weights, new_ws, state_cols, new_sts)
+            return
+    jf = _jit_multi_update(opname, static_kv, len(weights),
+                           len(state_cols))
+    ws = tuple(w._data for w in weights)
+    gs = tuple(g._data for g in grads)
+    new_ws, new_sts = jf(ws, gs, sts, lrs, wds, scal)
+    _rebind_updated(weights, new_ws, state_cols, new_sts)
 
 
 # ---------------------------------------------------------------------------
