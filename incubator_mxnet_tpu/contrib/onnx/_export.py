@@ -102,11 +102,13 @@ def _pads2(attrs, default=(0, 0)):
 
 
 class _Ctx:
-    """Per-export state a converter can touch: extra initializers and a
-    monotone counter for synthesized tensor names."""
+    """Per-export state a converter can touch: extra initializers, the
+    params dict (name → array, for shape lookups), and a monotone
+    counter for synthesized tensor names."""
 
-    def __init__(self):
+    def __init__(self, params=None):
         self.extra_init = []
+        self.params = params or {}
         self.n = 0
 
     def const(self, arr, hint="const"):
@@ -119,6 +121,21 @@ class _Ctx:
 def _cv_fc(name, ins, attrs, ctx):
     nh = int(attrs["num_hidden"])
     no_bias = bool(attrs.get("no_bias", False))
+    if not attrs.get("flatten", True):
+        # flatten=False applies to the LAST axis only (transformer /
+        # per-timestep Dense): x @ W.T (+ b).  Flatten+Gemm here would
+        # silently collapse the leading axes (advisor r3).
+        wt = name + "_wT"
+        nodes = [_node("Transpose", [ins[1]], [wt],
+                       name + "_transpose", perm=[1, 0])]
+        if no_bias:
+            nodes.append(_node("MatMul", [ins[0], wt], [name], name))
+        else:
+            mm = name + "_mm"
+            nodes.append(_node("MatMul", [ins[0], wt], [mm],
+                               name + "_matmul"))
+            nodes.append(_node("Add", [mm, ins[2]], [name], name))
+        return nodes
     flat = name + "_flat"
     nodes = [_node("Flatten", [ins[0]], [flat], name + "_flatten",
                    axis=1)]
@@ -151,8 +168,28 @@ def _cv_act(name, ins, attrs, ctx):
 
 def _cv_bn(name, ins, attrs, ctx):
     # inputs: data, gamma, beta, moving_mean, moving_var
-    return [_node("BatchNormalization", list(ins[:5]), [name], name,
-                  epsilon=float(attrs.get("eps", 1e-5)),
+    inputs = list(ins[:5])
+    if attrs.get("fix_gamma", True):
+        # symbol-API default: gamma is treated as 1 at runtime
+        # (ops/nn.py fix_gamma) regardless of the stored buffer — feed
+        # ONNX a ones tensor so the exported model matches (advisor r3)
+        gamma = ctx.params.get(ins[1])
+        if gamma is not None:
+            garr = (gamma.asnumpy() if hasattr(gamma, "asnumpy")
+                    else _np.asarray(gamma))
+            inputs[1] = ctx.const(_np.ones_like(garr), "ones")
+        else:
+            # gamma is a graph input with no stored value: without the
+            # array we cannot know the channel count statically — fail
+            # loudly rather than export wrong math
+            raise MXNetError(
+                "onnx export: BatchNorm %s has fix_gamma=True but gamma "
+                "%r is not in params; cannot substitute ones" %
+                (name, ins[1]))
+    # default eps is MXNet's 1e-3 (ops/nn.py batch_norm), NOT ONNX's
+    # 1e-5 — a silent eps mismatch shifts every normalized activation
+    return [_node("BatchNormalization", inputs, [name], name,
+                  epsilon=float(attrs.get("eps", 1e-3)),
                   momentum=float(attrs.get("momentum", 0.9)))]
 
 
@@ -308,7 +345,7 @@ def convert_symbol(sym, params, input_shapes, input_dtype="float32",
                 % (len(input_shapes), data_names))
         input_shapes = dict(zip(data_names, input_shapes))
 
-    ctx = _Ctx()
+    ctx = _Ctx(params)
     onnx_nodes = []
     out_name = {}               # (node_idx, out_idx) -> tensor name
 

@@ -94,14 +94,40 @@ def record_state_update(param, new_value_nd):
     _write_state_all_ctx(param, new_value_nd._data)
 
 
-def _write_state_all_ctx(param, value):
+def _write_state_all_ctx(param, value, pending=None):
     """Write an updated aux-state value to EVERY per-context copy of the
     parameter (running stats must stay in sync across devices in
-    multi-context training), keeping each copy's dtype and device."""
+    multi-context training), keeping each copy's dtype and device.
+    When ``pending`` is given, release its writer claim on the param
+    (see ``_flush_state_writers``)."""
     import jax as _jax
     for ctx, arr in param._data.items():
         arr._data = _jax.device_put(value.astype(arr._data.dtype),
                                     ctx.jax_device)
+    if pending is not None and \
+            getattr(param, "_pending_writer", None) is pending:
+        param._pending_writer = None
+
+
+def _mark_state_writers(state_params, pending):
+    """Claim aux-state params for a deferred program: until it
+    dispatches and writes back, these params' device buffers are STALE
+    relative to program order."""
+    for p in state_params:
+        p._pending_writer = pending
+
+
+def _flush_state_writers(params):
+    """Sequential consistency for mutable aux state (BatchNorm running
+    stats): a still-pending earlier call that WRITES one of this call's
+    params must dispatch — and write back — before this call snapshots
+    buffers.  Without this, the second of two calls of a stateful block
+    inside one record scope (GAN discriminator on real+fake, siamese
+    nets) reads pre-update statistics."""
+    for p in params:
+        w = getattr(p, "_pending_writer", None)
+        if w is not None and not w.done:
+            w.force()
 
 
 # ---------------------------------------------------------------------------
@@ -565,12 +591,12 @@ class _PendingFused:
         if lsp:
             tail = self.out_nds[prog.n_loss - len(lsp):prog.n_loss]
             for p, nd in zip(lsp, tail):
-                _write_state_all_ctx(p, nd._data_v)
+                _write_state_all_ctx(p, nd._data_v, pending=self)
         _, nsp = prog.net_graph._trace_meta[prog.net_fkey]
         if nsp:
             for p, nd in zip(nsp, self.out_nds[len(self.out_nds) -
                                                len(nsp):]):
-                _write_state_all_ctx(p, nd._data_v)
+                _write_state_all_ctx(p, nd._data_v, pending=self)
 
     def finish_from_train_step(self, result):
         """The whole-step executable already ran fwd+bwd+update: fill
@@ -859,6 +885,7 @@ class _CachedGraph:
             else current_context()
 
         param_nds = [p.data(ctx) for p in self.params]
+        _flush_state_writers(self.params)
         # key bits derived host-side (zero device ops) and fed as a plain
         # numpy jit input; the executable wraps them into a typed key
         key_bits = _rnd.next_key_bits(ctx)
@@ -895,6 +922,7 @@ class _CachedGraph:
             pending = _PendingCall(self, skey, leaf_data, flat_inputs,
                                    ctx)
             treedef, state_params = self._trace_meta[fkey]
+            _mark_state_writers(state_params, pending)
             n_outs = len(pending.out_nds) - len(state_params)
             return _unflatten_out(list(pending.out_nds[:n_outs]), treedef)
 
@@ -961,7 +989,7 @@ class _CachedGraph:
         tail = pending.out_nds[len(pending.out_nds) - n_states:] \
             if n_states else []
         for p, s in zip(state_params, tail):
-            _write_state_all_ctx(p, s._data_v)
+            _write_state_all_ctx(p, s._data_v, pending=pending)
 
     def _try_fused_call(self, args, param_nds, key_bits, fkey, ctx):
         """Compose this cached-op with ONE pending producer into a single
@@ -1079,6 +1107,12 @@ class _CachedGraph:
             # replays cheaply off the materialised source instead of
             # re-dispatching at scope exit
             _ag._unregister_pending(xp)
+
+        # the fused program now owns BOTH blocks' aux-state writebacks
+        # (the absorbed producer's claims re-point here)
+        _mark_state_writers(self._trace_meta[fkey][1], pending)
+        _mark_state_writers(base.graph._trace_meta[base.fkey][1],
+                            pending)
 
         ltd, lsp = self._trace_meta[fkey]
         skey = (fkey, tuple((tuple(a.shape), str(a.dtype))

@@ -9,8 +9,12 @@ thread next to dispatch (TPU transfers are engine-ordered already).
 from __future__ import annotations
 
 import multiprocessing as _mp
+import threading as _threading
 
 import numpy as _np
+
+# serializes Pool construction across DataLoaders (see __init__ cleanup)
+_POOL_CTOR_LOCK = _threading.Lock()
 
 from ...ndarray.ndarray import NDArray
 from ... import ndarray as nd
@@ -111,18 +115,43 @@ class DataLoader:
                 # that attempt IS the picklability probe — no separate
                 # serialization pass (a multi-GB in-memory dataset would
                 # pay a full extra pickle walk just to pre-check).
-                try:
-                    ctx = _mp.get_context("spawn")
-                    self._pool = ctx.Pool(self._num_workers,
-                                          initializer=_worker_init,
-                                          initargs=(self._dataset,))
-                except Exception:
+                import pickle as _pickle
+                ctx = _mp.get_context("spawn")
+                # serialize pool construction: the failure cleanup below
+                # diffs active_children(), which must not see another
+                # loader's workers appearing concurrently
+                with _POOL_CTOR_LOCK:
+                    before = set(_mp.active_children())
+                    try:
+                        self._pool = ctx.Pool(
+                            self._num_workers,
+                            initializer=_worker_init,
+                            initargs=(self._dataset,))
+                        e = None
+                    except Exception as exc:
+                        e = exc
+                        # reap workers the partially constructed Pool
+                        # already started before its constructor raised
+                        # (only spawn-pool daemons born in this window)
+                        for proc in (set(_mp.active_children()) -
+                                     before):
+                            if proc.daemon and proc.name.startswith(
+                                    "SpawnPoolWorker"):
+                                proc.terminate()
+                                proc.join()
+                if e is not None:
+                    if not isinstance(e, (_pickle.PicklingError,
+                                          TypeError, AttributeError)):
+                        # NOT a serialization failure (fd/resource
+                        # exhaustion, OS spawn error): surface it —
+                        # a thread fallback would mask a real problem
+                        raise e
                     import warnings
                     warnings.warn(
-                        "DataLoader: dataset is not picklable (lambda "
-                        "transform?) — using thread workers instead of "
-                        "spawned processes (pass thread_pool=True to "
-                        "silence)")
+                        "DataLoader: dataset failed to pickle into "
+                        "spawned workers (%s: %s) — using thread "
+                        "workers instead (pass thread_pool=True to "
+                        "silence)" % (type(e).__name__, e))
                     self._thread_pool = thread_pool = True
             if thread_pool:
                 from multiprocessing.dummy import Pool as _ThreadPool

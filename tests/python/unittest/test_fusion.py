@@ -349,3 +349,60 @@ def test_upstream_tape_history_blocks_whole_step_defer():
     assert np.abs(g1).max() > 0        # gradient actually flows to x
     np.testing.assert_allclose(g2, g1, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(g3, g1, rtol=1e-5, atol=1e-6)
+
+
+def test_stateful_double_call_one_scope_matches_eager():
+    """A hybridized stateful block (BatchNorm) called TWICE inside one
+    record scope (GAN discriminator on real+fake, siamese nets): the
+    second call must consume aux state AFTER the first call's writeback,
+    not the call-time snapshot (advisor r3 high).  Params, grads, and
+    running stats must match the eager path."""
+    x2_np = X[::-1].copy()
+
+    def run(hybridize):
+        net, loss_fn, trainer = _build(hybridize, seed=53)
+        x1, x2, y = nd.array(X), nd.array(x2_np), nd.array(Y)
+        for _ in range(3):
+            with ag.record():
+                l = loss_fn(net(x1), y) + loss_fn(net(x2), y)
+                l.backward()
+            trainer.step(8)
+        # name counters are process-global (dense0 vs dense2): align by
+        # collect_params() insertion order, stable across builds
+        return [(k, v.data().asnumpy())
+                for k, v in net.collect_params().items()]
+
+    eager = run(False)
+    fused = run(True)
+    assert len(eager) == len(fused)
+    for (ke, ve), (kf, vf) in zip(eager, fused):
+        np.testing.assert_allclose(vf, ve, rtol=2e-5, atol=2e-5,
+                                   err_msg="%s vs %s" % (ke, kf))
+
+
+def test_stateful_double_call_raw_outputs_running_stats():
+    """Same double-call hazard without a loss in between: forward the
+    block twice under record and check the running statistics chained
+    (call-2 started from call-1's updated stats)."""
+    x2_np = (X * 3.0 + 1.0).astype(np.float32)
+
+    def run(hybridize):
+        net, _, _ = _build(hybridize, seed=59)
+        x1, x2 = nd.array(X), nd.array(x2_np)
+        with ag.record():
+            o1 = net(x1)
+            o2 = net(x2)
+            s = (o1.sum() + o2.sum())
+        s.asnumpy()                    # force everything
+        stats = [(k, v.data().asnumpy())
+                 for k, v in net.collect_params().items()
+                 if "running" in k]
+        assert stats, "expected BatchNorm running stats"
+        return stats
+
+    eager = run(False)
+    fused = run(True)
+    assert len(eager) == len(fused)
+    for (ke, ve), (kf, vf) in zip(eager, fused):
+        np.testing.assert_allclose(vf, ve, rtol=1e-5, atol=1e-6,
+                                   err_msg="%s vs %s" % (ke, kf))

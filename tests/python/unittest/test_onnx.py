@@ -121,3 +121,72 @@ def test_onnx_unsupported_op_raises(tmp_path):
         mxonnx.export_model(y, {}, [(2, 4)],
                             onnx_file_path=os.path.join(
                                 str(tmp_path), "x.onnx"))
+
+
+def test_onnx_fc_flatten_false_roundtrip(tmp_path):
+    """Dense(flatten=False) on a 3-D input must export as a last-axis
+    MatMul, not Flatten+Gemm (advisor r3): the round-tripped model keeps
+    the leading axes."""
+    import incubator_mxnet_tpu.symbol as S
+    rs = onp.random.RandomState(7)
+    y = S.FullyConnected(S.var("data"), S.var("w"), S.var("b"),
+                         num_hidden=5, flatten=False, name="fc")
+    arg = {"w": nd.array(rs.randn(5, 4).astype(onp.float32)),
+           "b": nd.array(rs.randn(5).astype(onp.float32))}
+    x = nd.array(rs.randn(2, 3, 4).astype(onp.float32))
+    want = _eval_symbol(y, {"data": x, **arg}).asnumpy()
+    assert want.shape == (2, 3, 5)
+    path = mxonnx.export_model(y, arg, [(2, 3, 4)],
+                               onnx_file_path=os.path.join(
+                                   str(tmp_path), "fcnf.onnx"))
+    sym, arg_p, aux_p = mxonnx.import_model(path)
+    meta = mxonnx.get_model_metadata(path)
+    (in_name, _), = meta["input_tensor_data"]
+    got = _eval_symbol(sym, {in_name: x, **arg_p}).asnumpy()
+    assert got.shape == (2, 3, 5)
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_fc_flatten_false_no_bias(tmp_path):
+    import incubator_mxnet_tpu.symbol as S
+    rs = onp.random.RandomState(8)
+    y = S.FullyConnected(S.var("data"), S.var("w"), num_hidden=6,
+                         flatten=False, no_bias=True, name="fc")
+    arg = {"w": nd.array(rs.randn(6, 4).astype(onp.float32))}
+    x = nd.array(rs.randn(2, 3, 4).astype(onp.float32))
+    want = _eval_symbol(y, {"data": x, **arg}).asnumpy()
+    path = mxonnx.export_model(y, arg, [(2, 3, 4)],
+                               onnx_file_path=os.path.join(
+                                   str(tmp_path), "fcnb.onnx"))
+    sym, arg_p, aux_p = mxonnx.import_model(path)
+    meta = mxonnx.get_model_metadata(path)
+    (in_name, _), = meta["input_tensor_data"]
+    got = _eval_symbol(sym, {in_name: x, **arg_p}).asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_bn_fix_gamma_substitutes_ones(tmp_path):
+    """Symbol BatchNorm defaults to fix_gamma=True (gamma ignored at
+    runtime); the export must not bake a non-ones gamma buffer into the
+    ONNX graph (advisor r3)."""
+    import incubator_mxnet_tpu.symbol as S
+    rs = onp.random.RandomState(9)
+    y = S.BatchNorm(S.var("data"), S.var("g"), S.var("b"),
+                    S.var("mm"), S.var("mv"), name="bn")
+    arg = {"g": nd.array(onp.full(4, 3.5, onp.float32)),   # NOT ones
+           "b": nd.array(rs.randn(4).astype(onp.float32))}
+    aux = {"mm": nd.array(rs.randn(4).astype(onp.float32)),
+           "mv": nd.array(rs.rand(4).astype(onp.float32) + 0.5)}
+    x = nd.array(rs.randn(2, 4, 3, 3).astype(onp.float32))
+    res = _eval_symbol(y, {"data": x, **arg, **aux})
+    want = (res[0] if isinstance(res, (list, tuple)) else res).asnumpy()
+    path = mxonnx.export_model(y, {**arg, **aux}, [(2, 4, 3, 3)],
+                               onnx_file_path=os.path.join(
+                                   str(tmp_path), "bnfg.onnx"))
+    sym, arg_p, aux_p = mxonnx.import_model(path)
+    meta = mxonnx.get_model_metadata(path)
+    (in_name, _), = meta["input_tensor_data"]
+    gres = _eval_symbol(sym, {in_name: x, **arg_p, **aux_p})
+    got = (gres[0] if isinstance(gres, (list, tuple)) else
+           gres).asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
