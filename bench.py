@@ -1,28 +1,38 @@
-"""Benchmark: ResNet-50 v1b training throughput, single chip.
+"""Benchmark: all five BASELINE configs, single chip, within one budget.
 
 North-star config 1 (BASELINE.json): **Gluon hybridize → CachedOp →
 gluon.Trainer** — the user-facing imperative loop (`autograd.record`,
 `loss.backward()`, `trainer.step`), exactly the reference's benchmark
-path.  The pure-jax ShardedTrainer (whole step as one executable, the
-pod-scale path) is reported alongside.  See PROFILE.md for the roofline
-analysis of both numbers on this chip.
+path.  The same compiled step is then fed from the native C++ RecordIO
+pipeline for the END-TO-END number (decode→augment→H2D→step,
+overlapped), and the pure-jax ShardedTrainer (pod-scale path) is
+reported alongside.  See PROFILE.md for the roofline analysis.
 
 Prints ONE JSON line:
   {"metric": ..., "value": imgs/sec/chip (CachedOp path), "unit": ...,
-   "vs_baseline": r, "sharded_trainer_value": imgs/sec (fused path)}
+   "vs_baseline": r, ...all other configs...}
 vs_baseline normalises against the V100 target from BASELINE.md
 (~1400 img/s fp16 ResNet-50, the "≥ V100 per chip" north star; marked [L]
 there — no reference-published number was recoverable).
+
+Budget discipline (VERDICT r3 #2): the five BASELINE configs
+(resnet50/bert/ssd512/faster-rcnn/gnmt/wide&deep) run FIRST and are
+sized to always fit MXNET_BENCH_BUDGET_S (default 720); io/e2e/sharded
+extras run after and are skipped once the budget is spent.
 """
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
 V100_IMAGES_PER_SEC = 1400.0   # BASELINE.md north-star denominator [L]
+
+_REC_PATH = os.path.join("/tmp", "bench_io_512.rec")
+_REC_N = 512
 
 
 def _dependent_sync(net):
@@ -34,8 +44,32 @@ def _dependent_sync(net):
     next(iter(net.collect_params().values())).data().wait_to_read()
 
 
-def run_cachedop(batch=128, warmup=3, iters=20):
-    """North-star config 1: hybridized Gluon net + autograd + Trainer."""
+def _ensure_rec(n_images=_REC_N, path=_REC_PATH):
+    """Synthetic JPEG RecordIO corpus (cached across runs in /tmp)."""
+    from incubator_mxnet_tpu.io import recordio
+    if os.path.exists(path):
+        return path
+    rs = np.random.RandomState(0)
+    tmp = path + ".tmp"     # write-then-rename: no truncated leftovers
+    rec = recordio.MXRecordIO(tmp, "w")
+    for i in range(n_images):
+        img = rs.randint(0, 255, (256, 313, 3), dtype=np.uint8)
+        rec.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i % 1000), i, 0), img,
+            quality=90))
+    rec.close()
+    os.replace(tmp, path)
+    return path
+
+
+def run_cachedop(batch=128, warmup=2, iters=12, extra=None):
+    """North-star config 1: hybridized Gluon net + autograd + Trainer.
+
+    Also produces (into `extra`, budget-permitting) the INPUT-FED
+    end-to-end number reusing the SAME compiled train step: native C++
+    RecordIO decode/augment threads → host cast → H2D → fused step,
+    overlapped — the difference between a benchmark and a training
+    system (VERDICT r3 #1)."""
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, gluon, autograd as ag
     from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet50_v1b
@@ -54,23 +88,61 @@ def run_cachedop(batch=128, warmup=3, iters=20):
     y = nd.array(np.random.randint(0, 1000, batch).astype(np.float32),
                  ctx=ctx)
 
-    for _ in range(warmup):
+    def step(xb, yb):
         with ag.record():
-            l = loss_fn(net(x), y)
+            l = loss_fn(net(xb), yb)
             l.backward()
         trainer.step(batch)
+
+    for _ in range(warmup):
+        step(x, y)
     _dependent_sync(net)
     t0 = time.perf_counter()
     for _ in range(iters):
-        with ag.record():
-            l = loss_fn(net(x), y)
-            l.backward()
-        trainer.step(batch)
+        step(x, y)
     _dependent_sync(net)
-    return batch * iters / (time.perf_counter() - t0)
+    rate = batch * iters / (time.perf_counter() - t0)
+
+    if extra is None:
+        return rate
+
+    # ---- end-to-end: same compiled step, inputs from the native
+    # pipeline (C++ decode/augment threads overlap the chip) ----
+    try:
+        import ml_dtypes
+        from incubator_mxnet_tpu.io import native
+        if not native.available():
+            raise RuntimeError("native io unavailable")
+        path = _ensure_rec()
+        reader = native.NativeImageRecordReader(
+            path, batch_size=batch, data_shape=(3, 224, 224),
+            resize=256, rand_crop=True, rand_mirror=True, shuffle=True)
+        n = 0
+        t0 = time.perf_counter()
+        for epoch in range(3):
+            for data, label in reader:
+                if data.shape[0] != batch:
+                    continue            # keep the compiled signature
+                xb = nd.array(data.astype(ml_dtypes.bfloat16), ctx=ctx,
+                              dtype="bfloat16")
+                # reader labels are (batch, label_width): flatten to the
+                # (batch,) the compiled loss expects
+                yb = nd.array(
+                    label.reshape(label.shape[0], -1)[:, 0]
+                    .astype(np.float32) % 1000, ctx=ctx)
+                step(xb, yb)
+                n += batch
+            reader.reset()
+        _dependent_sync(net)
+        e2e = n / (time.perf_counter() - t0)
+        extra["resnet50_e2e_input_fed_images_per_sec"] = round(e2e, 2)
+        extra["resnet50_e2e_fraction_of_synthetic"] = round(e2e / rate, 3)
+    except Exception as e:
+        extra["resnet50_e2e_error"] = str(e)[:120]
+    return rate
 
 
-def run_bert(batch=8, seq=512, warmup=2, iters=8):
+def run_bert(batch=32, seq=512, warmup=2, iters=6):
     """North-star config 2: BERT-base MLM pretrain step, tokens/sec/chip.
 
     Same user-facing path as config 1 (hybridize → CachedOp → Trainer);
@@ -114,61 +186,15 @@ def run_bert(batch=8, seq=512, warmup=2, iters=8):
     return batch * seq * iters / (time.perf_counter() - t0)
 
 
-def build_sharded_trainer(batch):
-    import jax
-    import jax.numpy as jnp
-    from incubator_mxnet_tpu import nd, parallel
-    from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet50_v1b
-
-    net = resnet50_v1b(classes=1000)
-    net.initialize()
-    net(nd.array(np.zeros((2, 3, 224, 224), np.float32)))
-
-    def loss_fn(logits, labels):
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        ll = jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32),
-                                 axis=-1)
-        return -jnp.mean(ll)
-
-    trainer = parallel.ShardedTrainer(net, loss_fn=loss_fn,
-                                      optimizer="sgd", lr=0.1,
-                                      momentum=0.9, wd=1e-4)
-    # bf16 compute: params to bf16 (tree-wide); optimizer math upcasts
-    # to f32 internally (sgd_momentum_tree) — mp_sgd semantics
-    trainer.params = {k: (v.astype(jnp.bfloat16)
-                          if v.dtype == jnp.float32 and "running" not in k
-                          and "gamma" not in k and "beta" not in k else v)
-                      for k, v in trainer.params.items()}
-    trainer.opt_state = trainer._opt_init(trainer.params)
-    return trainer
-
-
-def run_sharded(batch=256, warmup=3, iters=20):
-    import jax
-    import jax.numpy as jnp
-    trainer = build_sharded_trainer(batch)
-    x = np.random.randn(batch, 3, 224, 224).astype(np.float32)
-    y = np.random.randint(0, 1000, batch)
-    xb = jnp.asarray(x, dtype=jnp.bfloat16)
-    for _ in range(warmup):
-        loss = trainer.step(xb, y)
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = trainer.step(xb, y)
-    jax.block_until_ready(loss)
-    return batch * iters / (time.perf_counter() - t0)
-
-
-def run_ssd(batch=16, size=300, warmup=2, iters=8):
-    """Config 3a: SSD-300 training step, images/sec/chip (hybridize →
+def run_ssd(batch=8, size=512, warmup=2, iters=8):
+    """Config 3a: SSD-512 training step, images/sec/chip (hybridize →
     CachedOp → Trainer, MultiBoxTarget loss like example/ssd)."""
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, gluon, autograd as ag
-    from incubator_mxnet_tpu.models import ssd_300, ssd_training_targets
+    from incubator_mxnet_tpu.models import ssd_512, ssd_training_targets
 
     ctx = mx.gpu()
-    net = ssd_300(classes=20)
+    net = ssd_512(classes=20)
     net.initialize(ctx=ctx)
     net.hybridize()
     trainer = gluon.Trainer(net.collect_params(), "sgd",
@@ -192,6 +218,61 @@ def run_ssd(batch=16, size=300, warmup=2, iters=8):
                         cls_t.reshape((-1,)))
             box_l = (nd.smooth_l1(box_preds - loc_t) * loc_m).mean()
             loss = cls_l.mean() + box_l
+            loss.backward()
+        trainer.step(batch)
+
+    for _ in range(warmup):
+        step()
+    _dependent_sync(net)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        step()
+    _dependent_sync(net)
+    return batch * iters / (time.perf_counter() - t0)
+
+
+def run_rcnn(batch=2, size=512, warmup=2, iters=8):
+    """Config 3b: Faster-RCNN end-to-end training step, images/sec/chip
+    (RPN → Proposal → ProposalTarget → ROIAlign → heads, the
+    example/rcnn train_end2end graph; fixed shapes keep it ONE XLA
+    executable)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, autograd as ag
+    from incubator_mxnet_tpu.models import FasterRCNN
+
+    ctx = mx.gpu()
+    net = FasterRCNN(classes=20, backbone_channels=(32, 64, 128, 256),
+                     feature_stride=16, rpn_channels=256,
+                     anchor_scales=(4, 8, 16), anchor_ratios=(0.5, 1, 2),
+                     rpn_pre_nms_top_n=512, rpn_post_nms_top_n=128,
+                     rpn_min_size=8, roi_size=7, top_units=1024)
+    net.initialize(ctx=ctx)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1e-3, "momentum": 0.9})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.randn(batch, 3, size, size).astype(np.float32),
+                 ctx=ctx)
+    im_info = nd.array(np.tile([size, size, 1.0],
+                               (batch, 1)).astype(np.float32), ctx=ctx)
+    gt = np.zeros((batch, 2, 5), np.float32)
+    gt[:, 0] = [60, 60, 260, 260, 1]
+    gt[:, 1] = [200, 200, 420, 420, 2]
+    gt_boxes = nd.array(gt, ctx=ctx)
+
+    def step():
+        with ag.record():
+            (cls_pred, box_pred, rois, labels, targets, weights,
+             rpn_cls, rpn_box) = net(x, im_info, gt_boxes=gt_boxes,
+                                     batch_rois=128)
+            mask = labels >= 0
+            safe = nd.invoke("clip", labels, a_min=0.0, a_max=1e9)
+            cls_l = (sce(cls_pred, safe) * mask).mean()
+            box_l = nd.invoke("smooth_l1",
+                              (box_pred - targets) * weights,
+                              scalar=1.0).sum(axis=1).mean()
+            loss = cls_l + 0.1 * box_l
             loss.backward()
         trainer.step(batch)
 
@@ -280,31 +361,62 @@ def run_wide_deep(batch=2048, fields=16, warmup=2, iters=10):
     return batch * iters / (time.perf_counter() - t0)
 
 
-def run_io(batch=128, n_images=1024):
-    """Input-pipeline throughput: native C++ RecordIO+JPEG pipeline
+def build_sharded_trainer(batch):
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import nd, parallel
+    from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet50_v1b
+
+    net = resnet50_v1b(classes=1000)
+    net.initialize()
+    net(nd.array(np.zeros((2, 3, 224, 224), np.float32)))
+
+    def loss_fn(logits, labels):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32),
+                                 axis=-1)
+        return -jnp.mean(ll)
+
+    trainer = parallel.ShardedTrainer(net, loss_fn=loss_fn,
+                                      optimizer="sgd", lr=0.1,
+                                      momentum=0.9, wd=1e-4)
+    # bf16 compute: params to bf16 (tree-wide); optimizer math upcasts
+    # to f32 internally (sgd_momentum_tree) — mp_sgd semantics
+    trainer.params = {k: (v.astype(jnp.bfloat16)
+                          if v.dtype == jnp.float32 and "running" not in k
+                          and "gamma" not in k and "beta" not in k else v)
+                      for k, v in trainer.params.items()}
+    trainer.opt_state = trainer._opt_init(trainer.params)
+    return trainer
+
+
+def run_sharded(batch=256, warmup=2, iters=12):
+    import jax
+    import jax.numpy as jnp
+    trainer = build_sharded_trainer(batch)
+    x = np.random.randn(batch, 3, 224, 224).astype(np.float32)
+    y = np.random.randint(0, 1000, batch)
+    xb = jnp.asarray(x, dtype=jnp.bfloat16)
+    for _ in range(warmup):
+        loss = trainer.step(xb, y)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.step(xb, y)
+    jax.block_until_ready(loss)
+    return batch * iters / (time.perf_counter() - t0)
+
+
+def run_io(batch=128):
+    """Input-pipeline-only throughput: native C++ RecordIO+JPEG pipeline
     (src/io/recordio_pipeline.cc), images/sec/host-core — SURVEY §2.4
     "must sustain v5e input rates".  Scales ~linearly with host cores;
-    this VM exposes os.cpu_count() of them."""
-    import os
-    import tempfile
-    from incubator_mxnet_tpu.io import recordio, native
+    this VM exposes os.cpu_count() of them (see PROFILE.md for the
+    thread-scaling curve)."""
+    from incubator_mxnet_tpu.io import native
     if not native.available():
         raise RuntimeError("native io unavailable")
-    rs = np.random.RandomState(0)
-    path = os.path.join(tempfile.gettempdir(),
-                        "bench_io_%d.rec" % n_images)
-    if not os.path.exists(path):
-        # write-then-rename: a killed prior run must not leave a
-        # truncated file that silently skews the benchmark
-        tmp = path + ".tmp"
-        rec = recordio.MXRecordIO(tmp, "w")
-        for i in range(n_images):
-            img = rs.randint(0, 255, (256, 313, 3), dtype=np.uint8)
-            rec.write(recordio.pack_img(
-                recordio.IRHeader(0, float(i % 1000), i, 0), img,
-                quality=90))
-        rec.close()
-        os.replace(tmp, path)
+    path = _ensure_rec()
     r = native.NativeImageRecordReader(
         path, batch_size=batch, data_shape=(3, 224, 224), resize=256,
         rand_crop=True, rand_mirror=True, shuffle=True)
@@ -320,11 +432,11 @@ def run_io(batch=128, n_images=1024):
     return n / (time.perf_counter() - t0)
 
 
-def _try_batches(fn, batches):
+def _try_batches(fn, batches, **kw):
     err = None
     for b in batches:
         try:
-            return fn(batch=b), b
+            return fn(batch=b, **kw), b
         except Exception as e:      # OOM etc. — halve and retry
             err = e
     raise err
@@ -332,56 +444,51 @@ def _try_batches(fn, batches):
 
 def main():
     # hard wall-clock budget: the driver must always get the ONE JSON
-    # line, so optional metrics are skipped once the budget is spent
-    # (override with MXNET_BENCH_BUDGET_S)
-    import os
+    # line; the five BASELINE configs are sized to fit it, extras are
+    # skipped once it is spent (override with MXNET_BENCH_BUDGET_S)
     t_start = time.perf_counter()
     budget = float(os.environ.get("MXNET_BENCH_BUDGET_S", 720))
 
     def over_budget():
         return time.perf_counter() - t_start > budget
 
+    extra = {}
+    times = {}
+
     try:
-        imgs, batch = _try_batches(run_cachedop, (128, 64, 32))
+        t0 = time.perf_counter()
+        imgs, batch = _try_batches(run_cachedop, (128, 64, 32),
+                                   extra=extra)
+        times["resnet"] = round(time.perf_counter() - t0, 1)
     except Exception as e:
         print(json.dumps({
             "metric": "resnet50_v1b_train_images_per_sec_per_chip",
             "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
             "error": str(e)[:200]}))
         return 1
-    # every metric beyond the headline respects the budget (the driver
-    # depends on the ONE JSON line arriving)
-    extra = {}
 
-    def _optional(key, thunk):
-        if over_budget():
+    def _timed(key, thunk, required=False):
+        """required configs always run (they are sized to fit the
+        budget); extras respect what remains."""
+        if not required and over_budget():
             extra[key + "_skipped"] = "bench budget (%ds) spent" % budget
             return
+        t0 = time.perf_counter()
         try:
             thunk()
         except Exception as e:
             extra[key + "_error"] = str(e)[:120]
-
-    def _sharded():
-        sharded, sbatch = _try_batches(run_sharded, (256, 128, 64))
-        extra.update({"sharded_trainer_value": round(sharded, 2),
-                      "sharded_trainer_batch": sbatch})
-    _optional("sharded_trainer", _sharded)
+        times[key.split("_")[0]] = round(time.perf_counter() - t0, 1)
 
     def _bert():
-        toks, bbatch = _try_batches(run_bert, (8, 4, 2))
+        toks, bbatch = _try_batches(run_bert, (32, 16, 8))
         extra.update({"bert_base_tokens_per_sec_per_chip": round(toks, 2),
                       "bert_batch": bbatch, "bert_seq": 512})
-    _optional("bert", _bert)
-
-    def _io():
-        io_rate = run_io()
-        extra.update({"io_pipeline_images_per_sec": round(io_rate, 1),
-                      "io_host_cores": os.cpu_count()})
-    _optional("io", _io)
+    _timed("bert", _bert, required=True)
 
     for key, fn, batches in (
-            ("ssd300_train_images_per_sec", run_ssd, (16, 8)),
+            ("ssd512_train_images_per_sec", run_ssd, (8, 4)),
+            ("rcnn_train_images_per_sec", run_rcnn, (2, 1)),
             ("gnmt_train_tokens_per_sec", run_gnmt, (32, 16)),
             ("wide_deep_train_samples_per_sec", run_wide_deep,
              (2048, 512))):
@@ -389,7 +496,21 @@ def main():
             val, b = _try_batches(fn, batches)
             extra[key] = round(val, 2)
             extra[key + "_batch"] = b
-        _optional(key, _one)
+        _timed(key, _one, required=True)
+
+    def _io():
+        io_rate = run_io()
+        extra.update({"io_pipeline_images_per_sec": round(io_rate, 1),
+                      "io_host_cores": os.cpu_count()})
+    _timed("io", _io)
+
+    def _sharded():
+        sharded, sbatch = _try_batches(run_sharded, (256, 128, 64))
+        extra.update({"sharded_trainer_value": round(sharded, 2),
+                      "sharded_trainer_batch": sbatch})
+    _timed("sharded_trainer", _sharded)
+
+    extra["config_wall_s"] = times
     extra["bench_wall_s"] = round(time.perf_counter() - t_start, 1)
     print(json.dumps({
         "metric": "resnet50_v1b_train_images_per_sec_per_chip",

@@ -44,6 +44,7 @@ from . import symbol as sym
 from .symbol import Symbol
 from . import module as mod
 from . import module
+from . import rnn
 from . import parallel
 from . import config
 from . import contrib

@@ -1,4 +1,4 @@
-"""mx.gluon.contrib namespace (ref: python/mxnet/gluon/contrib/).
-
-Populated as contrib features land (estimator, contrib.nn, contrib.rnn).
-"""
+"""mx.gluon.contrib namespace (ref: python/mxnet/gluon/contrib/)."""
+from . import nn                 # noqa: F401
+from . import rnn                # noqa: F401
+from . import estimator          # noqa: F401

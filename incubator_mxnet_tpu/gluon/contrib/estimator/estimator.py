@@ -1,0 +1,103 @@
+"""Gluon Estimator — the fit() loop as a component (ref:
+python/mxnet/gluon/contrib/estimator/estimator.py).
+
+Runs the SAME hot path as hand-written training (hybridized CachedOp →
+whole-step fusion via Trainer.step); the estimator only adds the
+lifecycle around it, so there is no throughput tax for using it.
+"""
+from __future__ import annotations
+
+from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,
+                            BatchBegin, BatchEnd, StoppingHandler,
+                            MetricHandler, LoggingHandler)
+from .... import autograd
+from ....base import MXNetError
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    """fit()/evaluate() driver over (net, loss, metrics, trainer).
+
+    train_data batches may be (data, label) tuples (e.g. a gluon
+    DataLoader) or io.DataBatch objects.
+    """
+
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 context=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = train_metrics or []
+        if not isinstance(self.train_metrics, (list, tuple)):
+            self.train_metrics = [self.train_metrics]
+        self.train_metrics = list(self.train_metrics)
+        self.trainer = trainer
+        self.context = context
+        if trainer is None:
+            from ...trainer import Trainer
+            self.trainer = Trainer(net.collect_params(), "sgd",
+                                   {"learning_rate": 0.01})
+
+    @staticmethod
+    def _split(batch):
+        if isinstance(batch, (list, tuple)):
+            data, label = batch[0], batch[1]
+        else:                      # io.DataBatch
+            data = batch.data[0] if isinstance(batch.data, list) \
+                else batch.data
+            label = batch.label[0] if isinstance(batch.label, list) \
+                else batch.label
+        return data, label
+
+    def evaluate(self, val_data, val_metrics):
+        if not isinstance(val_metrics, (list, tuple)):
+            val_metrics = [val_metrics]
+        for m in val_metrics:
+            m.reset()
+        if hasattr(val_data, "reset"):      # DataIter: rewindable
+            val_data.reset()
+        for batch in val_data:
+            data, label = self._split(batch)
+            pred = self.net(data)
+            for m in val_metrics:
+                m.update([label], [pred])
+        return val_metrics
+
+    def fit(self, train_data, val_data=None, epochs=None,
+            event_handlers=None, batches=None):
+        if epochs is None and batches is None:
+            epochs = 1
+        stopper = StoppingHandler(max_epoch=epochs, max_batch=batches)
+        handlers = [stopper, MetricHandler(self.train_metrics)]
+        handlers.extend(event_handlers or [])
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(metrics=self.train_metrics))
+        handlers.sort(key=lambda h: getattr(h, "priority", 0))
+
+        def _fire(event, *args, **kw):
+            for h in handlers:
+                if hasattr(h, event):
+                    getattr(h, event)(self, *args, **kw)
+
+        _fire("train_begin")
+        while not stopper.stop_training:
+            _fire("epoch_begin")
+            for batch in train_data:
+                data, label = self._split(batch)
+                bs = data.shape[0]
+                _fire("batch_begin")
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                    loss.backward()
+                self.trainer.step(bs)
+                _fire("batch_end", pred=pred, label=label, loss=loss)
+                if stopper.stop_training:
+                    break
+            if hasattr(train_data, "reset"):    # DataIter epochs
+                train_data.reset()
+            _fire("epoch_end")
+            if any(getattr(h, "stop_training", False) for h in handlers):
+                break
+        _fire("train_end")
+        return self

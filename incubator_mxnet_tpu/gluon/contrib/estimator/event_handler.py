@@ -1,0 +1,236 @@
+"""Estimator event handlers (ref: python/mxnet/gluon/contrib/estimator/
+event_handler.py — the mixin-based lifecycle hook system)."""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+__all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop training at max_epoch or max_batch (ref: StoppingHandler)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset train metrics each epoch, update them each batch."""
+
+    def __init__(self, metrics, priority=-1000):
+        self.metrics = metrics or []
+        self.priority = priority
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, pred=None, label=None, loss=None,
+                  **kwargs):
+        for m in self.metrics:
+            if getattr(m, "name", "") == "loss" and loss is not None:
+                m.update(0, loss)
+            elif pred is not None and label is not None:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run eval_fn on val_data every `epoch_period` epochs (or
+    `batch_period` batches)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1,
+                 batch_period=None, priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.priority = priority
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and \
+                self.current_batch % self.batch_period == 0:
+            self.eval_fn(self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and \
+                self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd,
+                     BatchEnd):
+    """Periodic metric logging (ref: LoggingHandler)."""
+
+    def __init__(self, log_interval="epoch", metrics=None,
+                 priority=-1000):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.priority = priority
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.processed_samples = 0
+        self.logger = logging.getLogger("estimator")
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        t = time.time() - self.train_start
+        msg = "Train finished in %.3fs: " % t
+        msg += " ".join("%s=%.4f" % m.get() for m in self.metrics)
+        self.logger.info(msg)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+        self.batch_index = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        t = time.time() - self.epoch_start
+        msg = "Epoch %d finished in %.3fs: " % (self.current_epoch, t)
+        msg += " ".join("%s=%.4f" % m.get() for m in self.metrics)
+        self.logger.info(msg)
+        self.current_epoch += 1
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.batch_index += 1
+        if isinstance(self.log_interval, int) and \
+                self.batch_index % self.log_interval == 0:
+            msg = "Epoch %d batch %d: " % (self.current_epoch,
+                                           self.batch_index)
+            msg += " ".join("%s=%.4f" % m.get() for m in self.metrics)
+            self.logger.info(msg)
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save params (+ trainer states) every epoch; keep the best by a
+    monitored metric (ref: CheckpointHandler, simplified surface)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 mode="min", save_best=False, epoch_period=1):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        assert mode in ("min", "max")
+        self.mode = mode
+        self.best = float("inf") if mode == "min" else -float("inf")
+        self.current_epoch = 0
+
+    def _better(self, v):
+        return v < self.best if self.mode == "min" else v > self.best
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.current_epoch % self.epoch_period:
+            return
+        os.makedirs(self.model_dir, exist_ok=True)
+        pfx = os.path.join(self.model_dir, self.model_prefix)
+        estimator.net.save_parameters(
+            "%s-epoch%d.params" % (pfx, self.current_epoch))
+        if estimator.trainer is not None:
+            estimator.trainer.save_states(
+                "%s-epoch%d.states" % (pfx, self.current_epoch))
+        if self.save_best and self.monitor is not None:
+            _, v = self.monitor.get()
+            if self._better(v):
+                self.best = v
+                estimator.net.save_parameters("%s-best.params" % pfx)
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd):
+    """Stop when a monitored metric stops improving (ref:
+    EarlyStoppingHandler)."""
+
+    def __init__(self, monitor, mode="min", patience=0, min_delta=0):
+        self.monitor = monitor
+        assert mode in ("min", "max")
+        self.mode = mode
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = None
+        self.wait = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.best = None
+        self.wait = 0
+        self.stop_training = False
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        _, v = self.monitor.get()
+        if self.best is None:
+            self.best = v
+            return
+        improved = (v < self.best - self.min_delta
+                    if self.mode == "min"
+                    else v > self.best + self.min_delta)
+        if improved:
+            self.best = v
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stop_training = True

@@ -1,0 +1,2 @@
+"""gluon.contrib.nn (ref: python/mxnet/gluon/contrib/nn/)."""
+from .basic_layers import *     # noqa: F401,F403

@@ -1,0 +1,163 @@
+"""gluon.contrib.nn layers (ref: python/mxnet/gluon/contrib/nn/
+basic_layers.py)."""
+from __future__ import annotations
+
+from ...block import Block, HybridBlock, record_state_update
+from ...nn.basic_layers import (Sequential, HybridSequential, BatchNorm,
+                                Embedding)
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity",
+           "SparseEmbedding", "SyncBatchNorm", "PixelShuffle1D",
+           "PixelShuffle2D", "PixelShuffle3D"]
+
+
+class Concurrent(Sequential):
+    """Feed the SAME input to every child, concat outputs along `axis`
+    (ref: contrib/nn/basic_layers.py Concurrent)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as F
+        return F.concat(*[blk(x) for blk in self._children.values()],
+                        dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (ref: contrib HybridConcurrent)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as F
+        return F.concat(*[blk(x) for blk in self._children.values()],
+                        dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through — the placeholder branch of a HybridConcurrent
+    (ref: contrib Identity)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding with `row_sparse` gradient, for very large tables
+    updated through the sparse KVStore path (ref: contrib
+    SparseEmbedding; here the one Embedding implementation already
+    carries sparse_grad)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._embedding = Embedding(input_dim, output_dim, dtype=dtype,
+                                    weight_initializer=weight_initializer,
+                                    sparse_grad=True)
+        self.register_child(self._embedding, "embedding")
+
+    def forward(self, x):
+        return self._embedding(x)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device Batch Normalization (ref: contrib SyncBatchNorm over
+    src/operator/contrib/sync_batch_norm-inl.h).
+
+    TPU-first realisation: under pjit/GSPMD (ShardedTrainer) a plain
+    BatchNorm's batch reduction is already GLOBAL — XLA inserts the
+    cross-device collectives when the batch axis is sharded, which is
+    the in-compiler form of the reference's key-based AllReduce
+    rendezvous.  Set `axis_name` to a shard_map mesh axis to get
+    explicit pmean'd moments inside per-device-body regions (the
+    `_contrib_SyncBatchNorm` op); leave it None for the pjit path or
+    single-device use, where this IS BatchNorm — the same ndev=1
+    degradation the reference has.  `num_devices`/`key` are accepted
+    for API parity only.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", axis_name=None,
+                 **kwargs):
+        super().__init__(
+            axis=1, momentum=momentum, epsilon=epsilon, center=center,
+            scale=scale, use_global_stats=use_global_stats,
+            beta_initializer=beta_initializer,
+            gamma_initializer=gamma_initializer,
+            running_mean_initializer=running_mean_initializer,
+            running_variance_initializer=running_variance_initializer,
+            in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices
+        self._axis_name = axis_name
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean,
+                       running_var):
+        if not self._axis_name:
+            return super().hybrid_forward(F, x, gamma, beta,
+                                          running_mean, running_var)
+        from .... import autograd as ag
+        out, mean, var = F.invoke(
+            "_contrib_SyncBatchNorm", x, gamma, beta, running_mean,
+            running_var, eps=self._epsilon, momentum=self._momentum,
+            fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats,
+            ndev=self._num_devices or 1, axis_name=self._axis_name)
+        if ag.is_training() and not self._use_global_stats:
+            m = self._momentum
+            record_state_update(self.running_mean,
+                                running_mean * m + mean * (1 - m))
+            record_state_update(self.running_var,
+                                running_var * m + var * (1 - m))
+        return out
+
+
+class _PixelShuffle(HybridBlock):
+    """Common rearrange: (B, C·∏f, *S) → (B, C, *(S·f)) — sub-pixel
+    convolution upsampling (ref: contrib PixelShuffle1D/2D/3D)."""
+
+    def __init__(self, factor, ndim, **kwargs):
+        super().__init__(**kwargs)
+        self._f = ((factor,) * ndim if isinstance(factor, int)
+                   else tuple(factor))
+        assert len(self._f) == ndim
+        self._ndim = ndim
+
+    def hybrid_forward(self, F, x):
+        f = self._f
+        nd_ = self._ndim
+        B = x.shape[0]
+        spatial = x.shape[2:]
+        C = x.shape[1]
+        for fi in f:
+            C //= fi
+        # (B, C, f1..fn, s1..sn) → interleave fi after each si
+        x = F.reshape(x, (B, C) + f + tuple(spatial))
+        perm = [0, 1]
+        for i in range(nd_):
+            perm.extend([2 + nd_ + i, 2 + i])   # si then fi
+        x = F.transpose(x, axes=tuple(perm))
+        out_sp = tuple(s * fi for s, fi in zip(spatial, f))
+        return F.reshape(x, (B, C) + out_sp)
+
+
+class PixelShuffle1D(_PixelShuffle):
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 1, **kwargs)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 2, **kwargs)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 3, **kwargs)

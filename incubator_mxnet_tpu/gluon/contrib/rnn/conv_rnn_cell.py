@@ -1,0 +1,162 @@
+"""Convolutional recurrent cells (ref: python/mxnet/gluon/contrib/rnn/
+conv_rnn_cell.py — ConvRNN/ConvLSTM/ConvGRU over 1D/2D/3D).
+
+State is a feature map; the i2h/h2h projections are convolutions.  The
+cell step is pure tensor math, so a `lax.scan` over steps (via
+cell.unroll or the gluon rnn layer machinery) compiles to one fused
+XLA loop on TPU.
+"""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import RecurrentCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _ConvCellBase(RecurrentCell):
+    """Shared conv plumbing: input_shape (C, *spatial) is required up
+    front — the state's spatial shape must be known before the first
+    step (the reference requires the same)."""
+
+    def __init__(self, input_shape, hidden_channels, ndim, ngates,
+                 i2h_kernel=3, h2h_kernel=3, i2h_pad=None,
+                 conv_layout="NCHW", activation="tanh",
+                 i2h_weight_initializer=None,
+                 h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        self._ndim = ndim
+        self._input_shape = tuple(input_shape)     # (C_in, *spatial)
+        self._hc = hidden_channels
+        self._ngates = ngates
+        self._activation = activation
+        self._i2h_kernel = _tup(i2h_kernel, ndim)
+        self._h2h_kernel = _tup(h2h_kernel, ndim)
+        for k in self._h2h_kernel:
+            assert k % 2 == 1, "h2h_kernel must be odd (same-pad)"
+        self._i2h_pad = (_tup(i2h_pad, ndim) if i2h_pad is not None
+                         else tuple(k // 2 for k in self._i2h_kernel))
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+        cin = self._input_shape[0]
+        self.i2h_weight = self.params.get(
+            "i2h_weight",
+            shape=(ngates * hidden_channels, cin) + self._i2h_kernel,
+            init=i2h_weight_initializer)
+        self.h2h_weight = self.params.get(
+            "h2h_weight",
+            shape=(ngates * hidden_channels,
+                   hidden_channels) + self._h2h_kernel,
+            init=h2h_weight_initializer)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(ngates * hidden_channels,),
+            init=i2h_bias_initializer)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(ngates * hidden_channels,),
+            init=h2h_bias_initializer)
+
+    def _state_shape(self, batch_size):
+        # i2h 'same'-pads by default; with a custom pad the spatial dims
+        # follow the conv arithmetic (stride 1, no dilation)
+        sp = tuple(s + 2 * p - k + 1
+                   for s, p, k in zip(self._input_shape[1:],
+                                      self._i2h_pad, self._i2h_kernel))
+        return (batch_size, self._hc) + sp
+
+    def _conv(self, F, x, weight, bias, pad):
+        return F.Convolution(
+            x, weight, bias,
+            kernel=weight.shape[2:], num_filter=weight.shape[0],
+            pad=pad, stride=(1,) * self._ndim)
+
+    def _gates(self, F, inputs, states, i2h_weight, h2h_weight,
+               i2h_bias, h2h_bias):
+        i2h = self._conv(F, inputs, i2h_weight, i2h_bias, self._i2h_pad)
+        h2h = self._conv(F, states[0], h2h_weight, h2h_bias,
+                         self._h2h_pad)
+        return i2h, h2h
+
+
+class _ConvRNNCell(_ConvCellBase):
+    def __init__(self, input_shape, hidden_channels, ndim, **kwargs):
+        super().__init__(input_shape, hidden_channels, ndim, 1, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": self._state_shape(batch_size)}]
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._gates(F, inputs, states, i2h_weight,
+                               h2h_weight, i2h_bias, h2h_bias)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class _ConvLSTMCell(_ConvCellBase):
+    def __init__(self, input_shape, hidden_channels, ndim, **kwargs):
+        super().__init__(input_shape, hidden_channels, ndim, 4, **kwargs)
+
+    def state_info(self, batch_size=0):
+        s = self._state_shape(batch_size)
+        return [{"shape": s}, {"shape": s}]
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._gates(F, inputs, states, i2h_weight,
+                               h2h_weight, i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        in_g, forget_g, in_t, out_g = F.split(gates, num_outputs=4,
+                                              axis=1)
+        next_c = F.sigmoid(forget_g) * states[1] + \
+            F.sigmoid(in_g) * F.Activation(in_t,
+                                           act_type=self._activation)
+        next_h = F.sigmoid(out_g) * F.Activation(
+            next_c, act_type=self._activation)
+        return next_h, [next_h, next_c]
+
+
+class _ConvGRUCell(_ConvCellBase):
+    def __init__(self, input_shape, hidden_channels, ndim, **kwargs):
+        super().__init__(input_shape, hidden_channels, ndim, 3, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": self._state_shape(batch_size)}]
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._gates(F, inputs, states, i2h_weight,
+                               h2h_weight, i2h_bias, h2h_bias)
+        i2h_r, i2h_z, i2h_n = F.split(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h_n = F.split(h2h, num_outputs=3, axis=1)
+        reset = F.sigmoid(i2h_r + h2h_r)
+        update = F.sigmoid(i2h_z + h2h_z)
+        cand = F.Activation(i2h_n + reset * h2h_n,
+                            act_type=self._activation)
+        next_h = (1.0 - update) * cand + update * states[0]
+        return next_h, [next_h]
+
+
+def _make(base, ndim, name):
+    class _Cell(base):
+        def __init__(self, input_shape, hidden_channels, **kwargs):
+            super().__init__(input_shape, hidden_channels, ndim,
+                             **kwargs)
+    _Cell.__name__ = _Cell.__qualname__ = name
+    return _Cell
+
+
+Conv1DRNNCell = _make(_ConvRNNCell, 1, "Conv1DRNNCell")
+Conv2DRNNCell = _make(_ConvRNNCell, 2, "Conv2DRNNCell")
+Conv3DRNNCell = _make(_ConvRNNCell, 3, "Conv3DRNNCell")
+Conv1DLSTMCell = _make(_ConvLSTMCell, 1, "Conv1DLSTMCell")
+Conv2DLSTMCell = _make(_ConvLSTMCell, 2, "Conv2DLSTMCell")
+Conv3DLSTMCell = _make(_ConvLSTMCell, 3, "Conv3DLSTMCell")
+Conv1DGRUCell = _make(_ConvGRUCell, 1, "Conv1DGRUCell")
+Conv2DGRUCell = _make(_ConvGRUCell, 2, "Conv2DGRUCell")
+Conv3DGRUCell = _make(_ConvGRUCell, 3, "Conv3DGRUCell")

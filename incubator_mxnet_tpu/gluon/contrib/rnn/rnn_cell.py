@@ -1,0 +1,125 @@
+"""gluon.contrib.rnn cells (ref: python/mxnet/gluon/contrib/rnn/
+rnn_cell.py)."""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import RecurrentCell, LSTMCell
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell"]
+
+
+class VariationalDropoutCell(RecurrentCell):
+    """Variational (a.k.a. locked) dropout around a base cell: ONE
+    dropout mask per sequence, reused at every time step, applied to
+    inputs / outputs / recurrent states (ref: contrib
+    VariationalDropoutCell, Gal & Ghahramani 2016)."""
+
+    def __init__(self, base_cell, drop_inputs=0., drop_states=0.,
+                 drop_outputs=0., **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self.register_child(base_cell, "base_cell")
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._mask_in = None
+        self._mask_states = None
+        self._mask_out = None
+
+    def reset(self):
+        super().reset()
+        self._mask_in = None
+        self._mask_states = None
+        self._mask_out = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    @staticmethod
+    def _mask(F, like, p):
+        # Dropout(ones) yields a 0/(1/(1-p)) mask — sampled once, then
+        # reused every step (the "locked" part)
+        return F.Dropout(F.ones_like(like), p=p)
+
+    def __call__(self, inputs, states):
+        from .... import ndarray as F
+        from .... import autograd as ag
+        self._counter += 1
+        training = ag.is_training()
+        if training and self.drop_inputs > 0.:
+            if self._mask_in is None:
+                self._mask_in = self._mask(F, inputs, self.drop_inputs)
+            inputs = inputs * self._mask_in
+        if training and self.drop_states > 0.:
+            if self._mask_states is None:
+                self._mask_states = [
+                    self._mask(F, s, self.drop_states) for s in states]
+            states = [s * m for s, m in zip(states, self._mask_states)]
+        out, next_states = self.base_cell(inputs, states)
+        if training and self.drop_outputs > 0.:
+            if self._mask_out is None:
+                self._mask_out = self._mask(F, out, self.drop_outputs)
+            out = out * self._mask_out
+        return out, next_states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()        # fresh masks per sequence
+        return super().unroll(length, inputs, begin_state=begin_state,
+                              layout=layout,
+                              merge_outputs=merge_outputs,
+                              valid_length=valid_length)
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a hidden-state projection (LSTMP, ref: contrib
+    LSTMPCell; Sak et al. 2014) — cell state keeps `hidden_size`, the
+    recurrent/output h is projected to `projection_size`."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None,
+                 h2h_weight_initializer=None,
+                 h2r_weight_initializer=None,
+                 i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, projection_size),
+            init=h2h_weight_initializer)
+        self.h2r_weight = self.params.get(
+            "h2r_weight", shape=(projection_size, hidden_size),
+            init=h2r_weight_initializer)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,),
+            init=i2h_bias_initializer)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,),
+            init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        in_g, forget_g, in_t, out_g = F.split(gates, num_outputs=4,
+                                              axis=-1)
+        next_c = F.sigmoid(forget_g) * states[1] + \
+            F.sigmoid(in_g) * F.tanh(in_t)
+        hidden = F.sigmoid(out_g) * F.tanh(next_c)
+        next_r = F.FullyConnected(hidden, h2r_weight, None,
+                                  num_hidden=self._projection_size,
+                                  no_bias=True)
+        return next_r, [next_r, next_c]

@@ -369,6 +369,104 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     return out, mean, var
 
 
+# --- SyncBatchNorm: cross-replica moments over a named mesh axis -----
+#
+# TPU-first note: under pjit/GSPMD (ShardedTrainer), a plain BatchNorm's
+# batch reduction is ALREADY global — XLA inserts the collectives when
+# the batch axis is sharded, which is the in-compiler equivalent of the
+# reference's hand-rolled cross-GPU sync (src/operator/contrib/
+# sync_batch_norm-inl.h key-based AllReduce).  This op exists for the
+# shard_map path, where per-device bodies see only their local shard
+# and the moments must be pmean'd explicitly.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _bn_train_sync(x, g, b, axis, eps, axis_name):
+    (out, _, _), _ = _bn_train_sync_fwd(x, g, b, axis, eps, axis_name)
+    return out
+
+
+def _bn_sync_stats(x, axis, axis_name):
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    x32 = x.astype(jnp.float32)
+    mean = lax.pmean(jnp.mean(x32, axis=red), axis_name)
+    # E[x²] − E[x]² over the GLOBAL batch (per-shard var would bias)
+    msq = lax.pmean(jnp.mean(x32 * x32, axis=red), axis_name)
+    return mean, msq - mean * mean
+
+
+def _bn_train_sync_fwd(x, g, b, axis, eps, axis_name):
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    bshape = tuple(x.shape[axis] if i == axis else 1
+                   for i in range(x.ndim))
+    mean, var = _bn_sync_stats(x, axis, axis_name)
+    inv = lax.rsqrt(var + eps)
+    scale = (g.astype(jnp.float32) * inv).reshape(bshape)
+    shift = (b.astype(jnp.float32) -
+             mean * g.astype(jnp.float32) * inv).reshape(bshape)
+    out = x * scale.astype(x.dtype) + shift.astype(x.dtype)
+    return (out, mean, var), (x, g, mean, inv, red, bshape)
+
+
+def _bn_train_sync_core_fwd(x, g, b, axis, eps, axis_name):
+    (out, _, _), res = _bn_train_sync_fwd(x, g, b, axis, eps, axis_name)
+    return out, res
+
+
+def _bn_train_sync_core_bwd(axis, eps, axis_name, res, dy):
+    x, g, mean, inv, red, bshape = res
+    n_local = 1
+    for i in red:
+        n_local *= x.shape[i]
+    n = n_local * lax.psum(1, axis_name)
+    x32 = x.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    xhat = (x32 - mean.reshape(bshape)) * inv.reshape(bshape)
+    # per-channel reductions must span the GLOBAL batch, like the
+    # forward moments
+    dbeta = lax.psum(jnp.sum(dy32, axis=red), axis_name)
+    dgamma = lax.psum(jnp.sum(dy32 * xhat, axis=red), axis_name)
+    m1 = (dbeta / n).reshape(bshape)
+    m2 = (dgamma / n).reshape(bshape)
+    dx = (g.astype(jnp.float32) * inv).reshape(bshape) * \
+        (dy32 - m1 - xhat * m2)
+    return dx.astype(x.dtype), dgamma.astype(g.dtype), dbeta.astype(g.dtype)
+
+
+_bn_train_sync.defvjp(_bn_train_sync_core_fwd, _bn_train_sync_core_bwd)
+
+
+@register("_contrib_SyncBatchNorm",
+          ndarray_inputs=("data", "gamma", "beta", "moving_mean",
+                          "moving_var"),
+          num_outputs=3, visible_outputs=1)
+def sync_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                    eps=1e-3, momentum=0.9, fix_gamma=True,
+                    use_global_stats=False, output_mean_var=False,
+                    axis=1, ndev=1, key="", axis_name="",
+                    _training=True):
+    """ref: src/operator/contrib/sync_batch_norm-inl.h.
+
+    With `axis_name` set, batch moments (and the backward's per-channel
+    reductions) are pmean/psum'd over that shard_map mesh axis — global
+    statistics over the device-sharded batch.  With it empty this IS
+    BatchNorm (the reference degrades the same way at ndev=1; under
+    pjit the compiler already globalises the reduction).  `ndev`/`key`
+    are accepted for API parity — the mesh axis replaces the key-based
+    rendezvous."""
+    if not axis_name or not _training or use_global_stats:
+        return batch_norm(data, gamma, beta, moving_mean, moving_var,
+                          eps=eps, momentum=momentum,
+                          fix_gamma=fix_gamma,
+                          use_global_stats=use_global_stats,
+                          output_mean_var=output_mean_var, axis=axis,
+                          _training=_training)
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    out = _bn_train_sync(data, g, beta, axis, eps, axis_name)
+    mean, var = _bn_sync_stats(lax.stop_gradient(data), axis, axis_name)
+    return out, mean, var
+
+
 @register("LayerNorm", ndarray_inputs=("data", "gamma", "beta"))
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
     """ref: src/operator/nn/layer_norm-inl.h."""

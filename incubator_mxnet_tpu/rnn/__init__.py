@@ -1,0 +1,121 @@
+"""Legacy ``mx.rnn`` namespace (ref: python/mxnet/rnn/).
+
+The piece that matters for the Sockeye/GNMT workflow (SURVEY §5.7) is
+`BucketSentenceIter` — the bucketing data feeder whose `bucket_key`
+drives `BucketingModule.switch_bucket`.  The legacy symbol rnn-cell API
+is served by the gluon cells (re-exported here): they build the same
+gate math, and `HybridBlock.export` produces the symbol graph the old
+API assembled by hand.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..io import DataBatch, DataDesc, DataIter
+# legacy cell names resolve to the gluon cells (one implementation)
+from ..gluon.rnn import (RNNCell, LSTMCell, GRUCell, SequentialRNNCell,
+                         BidirectionalCell, DropoutCell, ZoneoutCell,
+                         ResidualCell)
+
+__all__ = ["BucketSentenceIter", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ZoneoutCell", "ResidualCell"]
+
+
+class BucketSentenceIter(DataIter):
+    """Bucketing iterator over variable-length token sequences
+    (ref: python/mxnet/rnn/io.py BucketSentenceIter).
+
+    Each sentence lands in the smallest bucket that fits, padded with
+    `invalid_label`; batches are drawn per-bucket so every batch has ONE
+    static shape — on TPU each bucket compiles once and is reused, the
+    same economics as the reference's cached per-bucket executors.
+
+    `label` is the sentence shifted left by one (next-token target),
+    padded with `invalid_label` — the language-model contract of
+    example/rnn/bucketing.
+    """
+
+    def __init__(self, sentences, batch_size, buckets=None,
+                 invalid_label=-1, data_name="data",
+                 label_name="softmax_label", dtype="float32",
+                 layout="NT", shuffle=True, seed=0):
+        super().__init__(batch_size)
+        if not buckets:
+            # auto-buckets: every length that occurs often enough to
+            # fill at least one batch (reference default_gen_buckets)
+            counts = {}
+            for s in sentences:
+                counts[len(s)] = counts.get(len(s), 0) + 1
+            buckets = sorted(l for l, c in counts.items()
+                             if c >= batch_size) or \
+                [max(len(s) for s in sentences)]
+        self.buckets = sorted(buckets)
+        self.data_name = data_name
+        self.label_name = label_name
+        self.invalid_label = invalid_label
+        self.dtype = dtype
+        self.layout = layout
+        self._shuffle = shuffle
+        self._rs = _np.random.RandomState(seed)
+
+        # bucket the sentences, dropping those longer than the largest
+        # bucket (reference behavior, with a count kept for visibility)
+        self.data = [[] for _ in self.buckets]
+        self.discarded = 0
+        for s in sentences:
+            buck = None
+            for i, b in enumerate(self.buckets):
+                if len(s) <= b:
+                    buck = i
+                    break
+            if buck is None:
+                self.discarded += 1
+                continue
+            row = _np.full(self.buckets[buck], invalid_label,
+                           dtype=self.dtype)
+            row[:len(s)] = s
+            self.data[buck].append(row)
+        self.data = [_np.asarray(x, dtype=self.dtype) if len(x) else
+                     _np.zeros((0, b), self.dtype)
+                     for x, b in zip(self.data, self.buckets)]
+
+        self.default_bucket_key = max(self.buckets)
+        shape = ((batch_size, self.default_bucket_key)
+                 if layout == "NT" else
+                 (self.default_bucket_key, batch_size))
+        self.provide_data = [DataDesc(data_name, shape, dtype)]
+        self.provide_label = [DataDesc(label_name, shape, dtype)]
+        self.reset()
+
+    def reset(self):
+        """Reshuffle within buckets and rebuild the batch plan."""
+        self._plan = []              # (bucket_idx, start_row)
+        for i, d in enumerate(self.data):
+            if self._shuffle and len(d) > 1:
+                self._rs.shuffle(d)
+            for start in range(0, len(d) - self.batch_size + 1,
+                               self.batch_size):
+                self._plan.append((i, start))
+        if self._shuffle:
+            self._rs.shuffle(self._plan)
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor >= len(self._plan):
+            raise StopIteration
+        i, start = self._plan[self._cursor]
+        self._cursor += 1
+        from .. import ndarray as nd
+        buck = self.buckets[i]
+        d = self.data[i][start:start + self.batch_size]
+        lab = _np.full_like(d, self.invalid_label)
+        lab[:, :-1] = d[:, 1:]       # next-token target
+        if self.layout == "TN":
+            d, lab = d.T, lab.T
+        shape = d.shape
+        return DataBatch(
+            [nd.array(d)], label=[nd.array(lab)], bucket_key=buck,
+            provide_data=[DataDesc(self.data_name, shape, self.dtype)],
+            provide_label=[DataDesc(self.label_name, shape,
+                                    self.dtype)])

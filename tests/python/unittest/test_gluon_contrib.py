@@ -1,0 +1,404 @@
+"""gluon.contrib (nn/rnn/estimator) + mx.rnn legacy namespace
+(ref: tests/python/unittest/test_gluon_contrib.py and
+python/mxnet/gluon/contrib/)."""
+import os
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon, autograd as ag
+from incubator_mxnet_tpu.gluon import contrib as gcontrib
+from incubator_mxnet_tpu.gluon.contrib import nn as cnn
+from incubator_mxnet_tpu.gluon.contrib import rnn as crnn
+
+
+# ---------------------------------------------------------------- nn --
+
+def test_concurrent_and_identity():
+    b = cnn.HybridConcurrent(axis=1)
+    with b.name_scope():
+        b.add(gluon.nn.Dense(4))
+        b.add(gluon.nn.Dense(6))
+        b.add(cnn.Identity())
+    b.initialize()
+    x = nd.array(onp.random.RandomState(0).randn(2, 3).astype("float32"))
+    out = b(x)
+    assert out.shape == (2, 4 + 6 + 3)
+    # Identity branch passes the input through untouched
+    onp.testing.assert_allclose(out.asnumpy()[:, -3:], x.asnumpy(),
+                                rtol=1e-6)
+
+    s = cnn.Concurrent(axis=-1)
+    with s.name_scope():
+        s.add(gluon.nn.Dense(2))
+        s.add(cnn.Identity())
+    s.initialize()
+    assert s(x).shape == (2, 5)
+
+
+def test_pixelshuffle2d_matches_numpy():
+    rs = onp.random.RandomState(1)
+    B, C, H, W, r = 2, 3, 4, 5, 2
+    x = rs.randn(B, C * r * r, H, W).astype("float32")
+    blk = cnn.PixelShuffle2D(r)
+    out = blk(nd.array(x)).asnumpy()
+    assert out.shape == (B, C, H * r, W * r)
+    # reference rearrange: (B, C, r1, r2, H, W) → interleave
+    want = x.reshape(B, C, r, r, H, W).transpose(0, 1, 4, 2, 5, 3) \
+        .reshape(B, C, H * r, W * r)
+    onp.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_pixelshuffle1d_3d_shapes():
+    x1 = nd.array(onp.zeros((2, 6, 5), "float32"))
+    assert cnn.PixelShuffle1D(3)(x1).shape == (2, 2, 15)
+    x3 = nd.array(onp.zeros((1, 8, 2, 3, 4), "float32"))
+    assert cnn.PixelShuffle3D(2)(x3).shape == (1, 1, 4, 6, 8)
+
+
+def test_sparse_embedding_row_sparse_grad():
+    emb = cnn.SparseEmbedding(50, 8)
+    emb.initialize()
+    idx = nd.array(onp.array([[1, 3], [7, 1]], "float32"))
+    with ag.record():
+        out = emb(idx)
+        out.sum().backward()
+    w = emb._embedding.weight
+    assert w.grad_req == "write"
+    g = w.grad()
+    assert getattr(g, "stype", "default") == "row_sparse"
+
+
+def test_sync_batch_norm_degrades_to_bn():
+    """axis_name=None: SyncBatchNorm IS BatchNorm (the reference ndev=1
+    degradation)."""
+    rs = onp.random.RandomState(2)
+    x = rs.randn(4, 3, 5, 5).astype("float32")
+    sbn = cnn.SyncBatchNorm(in_channels=3)
+    bn = gluon.nn.BatchNorm(in_channels=3)
+    sbn.initialize()
+    bn.initialize()
+    with ag.record():
+        a = sbn(nd.array(x))
+    with ag.record():
+        b = bn(nd.array(x))
+    onp.testing.assert_allclose(a.asnumpy(), b.asnumpy(), rtol=1e-5,
+                                atol=1e-6)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs >= 8 devices (virtual mesh)")
+def test_sync_batch_norm_op_global_moments_and_grads():
+    """shard_map path: pmean'd moments — per-shard outputs/grads equal
+    the full-batch BatchNorm run on one device (the reference's
+    cross-GPU AllReduce contract, sync_batch_norm-inl.h)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    from incubator_mxnet_tpu.ops import registry
+
+    fn = registry.get("_contrib_SyncBatchNorm").fn
+    bn = registry.get("BatchNorm").fn
+    rs = onp.random.RandomState(3)
+    B, C = 16, 4                       # batch 16 → 2 rows per device
+    x = jnp.asarray(rs.randn(B, C, 3, 3).astype("float32"))
+    gamma = jnp.asarray(rs.rand(C).astype("float32") + 0.5)
+    beta = jnp.asarray(rs.randn(C).astype("float32"))
+    zeros = jnp.zeros(C)
+    ones = jnp.ones(C)
+
+    mesh = Mesh(onp.array(jax.devices()[:8]).reshape(8), ("dp",))
+
+    def local_loss(xs):
+        out, mean, var = fn(xs, gamma, beta, zeros, ones,
+                            fix_gamma=False, axis_name="dp")
+        return (out * out).sum(), (out, mean, var)
+
+    def body(xs):
+        (loss, (out, mean, var)), dx = jax.value_and_grad(
+            local_loss, has_aux=True)(xs)
+        return out, mean, var, dx
+
+    out, mean, var, dx = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=P("dp"),
+        out_specs=(P("dp"), P(), P(), P("dp"))))(x)
+
+    # single-device reference on the FULL batch
+    def full_loss(xs):
+        o, m, v = bn(xs, gamma, beta, zeros, ones, fix_gamma=False)
+        return (o * o).sum(), (o, m, v)
+
+    (_, (ro, rm, rv)), rdx = jax.value_and_grad(
+        full_loss, has_aux=True)(x)
+
+    onp.testing.assert_allclose(onp.asarray(mean), onp.asarray(rm),
+                                rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(onp.asarray(var), onp.asarray(rv),
+                                rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ro),
+                                rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(onp.asarray(dx), onp.asarray(rdx),
+                                rtol=1e-3, atol=1e-4)
+
+
+# --------------------------------------------------------------- rnn --
+
+class _PassCell(crnn.rnn_cell.RecurrentCell):
+    """Base cell that passes inputs through (mask-visibility probe)."""
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def hybrid_forward(self, F, inputs, states):
+        return inputs, states
+
+
+def test_variational_dropout_locked_mask():
+    cell = crnn.VariationalDropoutCell(_PassCell(), drop_inputs=0.5)
+    x = nd.array(onp.ones((4, 6), "float32"))
+    with ag.record():
+        o1, _ = cell(x, [])
+        o2, _ = cell(x, [])
+    a, b = o1.asnumpy(), o2.asnumpy()
+    assert (a == 0).any() and (a != 0).any()    # dropout really applied
+    onp.testing.assert_allclose(a, b)           # SAME mask across steps
+    cell.reset()
+    with ag.record():
+        o3, _ = cell(x, [])
+    # a fresh sequence draws a fresh mask (overwhelmingly likely)
+    assert (o3.asnumpy() != a).any()
+
+
+def test_variational_dropout_eval_identity():
+    cell = crnn.VariationalDropoutCell(_PassCell(), drop_inputs=0.5,
+                                       drop_outputs=0.5)
+    x = nd.array(onp.ones((2, 5), "float32"))
+    out, _ = cell(x, [])
+    onp.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+
+
+def test_variational_dropout_unroll_lstm():
+    base = gluon.rnn.LSTMCell(8)
+    cell = crnn.VariationalDropoutCell(base, drop_inputs=0.3,
+                                       drop_states=0.3)
+    cell.initialize()
+    x = nd.array(onp.random.RandomState(5).randn(2, 4, 6)
+                 .astype("float32"))
+    with ag.record():
+        out, states = cell.unroll(4, x, layout="NTC")
+        out.sum().backward()
+    assert out.shape == (2, 4, 8)
+    assert all(s.shape == (2, 8) for s in states)
+    g = base.i2h_weight.grad()
+    assert onp.isfinite(g.asnumpy()).all()
+
+
+def test_lstmp_cell():
+    cell = crnn.LSTMPCell(hidden_size=16, projection_size=8)
+    cell.initialize()
+    x = nd.array(onp.random.RandomState(6).randn(3, 5, 4)
+                 .astype("float32"))
+    with ag.record():
+        out, states = cell.unroll(5, x, layout="NTC")
+        out.sum().backward()
+    assert out.shape == (3, 5, 8)               # projected size
+    assert states[0].shape == (3, 8)            # r (projection)
+    assert states[1].shape == (3, 16)           # c (full hidden)
+    assert onp.isfinite(out.asnumpy()).all()
+
+
+@pytest.mark.parametrize("cls,nstate", [
+    (crnn.Conv2DRNNCell, 1), (crnn.Conv2DLSTMCell, 2),
+    (crnn.Conv2DGRUCell, 1)])
+def test_conv2d_cells(cls, nstate):
+    cell = cls(input_shape=(3, 8, 8), hidden_channels=5)
+    cell.initialize()
+    rs = onp.random.RandomState(7)
+    x = nd.array(rs.randn(2, 4, 3, 8, 8).astype("float32"))  # NTCHW
+    with ag.record():
+        out, states = cell.unroll(4, x, layout="NTC")
+        out.sum().backward()
+    assert out.shape == (2, 4, 5, 8, 8)
+    assert len(states) == nstate
+    assert all(s.shape == (2, 5, 8, 8) for s in states)
+    assert onp.isfinite(out.asnumpy()).all()
+    g = cell.i2h_weight.grad()
+    assert onp.abs(g.asnumpy()).max() > 0
+
+
+def test_conv1d_lstm_cell_step():
+    cell = crnn.Conv1DLSTMCell(input_shape=(2, 10), hidden_channels=4)
+    cell.initialize()
+    x = nd.array(onp.random.RandomState(8).randn(3, 2, 10)
+                 .astype("float32"))
+    states = cell.begin_state(3)
+    out, states = cell(x, states)
+    assert out.shape == (3, 4, 10)
+    assert states[1].shape == (3, 4, 10)
+
+
+# --------------------------------------------------------- estimator --
+
+def test_estimator_fit_and_handlers(tmp_path):
+    rs = onp.random.RandomState(9)
+    X = rs.randn(64, 10).astype("float32")
+    w = rs.randn(10).astype("float32")
+    Y = (X @ w > 0).astype("float32")
+    batches = [(nd.array(X[i:i + 16]), nd.array(Y[i:i + 16]))
+               for i in range(0, 64, 16)]
+
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    acc = mx.metric.Accuracy()
+    est = gcontrib.estimator.Estimator(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        train_metrics=[acc], trainer=trainer)
+    ckpt = gcontrib.estimator.CheckpointHandler(str(tmp_path),
+                                                model_prefix="m")
+    est.fit(batches, epochs=8, event_handlers=[ckpt])
+    assert acc.get()[1] > 0.8, acc.get()
+    assert os.path.exists(os.path.join(str(tmp_path),
+                                       "m-epoch8.params"))
+    # evaluate() runs the same metric machinery
+    val = est.evaluate(batches, mx.metric.Accuracy())
+    assert val[0].get()[1] > 0.8
+
+
+def test_estimator_early_stopping():
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    loss_metric = mx.metric.Loss()
+    est = gcontrib.estimator.Estimator(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        train_metrics=[loss_metric])
+    es = gcontrib.estimator.EarlyStoppingHandler(loss_metric,
+                                                 patience=0,
+                                                 min_delta=1e9)
+    X = nd.array(onp.zeros((8, 4), "float32"))
+    Y = nd.array(onp.zeros((8,), "float32"))
+    est.fit([(X, Y)], epochs=50, event_handlers=[es])
+    # min_delta huge → never "improves" → stops after patience+2 epochs
+    assert es.stop_training
+
+
+# ------------------------------------------------------------ mx.rnn --
+
+def test_bucket_sentence_iter_basics():
+    rs = onp.random.RandomState(10)
+    sentences = [list(rs.randint(1, 20, rs.randint(2, 13)))
+                 for _ in range(100)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4,
+                                   buckets=[4, 8, 12],
+                                   invalid_label=0)
+    seen = 0
+    for batch in it:
+        T = batch.bucket_key
+        assert T in (4, 8, 12)
+        d = batch.data[0].asnumpy()
+        lab = batch.label[0].asnumpy()
+        assert d.shape == (4, T) and lab.shape == (4, T)
+        # label is data shifted left by one
+        onp.testing.assert_allclose(lab[:, :-1], d[:, 1:])
+        assert (lab[:, -1] == 0).all()
+        seen += 1
+    assert seen >= 3
+    it.reset()
+    assert sum(1 for _ in it) == seen
+
+
+def test_bucket_sentence_iter_drops_overlong():
+    sentences = [[1, 2], [1, 2, 3, 4, 5, 6, 7, 8, 9]]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=1,
+                                   buckets=[4], invalid_label=-1,
+                                   shuffle=False)
+    assert it.discarded == 1
+    batches = list(it)
+    assert len(batches) == 1
+    d = batches[0].data[0].asnumpy()
+    onp.testing.assert_allclose(d[0, :2], [1, 2])
+    assert (d[0, 2:] == -1).all()
+
+
+def test_bucket_sentence_iter_feeds_bucketing_module():
+    """The Sockeye/GNMT feeder contract (SURVEY §5.7): BucketSentenceIter
+    bucket_keys drive BucketingModule.switch_bucket; training across
+    buckets with shared params learns a next-token task."""
+    from incubator_mxnet_tpu.models.seq2seq import gnmt_sym_gen
+
+    vocab = 16
+    rs = onp.random.RandomState(11)
+    # predictable next-token sequences: x[t+1] = (x[t] + 1) % vocab,
+    # never emitting the pad id 0 (so invalid_label stays out of band)
+    sentences = []
+    for _ in range(120):
+        T = rs.choice([6, 9, 12])
+        start = rs.randint(1, vocab)
+        sentences.append([(start + t - 1) % (vocab - 1) + 1
+                          for t in range(T)])
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4,
+                                   buckets=[6, 9, 12],
+                                   invalid_label=0, seed=3)
+    gen = gnmt_sym_gen(vocab, embed_dim=8, hidden=16, num_layers=1)
+    bm = mx.mod.BucketingModule(gen,
+                                default_bucket_key=it.default_bucket_key)
+    bm.bind(data_shapes=[("data", (4, 12))],
+            label_shapes=[("softmax_label", (4, 12))])
+    bm.init_params()
+    bm.init_optimizer(optimizer="adam",
+                      optimizer_params={"learning_rate": 0.05})
+    losses = []
+    for epoch in range(4):
+        for batch in it:
+            bm.forward(batch, is_train=True)
+            out = bm.get_outputs()[0].asnumpy()
+            lab = batch.label[0].asnumpy().reshape(-1).astype(int)
+            losses.append(float(-onp.log(
+                out[onp.arange(len(lab)), lab] + 1e-9).mean()))
+            bm.backward()
+            bm.update()
+        it.reset()
+    assert len(bm._buckets) == 3            # every bucket compiled
+    assert onp.mean(losses[-5:]) < onp.mean(losses[:5]) * 0.8, \
+        (onp.mean(losses[:5]), onp.mean(losses[-5:]))
+
+
+def test_estimator_dataiter_epochs_reset():
+    """fit() must rewind a DataIter between epochs (review r4): every
+    epoch sees the full data, and evaluate() is repeatable."""
+    from incubator_mxnet_tpu.io import NDArrayIter
+    rs = onp.random.RandomState(12)
+    X = rs.randn(32, 6).astype("float32")
+    Y = (X.sum(axis=1) > 0).astype("float32")
+    it = NDArrayIter(X, Y, batch_size=8)
+
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    est = gcontrib.estimator.Estimator(
+        net, gluon.loss.SoftmaxCrossEntropyLoss())
+    counted = []
+
+    class _Counter(gcontrib.estimator.BatchEnd,
+                   gcontrib.estimator.EpochEnd):
+        def __init__(self):
+            self.n = 0
+
+        def batch_end(self, estimator, **kw):
+            self.n += 1
+
+        def epoch_end(self, estimator, **kw):
+            counted.append(self.n)
+            self.n = 0
+
+    est.fit(it, epochs=3, event_handlers=[_Counter()])
+    assert counted == [4, 4, 4], counted        # all epochs full
+    v1 = est.evaluate(it, mx.metric.Accuracy())[0].get()[1]
+    v2 = est.evaluate(it, mx.metric.Accuracy())[0].get()[1]
+    assert v1 == v2                             # repeatable eval
