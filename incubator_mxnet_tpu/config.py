@@ -168,6 +168,10 @@ register("MXNET_FLASH_BLOCK_K", int, 0,
 register("MXNET_FLASH_AUTO_BYTES", float, 4e9,
          "Score-matrix bytes above which attention auto-switches to the "
          "flash kernel")
+register("MXNET_FLASH_BWD_PALLAS", str, "1",
+         "flash-attention backward: 1=Pallas dq/dkv kernels (block "
+         "recompute from lse residuals, no TxT HBM slab), 0=fused-XLA "
+         "scan fallback")
 register("MXNET_FLASH_BWD_BYTES", float, 5e8,
          "Bytes threshold for the recompute-free flash backward")
 register("MXNET_TEST_DEVICE", str, "cpu",

@@ -38,8 +38,7 @@ class Estimator:
             self.trainer = Trainer(net.collect_params(), "sgd",
                                    {"learning_rate": 0.01})
 
-    @staticmethod
-    def _split(batch):
+    def _split(self, batch):
         if isinstance(batch, (list, tuple)):
             data, label = batch[0], batch[1]
         else:                      # io.DataBatch
@@ -47,6 +46,10 @@ class Estimator:
                 else batch.data
             label = batch.label[0] if isinstance(batch.label, list) \
                 else batch.label
+        if self.context is not None:
+            data = data.as_in_context(self.context)
+            if label is not None:
+                label = label.as_in_context(self.context)
         return data, label
 
     def evaluate(self, val_data, val_metrics):

@@ -32,6 +32,10 @@ class _ConvCellBase(RecurrentCell):
                  i2h_bias_initializer="zeros",
                  h2h_bias_initializer="zeros", **kwargs):
         super().__init__(**kwargs)
+        if conv_layout not in ("NCHW", "NCW", "NCDHW"):
+            raise NotImplementedError(
+                "conv cells support channel-first layouts only, got %r"
+                % (conv_layout,))
         self._ndim = ndim
         self._input_shape = tuple(input_shape)     # (C_in, *spatial)
         self._hc = hidden_channels

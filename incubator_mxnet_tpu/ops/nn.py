@@ -382,8 +382,13 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _bn_train_sync(x, g, b, axis, eps, axis_name):
-    (out, _, _), _ = _bn_train_sync_fwd(x, g, b, axis, eps, axis_name)
-    return out
+    """Returns (out, mean, var): the global moments come out of the SAME
+    collective pass as the normalisation (no second pmean); their
+    cotangents are discarded in the vjp — stop_gradient semantics, as
+    in the non-sync op."""
+    (out, mean, var), _ = _bn_train_sync_fwd(x, g, b, axis, eps,
+                                             axis_name)
+    return out, mean, var
 
 
 def _bn_sync_stats(x, axis, axis_name):
@@ -409,11 +414,12 @@ def _bn_train_sync_fwd(x, g, b, axis, eps, axis_name):
 
 
 def _bn_train_sync_core_fwd(x, g, b, axis, eps, axis_name):
-    (out, _, _), res = _bn_train_sync_fwd(x, g, b, axis, eps, axis_name)
-    return out, res
+    outs, res = _bn_train_sync_fwd(x, g, b, axis, eps, axis_name)
+    return outs, res
 
 
-def _bn_train_sync_core_bwd(axis, eps, axis_name, res, dy):
+def _bn_train_sync_core_bwd(axis, eps, axis_name, res, cots):
+    dy = cots[0]            # d_mean/d_var discarded (aux stats)
     x, g, mean, inv, red, bshape = res
     n_local = 1
     for i in red:
@@ -462,9 +468,8 @@ def sync_batch_norm(data, gamma, beta, moving_mean, moving_var,
                           output_mean_var=output_mean_var, axis=axis,
                           _training=_training)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
-    out = _bn_train_sync(data, g, beta, axis, eps, axis_name)
-    mean, var = _bn_sync_stats(lax.stop_gradient(data), axis, axis_name)
-    return out, mean, var
+    out, mean, var = _bn_train_sync(data, g, beta, axis, eps, axis_name)
+    return out, lax.stop_gradient(mean), lax.stop_gradient(var)
 
 
 @register("LayerNorm", ndarray_inputs=("data", "gamma", "beta"))

@@ -77,7 +77,9 @@ def test_flash_grad_matches_naive(pallas_interpret):
 
 
 def test_flash_bwd_chunked_matches_direct(pallas_interpret, monkeypatch):
-    """Force the lax.scan k-block backward and compare to the one-shot."""
+    """Force the lax.scan k-block backward and compare to the one-shot
+    (both on the XLA fallback path)."""
+    monkeypatch.setenv("MXNET_FLASH_BWD_PALLAS", "0")
     q, k, v = _rand_qkv(BH=2, T=128, d=32)
     scale = 1.0 / np.sqrt(32)
 
@@ -89,6 +91,54 @@ def test_flash_bwd_chunked_matches_direct(pallas_interpret, monkeypatch):
     monkeypatch.setenv("MXNET_FLASH_BWD_BYTES", "100000")   # forces nk > 1
     g_chunked = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_direct, g_chunked):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=max(1e-5, _tol()),
+                                   atol=max(1e-5, _tol()))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bwd_pallas_matches_naive(pallas_interpret, monkeypatch,
+                                        causal):
+    """The Pallas dq/dkv kernel pair (multi-block grid: T=256 with
+    128-blocks) vs autodiff through the naive path."""
+    monkeypatch.setenv("MXNET_FLASH_BWD_PALLAS", "2")
+    q, k, v = _rand_qkv(BH=2, T=256, d=32)
+    scale = 1.0 / np.sqrt(32)
+    w = jnp.cos(jnp.arange(32.0))
+
+    def f_flash(q, k, v):
+        return jnp.sum(att._flash_attention(
+            q, k, v, float(scale), causal) * w)
+
+    def f_ref(q, k, v):
+        return jnp.sum(att.naive_attention(
+            q, k, v, scale, causal=causal) * w)
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=max(1e-4, _tol()),
+                                   atol=max(1e-4, _tol()))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bwd_pallas_matches_xla_path(pallas_interpret, monkeypatch,
+                                           causal):
+    """Pallas backward vs the fused-XLA from-lse backward — same
+    residuals, same math, different schedule."""
+    monkeypatch.setenv("MXNET_FLASH_BWD_PALLAS", "2")
+    q, k, v = _rand_qkv(BH=2, T=256, d=32)
+    scale = 1.0 / np.sqrt(32)
+
+    def loss(q, k, v):
+        return jnp.sum(att._flash_attention(
+            q, k, v, float(scale), causal) ** 2)
+
+    g_pallas = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setenv("MXNET_FLASH_BWD_PALLAS", "0")
+    g_xla = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pallas, g_xla):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=max(1e-5, _tol()),
                                    atol=max(1e-5, _tol()))
