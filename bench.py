@@ -29,6 +29,15 @@ import time
 
 import numpy as np
 
+# persistent XLA compilation cache (verified working through this PJRT
+# plugin: gnmt config wall 39s -> 10s on the second process).  Set
+# before any jax import; inherited by the per-config subprocesses, so
+# recompiles across configs/runs hit disk instead of the compiler.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_pcache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                      "0.5")
+
 V100_IMAGES_PER_SEC = 1400.0   # BASELINE.md north-star denominator [L]
 
 _REC_PATH = os.path.join("/tmp", "bench_io_512.rec")
@@ -109,54 +118,79 @@ def run_cachedop(batch=128, warmup=2, iters=12, extra=None):
     # ---- end-to-end: same compiled step, inputs from the native
     # pipeline (C++ decode/augment threads overlap the chip) ----
     try:
-        import ml_dtypes
         from incubator_mxnet_tpu.io import native
         if not native.available():
             raise RuntimeError("native io unavailable")
         path = _ensure_rec()
+        # uint8 mode: raw augmented pixels over the link (4x fewer
+        # bytes than f32 — this backend's chip sits behind a network
+        # tunnel, so transfer bytes ARE the e2e bottleneck), mean/std
+        # applied on device
         reader = native.NativeImageRecordReader(
             path, batch_size=batch, data_shape=(3, 224, 224),
-            resize=256, rand_crop=True, rand_mirror=True, shuffle=True)
+            resize=256, rand_crop=True, rand_mirror=True, shuffle=True,
+            dtype="uint8")
+        # H2D bandwidth probe: on this backend the chip sits behind a
+        # network tunnel, so per-batch input transfer — not decode, not
+        # compute — can bound the e2e rate.  Reported so the e2e number
+        # is attributable (PROFILE.md r4).
+        probe = np.random.randn(batch, 3, 224, 224).astype(np.float32)
+        t0 = time.perf_counter()
+        nd.array(probe, ctx=ctx).wait_to_read()
+        h2d = probe.nbytes / (time.perf_counter() - t0)
+        extra["h2d_bytes_per_sec"] = round(h2d, 0)
+
         n = 0
         t0 = time.perf_counter()
-        for epoch in range(3):
-            for data, label in reader:
-                if data.shape[0] != batch:
-                    continue            # keep the compiled signature
-                xb = nd.array(data.astype(ml_dtypes.bfloat16), ctx=ctx,
-                              dtype="bfloat16")
-                # reader labels are (batch, label_width): flatten to the
-                # (batch,) the compiled loss expects
-                yb = nd.array(
-                    label.reshape(label.shape[0], -1)[:, 0]
-                    .astype(np.float32) % 1000, ctx=ctx)
-                step(xb, yb)
-                n += batch
-            reader.reset()
+        for data, label in reader:
+            if data.shape[0] != batch:
+                continue                # keep the compiled signature
+            # ship uint8, normalize on device in bf16 (a host-side
+            # ml_dtypes convert is a single-core C loop, measured ~12x
+            # slower than the whole train step)
+            xb = (nd.cast(nd.array(data, ctx=ctx), dtype="bfloat16")
+                  - 127.5) * (1.0 / 64.0)
+            # reader labels are (batch, label_width): flatten to the
+            # (batch,) the compiled loss expects
+            yb = nd.array(
+                label.reshape(label.shape[0], -1)[:, 0]
+                .astype(np.float32) % 1000, ctx=ctx)
+            step(xb, yb)
+            n += batch
         _dependent_sync(net)
         e2e = n / (time.perf_counter() - t0)
         extra["resnet50_e2e_input_fed_images_per_sec"] = round(e2e, 2)
         extra["resnet50_e2e_fraction_of_synthetic"] = round(e2e / rate, 3)
+        # what the link allows at uint8 bytes/img — the e2e ceiling on
+        # this tunnel-attached backend (PROFILE.md r4)
+        extra["resnet50_e2e_h2d_bound_images_per_sec"] = round(
+            h2d / (3 * 224 * 224), 1)
     except Exception as e:
         extra["resnet50_e2e_error"] = str(e)[:120]
     return rate
 
 
-def run_bert(batch=32, seq=512, warmup=2, iters=6):
+def run_bert(batch=16, seq=512, warmup=2, iters=6):
     """North-star config 2: BERT-base MLM pretrain step, tokens/sec/chip.
 
-    Same user-facing path as config 1 (hybridize → CachedOp → Trainer);
-    attention runs the fused kernel (ops/attention.py).  Synthetic MLM:
-    predict the token ids at every position (dense CE over the vocab) —
-    same compute shape as a 100%-masked MLM step.
+    Same user-facing path as config 1 (hybridize → CachedOp → Trainer),
+    bf16 compute (LayerNorm model: no BN-state writeback tax) with the
+    Pallas flash attention kernels forced and the memory-exact fused
+    softmax-CE — together these moved the fitting batch from 8 (r3) to
+    16 and +42% tokens/s.  Synthetic MLM: predict the token ids at
+    every position (dense CE over the vocab) — same compute shape as a
+    100%-masked MLM step.
     """
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, gluon, autograd as ag
+    from incubator_mxnet_tpu import config as _cfg
     from incubator_mxnet_tpu.models.transformer import bert_base
 
+    _cfg.set("MXNET_USE_PALLAS", "2")
     ctx = mx.gpu()
     net = bert_base(dropout=0.0)
     net.initialize(ctx=ctx)
+    net.cast("bfloat16")
     net.hybridize(static_alloc=True, static_shape=True)
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     loss_fn.hybridize()
@@ -200,6 +234,7 @@ def run_ssd(batch=8, size=512, warmup=2, iters=8):
     trainer = gluon.Trainer(net.collect_params(), "sgd",
                             {"learning_rate": 0.01, "momentum": 0.9})
     sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    sce.hybridize()
     rs = np.random.RandomState(0)
     x = nd.array(rs.randn(batch, 3, size, size).astype(np.float32),
                  ctx=ctx)
@@ -251,6 +286,7 @@ def run_rcnn(batch=2, size=512, warmup=2, iters=8):
     trainer = gluon.Trainer(net.collect_params(), "sgd",
                             {"learning_rate": 1e-3, "momentum": 0.9})
     sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    sce.hybridize()
     rs = np.random.RandomState(0)
     x = nd.array(rs.randn(batch, 3, size, size).astype(np.float32),
                  ctx=ctx)
@@ -286,7 +322,7 @@ def run_rcnn(batch=2, size=512, warmup=2, iters=8):
     return batch * iters / (time.perf_counter() - t0)
 
 
-def run_gnmt(batch=32, src_len=32, tgt_len=32, warmup=2, iters=8):
+def run_gnmt(batch=128, src_len=32, tgt_len=32, warmup=3, iters=10):
     """Config 4: GNMT-style LSTM seq2seq training, target tokens/sec."""
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, gluon, autograd as ag
@@ -300,6 +336,7 @@ def run_gnmt(batch=32, src_len=32, tgt_len=32, warmup=2, iters=8):
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": 1e-3})
     sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    sce.hybridize()        # whole-step fusion needs a cached-op loss
     rs = np.random.RandomState(0)
     src = nd.array(rs.randint(0, vocab, (batch, src_len)), ctx=ctx,
                    dtype="int32")
@@ -339,6 +376,7 @@ def run_wide_deep(batch=2048, fields=16, warmup=2, iters=10):
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": 1e-3})
     sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    sce.hybridize()
     rs = np.random.RandomState(0)
     idx = nd.array(rs.randint(0, num_features, (batch, fields)),
                    ctx=ctx, dtype="int32")
@@ -432,6 +470,19 @@ def run_io(batch=128):
     return n / (time.perf_counter() - t0)
 
 
+def _free_device_memory():
+    """Drop dead device buffers between retries inside one process:
+    each config's net/trainer/pendings form reference cycles
+    (Block↔Parameter↔pending) that only gc.collect() breaks."""
+    import gc
+    gc.collect()
+    try:
+        import jax
+        jax.clear_caches()
+    except Exception:
+        pass
+
+
 def _try_batches(fn, batches, **kw):
     err = None
     for b in batches:
@@ -439,90 +490,127 @@ def _try_batches(fn, batches, **kw):
             return fn(batch=b, **kw), b
         except Exception as e:      # OOM etc. — halve and retry
             err = e
+            _free_device_memory()
     raise err
+
+
+# ---------------------------------------------------------------------------
+# driver: one SUBPROCESS per config.
+#
+# Measured on this backend: a failed (OOM) allocation wedges the
+# remote TPU server's allocator for the REST of the process — after
+# resnet b128 + one bert b32 OOM attempt, even b8 fails, and
+# gc.collect()+jax.clear_caches() freeing every client handle does not
+# recover it.  Process exit does.  So each config runs in its own
+# python subprocess (~8s import+tunnel overhead each) and reports one
+# JSON dict on its last stdout line.
+# ---------------------------------------------------------------------------
+
+_CONFIGS = {
+    "resnet": lambda: _cfg_resnet(),
+    "bert": lambda: _cfg_simple(
+        "bert_base_tokens_per_sec_per_chip", run_bert, (16, 8),
+        const={"bert_seq": 512}, batch_key="bert_batch"),
+    "ssd512": lambda: _cfg_simple(
+        "ssd512_train_images_per_sec", run_ssd, (8, 4)),
+    "rcnn": lambda: _cfg_simple(
+        "rcnn_train_images_per_sec", run_rcnn, (2, 1)),
+    "gnmt": lambda: _cfg_simple(
+        "gnmt_train_tokens_per_sec", run_gnmt, (128, 32)),
+    "wide_deep": lambda: _cfg_simple(
+        "wide_deep_train_samples_per_sec", run_wide_deep, (2048, 512)),
+    "io": lambda: {"io_pipeline_images_per_sec": round(run_io(), 1),
+                   "io_host_cores": os.cpu_count()},
+    "sharded": lambda: _cfg_simple(
+        "sharded_trainer_value", run_sharded, (256, 128, 64),
+        batch_key="sharded_trainer_batch"),
+}
+
+
+def _cfg_resnet():
+    extra = {}
+    imgs, batch = _try_batches(run_cachedop, (128, 64, 32), extra=extra)
+    extra.update({"value": round(imgs, 2), "batch": batch})
+    return extra
+
+
+def _cfg_simple(key, fn, batches, const=None, batch_key=None):
+    val, b = _try_batches(fn, batches)
+    out = {key: round(val, 2),
+           (batch_key or key + "_batch"): b}
+    out.update(const or {})
+    return out
+
+
+def _run_config_subprocess(name, timeout_s):
+    import subprocess
+    cmd = [sys.executable, os.path.abspath(__file__), "--config", name]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout_s,
+                             cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return {name + "_error": "config timed out (%ds)" % timeout_s}
+    for line in reversed(res.stdout.strip().splitlines() or [""]):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except Exception:
+                break
+    tail = (res.stderr or res.stdout or "").strip().splitlines()
+    return {name + "_error": (tail[-1] if tail else
+                              "rc=%d, no output" % res.returncode)[:160]}
 
 
 def main():
     # hard wall-clock budget: the driver must always get the ONE JSON
-    # line; the five BASELINE configs are sized to fit it, extras are
-    # skipped once it is spent (override with MXNET_BENCH_BUDGET_S)
+    # line; the five BASELINE configs run first (each in its own
+    # process, see above), extras are skipped once the budget is spent
+    # (override with MXNET_BENCH_BUDGET_S)
     t_start = time.perf_counter()
     budget = float(os.environ.get("MXNET_BENCH_BUDGET_S", 720))
-
-    def over_budget():
-        return time.perf_counter() - t_start > budget
+    _ensure_rec()       # build the shared corpus once, outside timings
 
     extra = {}
     times = {}
+    required = ("resnet", "bert", "ssd512", "rcnn", "gnmt", "wide_deep")
+    optional = ("io", "sharded")
 
-    try:
+    for name in required + optional:
+        remaining = budget - (time.perf_counter() - t_start)
+        if name not in required and remaining < 30:
+            extra[name + "_skipped"] = "bench budget (%ds) spent" % budget
+            continue
+        # required configs get a fair floor even if earlier ones ran
+        # long; the subprocess hard-timeout keeps the total bounded
+        cap = max(remaining, 150 if name in required else 30)
         t0 = time.perf_counter()
-        imgs, batch = _try_batches(run_cachedop, (128, 64, 32),
-                                   extra=extra)
-        times["resnet"] = round(time.perf_counter() - t0, 1)
-    except Exception as e:
-        print(json.dumps({
-            "metric": "resnet50_v1b_train_images_per_sec_per_chip",
-            "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
-            "error": str(e)[:200]}))
-        return 1
+        extra.update(_run_config_subprocess(name, cap))
+        times[name] = round(time.perf_counter() - t0, 1)
 
-    def _timed(key, thunk, required=False):
-        """required configs always run (they are sized to fit the
-        budget); extras respect what remains."""
-        if not required and over_budget():
-            extra[key + "_skipped"] = "bench budget (%ds) spent" % budget
-            return
-        t0 = time.perf_counter()
-        try:
-            thunk()
-        except Exception as e:
-            extra[key + "_error"] = str(e)[:120]
-        times[key.split("_")[0]] = round(time.perf_counter() - t0, 1)
-
-    def _bert():
-        toks, bbatch = _try_batches(run_bert, (32, 16, 8))
-        extra.update({"bert_base_tokens_per_sec_per_chip": round(toks, 2),
-                      "bert_batch": bbatch, "bert_seq": 512})
-    _timed("bert", _bert, required=True)
-
-    for key, fn, batches in (
-            ("ssd512_train_images_per_sec", run_ssd, (8, 4)),
-            ("rcnn_train_images_per_sec", run_rcnn, (2, 1)),
-            ("gnmt_train_tokens_per_sec", run_gnmt, (32, 16)),
-            ("wide_deep_train_samples_per_sec", run_wide_deep,
-             (2048, 512))):
-        def _one(key=key, fn=fn, batches=batches):
-            val, b = _try_batches(fn, batches)
-            extra[key] = round(val, 2)
-            extra[key + "_batch"] = b
-        _timed(key, _one, required=True)
-
-    def _io():
-        io_rate = run_io()
-        extra.update({"io_pipeline_images_per_sec": round(io_rate, 1),
-                      "io_host_cores": os.cpu_count()})
-    _timed("io", _io)
-
-    def _sharded():
-        sharded, sbatch = _try_batches(run_sharded, (256, 128, 64))
-        extra.update({"sharded_trainer_value": round(sharded, 2),
-                      "sharded_trainer_batch": sbatch})
-    _timed("sharded_trainer", _sharded)
-
+    headline = extra.pop("value", 0.0)
+    batch = extra.pop("batch", 0)
     extra["config_wall_s"] = times
     extra["bench_wall_s"] = round(time.perf_counter() - t_start, 1)
     print(json.dumps({
         "metric": "resnet50_v1b_train_images_per_sec_per_chip",
-        "value": round(imgs, 2),
+        "value": headline,
         "unit": "images/sec",
-        "vs_baseline": round(imgs / V100_IMAGES_PER_SEC, 4),
+        "vs_baseline": round(headline / V100_IMAGES_PER_SEC, 4),
         "batch": batch,
         "path": "gluon hybridize->CachedOp->Trainer (north-star config 1)",
         **extra,
     }))
-    return 0
+    return 0 if headline else 1     # headline failure -> non-zero exit
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--config":
+        name = sys.argv[2]
+        try:
+            print(json.dumps(_CONFIGS[name]()))
+            sys.exit(0)
+        except Exception as e:
+            print(json.dumps({name + "_error": str(e)[:160]}))
+            sys.exit(0)
     sys.exit(main())

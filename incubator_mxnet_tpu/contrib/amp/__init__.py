@@ -25,7 +25,8 @@ from . import lists
 from .loss_scaler import LossScaler
 
 __all__ = ["init", "init_trainer", "scale_loss", "unscale",
-           "convert_hybrid_block", "convert_model", "LossScaler", "lists"]
+           "convert_hybrid_block", "convert_model", "convert_symbol",
+           "LossScaler", "lists"]
 
 _CURRENT = {"target": None, "orig": {}}   # opname -> original fn
 
@@ -206,14 +207,92 @@ def convert_hybrid_block(block, target_dtype="bfloat16"):
     return block
 
 
-def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16"):
-    """Symbolic-API analogue: cast arg params (aux stats stay float32)
-    and return the triple (ref: amp.convert_model). The symbol itself
-    is unchanged — dtype policy is applied at op dispatch by init()."""
+def convert_symbol(sym, target_dtype="bfloat16", target_dtype_ops=None,
+                   fp32_ops=None, widest_dtype_ops=None,
+                   excluded_sym_names=(), data_names=("data",)):
+    """Symbol GRAPH pass (ref: amp.convert_symbol over the nnvm
+    ReducePrecision pass): rebuild the graph with explicit `amp_cast`
+    nodes feeding every listed op — TARGET_DTYPE_OPS get their float
+    inputs cast down to `target_dtype`, FP32_OPS cast up to float32,
+    WIDEST_TYPE_CASTS get one `amp_multicast` across their inputs.
+    The returned symbol round-trips through tojson/load_json and
+    carries its mixed-precision policy with it (an exported model needs
+    no amp.init() at load time)."""
+    import json as _json
+    from ...symbol.symbol import _apply, rebuild_graph
+
+    tgt = set(lists.TARGET_DTYPE_OPS if target_dtype_ops is None
+              else target_dtype_ops)
+    f32 = set(lists.FP32_OPS if fp32_ops is None else fp32_ops)
+    wide = set(lists.WIDEST_TYPE_CASTS if widest_dtype_ops is None
+               else widest_dtype_ops)
+    excluded = set(excluded_sym_names)
+
+    graph = _json.loads(sym.tojson())
+    specs = graph["nodes"]
+    cast_cache = {}     # (src_idx, out_idx, dtype) -> cast symbol:
+    # one producer feeding N consumers gets ONE inserted cast
+
+    def make_inputs(idx, spec, ins, resolve):
+        def casted(i, o, dtype):
+            key = (i, o, dtype)
+            if key not in cast_cache:
+                cast_cache[key] = _apply(
+                    "amp_cast", [resolve(i, o)], {"dtype": dtype},
+                    name="%s_amp_cast_%s" % (specs[i]["name"], dtype))
+            return cast_cache[key]
+
+        op, name = spec["op"], spec["name"]
+        if name in excluded:
+            return [resolve(i, o) for i, o in ins]
+        if op in tgt:
+            return [casted(i, o, target_dtype) for i, o in ins]
+        if op in f32:
+            return [casted(i, o, "float32") for i, o in ins]
+        if op in wide and len(ins) > 1:
+            mc = _apply("amp_multicast",
+                        [resolve(i, o) for i, o in ins],
+                        {"num_outputs": len(ins)},
+                        name=name + "_amp_multicast")
+            return [mc.outputs[j] for j in range(len(ins))]
+        return [resolve(i, o) for i, o in ins]
+
+    return rebuild_graph(graph, make_inputs)
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  target_dtype_ops=None, fp32_ops=None,
+                  excluded_sym_names=(), cast_optional_params=False):
+    """Symbolic-API conversion (ref: amp.convert_model): run the
+    `convert_symbol` graph pass so the symbol CARRIES its casts
+    (round-trips through tojson — an exported model needs no
+    amp.init() at load time), and cast arg params to the target dtype
+    (aux/normalisation statistics stay float32).  `sym=None` is the
+    params-only mode (dtype policy applied at dispatch by init())."""
+    new_sym = sym
+    keep_f32_names = set()
+    if sym is not None:
+        new_sym = convert_symbol(sym, target_dtype=target_dtype,
+                                 target_dtype_ops=target_dtype_ops,
+                                 fp32_ops=fp32_ops,
+                                 excluded_sym_names=excluded_sym_names)
+        if excluded_sym_names:
+            # params feeding an EXCLUDED op stay f32 — the exclusion
+            # must cover weights, not just activations
+            import json as _json
+            g = _json.loads(sym.tojson())
+            excl = set(excluded_sym_names)
+            for spec in g["nodes"]:
+                if spec["op"] != "null" and spec["name"] in excl:
+                    for e in spec["inputs"]:
+                        src = g["nodes"][e[0]]
+                        if src["op"] == "null":
+                            keep_f32_names.add(src["name"])
     new_args = {}
     for k, v in arg_params.items():
-        if any(f in k for f in _KEEP_F32_FRAGMENTS):
+        if k in keep_f32_names or \
+                any(f in k for f in _KEEP_F32_FRAGMENTS):
             new_args[k] = v
         else:
             new_args[k] = v.astype(target_dtype)
-    return sym, new_args, dict(aux_params)
+    return new_sym, new_args, dict(aux_params)

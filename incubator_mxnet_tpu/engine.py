@@ -52,8 +52,49 @@ def remove_dispatch_listener(fn: Callable):
         _LISTENERS.remove(fn)
 
 
+import threading as _threading
+
+# Trace-time op collector: while a cached-op pure function is being
+# traced, every imperative dispatch records its op name here — the
+# composition of the (later fully fused) executable.  Keyed per-thread:
+# tracing can nest across threads in the DataLoader.
+_TRACE_COLLECT = _threading.local()
+
+
+@contextlib.contextmanager
+def collect_op_names():
+    """Collect op names dispatched inside this scope (used while
+    tracing a hybridized block; the list is the fused program's op
+    composition for the profiler's aggregate table)."""
+    prev = getattr(_TRACE_COLLECT, "ops", None)
+    _TRACE_COLLECT.ops = []
+    try:
+        yield _TRACE_COLLECT.ops
+    finally:
+        _TRACE_COLLECT.ops = prev
+
+
+def has_listeners() -> bool:
+    return bool(_LISTENERS)
+
+
+def emit_fused_ops(step_name: str, ctx, op_names):
+    """Report the per-op composition of a fused executable that just
+    dispatched as one event.  Sub-ops carry zero duration — wall time
+    inside ONE XLA program is not attributable per op without XPlane
+    (profiler.start_jax_trace); the parent event carries the total.
+    Callers guard with `has_listeners()` so the hot path never builds
+    the name lists for nobody."""
+    for fn in _LISTENERS:
+        for op in op_names:
+            fn("%s[fused]" % op, ctx, 0.0)
+
+
 @contextlib.contextmanager
 def _dispatch_hook(name: str, ctx):
+    coll = getattr(_TRACE_COLLECT, "ops", None)
+    if coll is not None:
+        coll.append(name)
     if not _LISTENERS:
         yield
         return
@@ -65,11 +106,21 @@ def _dispatch_hook(name: str, ctx):
 
 
 def wait_all():
-    """Engine::WaitForAll — barrier on all outstanding device work."""
+    """Engine::WaitForAll — barrier on all outstanding device work.
+
+    PJRT plugin caveat (PROFILE.md "timing pitfall"): blocking on an
+    INDEPENDENT op can return before enqueued work drains on some
+    plugins, so this walks every live jax array and blocks on each —
+    a buffer's own readiness is the only sync this backend honours.
+    Prefer blocking on a result you actually need for timing loops."""
     import jax
     from . import autograd as _ag
     _ag.flush_pending("all")    # deferred programs must dispatch first
-    (jax.device_put(0) + 0).block_until_ready()
+    for arr in jax.live_arrays():
+        try:
+            arr.block_until_ready()
+        except Exception:
+            pass                # deleted/donated buffers mid-walk
     try:
         jax.effects_barrier()
     except Exception:

@@ -571,6 +571,11 @@ class _PendingFused:
                 prog.net_graph.block.name + "+" +
                 prog.loss_graph.block.name + "_fused", self.ctx):
             result, vjp_closure = prog.fwd_jit(*self.leaves)
+        if _engine.has_listeners():
+            _engine.emit_fused_ops(
+                "fused_fwd", self.ctx,
+                prog.net_graph._trace_ops.get(prog.net_fkey, []) +
+                prog.loss_graph._trace_ops.get(prog.loss_fkey, []))
         if _engine.naive_mode():
             for o in result:
                 o.block_until_ready()
@@ -790,6 +795,7 @@ class _CachedGraph:
         # executables — a single global copy mis-slices outputs when a
         # hybridized net switches between train and eval
         self._trace_meta = {}
+        self._trace_ops = {}        # fkey -> [op names] (profiler)
         self._jax = jax
 
     def _collect_params(self):
@@ -802,6 +808,7 @@ class _CachedGraph:
         block = self.block
 
         def pure(pvals, ivals, key_bits):
+            from .. import engine as _engine
             holder = _rnd.KeyHolder(jax.random.wrap_key_data(key_bits))
             # temporarily rebind param data to tracer-backed arrays; restore
             # after tracing (leaking tracers into Parameters would poison
@@ -818,7 +825,12 @@ class _CachedGraph:
             _rnd.push_trace_key(holder)
             try:
                 nd_in = [NDArray(v) for v in ivals]
-                out = block.forward(*nd_in)
+                with _engine.collect_op_names() as traced_ops:
+                    out = block.forward(*nd_in)
+                # op composition of the (fused) executable, for the
+                # profiler's aggregate table (per-op times inside ONE
+                # XLA program need XPlane — engine.emit_fused_ops)
+                self._trace_ops[fkey] = list(traced_ops)
             finally:
                 _rnd.pop_trace_key()
                 _ag.set_training(prev_train)
@@ -973,6 +985,10 @@ class _CachedGraph:
                                     pending.ctx):
             result, vjp_closure = self._get_fwd_vjp(*fkey)(
                 *pending.leaf_data)
+        if _engine.has_listeners():
+            _engine.emit_fused_ops(self.block.name + "_cachedop",
+                                   pending.ctx,
+                                   self._trace_ops.get(fkey, []))
         if _engine.naive_mode():
             for o in result:
                 o.block_until_ready()

@@ -5,8 +5,11 @@ TPU-native equivalent of the reference sparse storage types
 src/operator/tensor/cast_storage-inl.h).  XLA has no native sparse
 support, so (per SURVEY §7.2) row_sparse is an (indices, values) pair and
 csr an (indptr, indices, values) triple; kernels are gather/scatter +
-segment-sum.  Full implementation lands with the Wide&Deep slice — this
-module currently provides the types, conversion, and dense bridging.
+segment-sum.  The full sparse path is live: Embedding sparse_grad
+produces row_sparse grads, the optimizers apply lazy sparse updates,
+kvstore supports sparse push/row_sparse_pull, and the Wide&Deep
+convergence test exercises it end to end (test_sparse, test_kvstore,
+test_models).
 """
 from __future__ import annotations
 

@@ -369,6 +369,62 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     return out, mean, var
 
 
+# --- fused sparse softmax cross-entropy (memory-exact vjp) -----------
+#
+# Plain autodiff through log_softmax + pick saves the f32 probability
+# slab over the FULL vocab as a residual — at BERT scale (B·T=16k rows
+# x 30522 vocab) that is multiple 2 GB tensors and is what OOMs b>=16
+# on a 16 GB chip.  Here the residuals are the logits the caller
+# already holds, the labels, and a per-row f32 lse; the backward
+# recomputes softmax from them in one fused kernel.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _softmax_ce_core(pred, label, axis):
+    loss, _ = _softmax_ce_fwd(pred, label, axis)
+    return loss
+
+
+def _softmax_ce_fwd(pred, label, axis):
+    p32 = pred.astype(jnp.float32)
+    m = jnp.max(p32, axis=axis, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(p32 - m), axis=axis,
+                              keepdims=True))
+    idx = jnp.expand_dims(label.astype(jnp.int32), axis)
+    picked = jnp.take_along_axis(p32, idx, axis=axis)
+    loss = (lse - picked).squeeze(axis)
+    return loss, (pred, label, lse)
+
+
+def _softmax_ce_core_fwd(pred, label, axis):
+    return _softmax_ce_fwd(pred, label, axis)
+
+
+def _softmax_ce_core_bwd(axis, res, dy):
+    pred, label, lse = res
+    p = jnp.exp(pred.astype(jnp.float32) - lse)      # softmax, f32 math
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), pred.shape[axis],
+                            axis=axis, dtype=jnp.float32)
+    dpred = (p - onehot) * jnp.expand_dims(
+        dy.astype(jnp.float32), axis)
+    return dpred.astype(pred.dtype), None
+
+
+_softmax_ce_core.defvjp(_softmax_ce_core_fwd, _softmax_ce_core_bwd)
+
+
+@register("_fused_softmax_ce", ndarray_inputs=("pred", "label"),
+          nograd_argnums=(1,))
+def fused_softmax_ce(pred, label, axis=-1):
+    """-log softmax(pred)[label] per row, with a memory-exact custom
+    vjp (residuals: logits + labels + per-row lse; the backward
+    recomputes softmax).  The gluon SoftmaxCrossEntropyLoss hot path
+    (ref: the SoftmaxOutput fused kernel, src/operator/softmax_output*
+    — fused fwd+bwd was the reference's answer to the same problem)."""
+    ax = axis % pred.ndim
+    return _softmax_ce_core(pred, label, ax)
+
+
 # --- SyncBatchNorm: cross-replica moments over a named mesh axis -----
 #
 # TPU-first note: under pjit/GSPMD (ShardedTrainer), a plain BatchNorm's

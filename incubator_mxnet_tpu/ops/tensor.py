@@ -17,6 +17,8 @@ Design notes:
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as _np
@@ -238,6 +240,42 @@ def cast(data, dtype="float32"):
 
 
 alias("cast", "Cast")
+
+
+@register("amp_cast", ndarray_inputs=("data",))
+def amp_cast(data, dtype="float32"):
+    """ref: src/operator/tensor/amp_cast.cc AMPCastCompute — the cast
+    the AMP graph pass inserts.  Unlike Cast it only touches floating
+    inputs (int indices/labels pass through), and XLA fuses it into the
+    consumer so a carried cast costs nothing at runtime."""
+    from ..base import dtype_np
+    if not jnp.issubdtype(jnp.asarray(data).dtype, jnp.floating):
+        return data
+    return data.astype(dtype_np(dtype))
+
+
+def _amp_multicast_nout(attrs):
+    return int(attrs.get("num_outputs", 1))
+
+
+@register("amp_multicast", ndarray_inputs=None, num_outputs=-1,
+          num_outputs_fn=_amp_multicast_nout)
+def amp_multicast(*data, num_outputs=1, cast_narrow=False):
+    """ref: amp_multicast — common-dtype cast across inputs: widest
+    floating dtype wins (narrowest with cast_narrow), non-float inputs
+    pass through untouched."""
+    fdts = [d.dtype for d in data
+            if jnp.issubdtype(jnp.asarray(d).dtype, jnp.floating)]
+    if not fdts:
+        return tuple(data) if len(data) > 1 else data[0]
+    if cast_narrow:
+        target = min(fdts, key=lambda t: jnp.dtype(t).itemsize)
+    else:
+        target = functools.reduce(jnp.promote_types, fdts)
+    outs = tuple(d.astype(target)
+                 if jnp.issubdtype(jnp.asarray(d).dtype, jnp.floating)
+                 else d for d in data)
+    return outs if len(outs) > 1 else outs[0]
 
 
 @register("clip", ndarray_inputs=("data",))

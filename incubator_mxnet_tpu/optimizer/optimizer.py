@@ -232,6 +232,12 @@ def _train_step_dispatch(prod, pending, opname, static_kv, weights,
                                 weights[0].context):
         outs, new_ws, new_sts, gouts = jf(*prod.leaves, pending.cots,
                                           sts, lrs, wds, scal)
+    if _engine.has_listeners():
+        _engine.emit_fused_ops(
+            opname + "_train_step", weights[0].context,
+            prog.net_graph._trace_ops.get(prog.net_fkey, []) +
+            prog.loss_graph._trace_ops.get(prog.loss_fkey, []) +
+            [opname] * len(weights))
     prod.finish_from_train_step(outs)
     pending.fulfill(zip(grads, gouts))
     _rebind_updated(weights, new_ws, state_cols, new_sts)

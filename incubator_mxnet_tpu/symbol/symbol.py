@@ -482,8 +482,14 @@ def Group(symbols):
     return Symbol("_group", list(symbols), {}, "group", len(symbols))
 
 
-def load_json(json_str):
-    data = json.loads(json_str)
+def rebuild_graph(data, make_inputs=None):
+    """Rebuild a Symbol from a parsed graph-JSON dict.
+
+    `make_inputs(idx, spec, ins, resolve)` — optional per-node hook
+    returning the node's input symbol list (`resolve(i, o)` yields the
+    already-rebuilt producer view); graph passes (e.g. the AMP
+    convert_symbol cast inserter) use it to rewrite edges while sharing
+    ONE copy of the rebuild/view semantics with load_json."""
     nodes = []
 
     def pick_out(node, o):
@@ -493,20 +499,32 @@ def load_json(json_str):
             return node.outputs[o]
         return node
 
-    for spec in data["nodes"]:
+    def resolve(i, o):
+        return pick_out(nodes[i], o)
+
+    for idx, spec in enumerate(data["nodes"]):
         attrs = {k: _parse_attr(v) for k, v in
                  (spec.get("attrs") or {}).items()}
         if spec["op"] == "null":
             nodes.append(var(spec["name"], attr=attrs))
+            continue
+        ins = [(e[0], e[1] if len(e) > 1 else 0) for e in spec["inputs"]]
+        if make_inputs is None:
+            inputs = [resolve(i, o) for i, o in ins]
         else:
-            inputs = [pick_out(nodes[i], o) for i, o, _ in spec["inputs"]]
-            nodes.append(_apply(spec["op"], inputs, attrs,
-                                name=spec["name"]))
+            inputs = make_inputs(idx, spec, ins, resolve)
+        nodes.append(_apply(spec["op"], inputs, attrs,
+                            name=spec["name"]))
     heads = data["heads"]
     if len(heads) == 1:
-        i, o, _ = heads[0]
-        return pick_out(nodes[i], o)
-    return Group([pick_out(nodes[i], o) for i, o, _ in heads])
+        h = heads[0]
+        return resolve(h[0], h[1] if len(h) > 1 else 0)
+    return Group([resolve(h[0], h[1] if len(h) > 1 else 0)
+                  for h in heads])
+
+
+def load_json(json_str):
+    return rebuild_graph(json.loads(json_str))
 
 
 def _parse_attr(v):

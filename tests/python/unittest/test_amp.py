@@ -101,3 +101,75 @@ def test_amp_convert_model_keeps_norm_stats_f32():
     assert str(new_args["fc_weight"].dtype) == "bfloat16"
     assert str(new_args["bn_gamma"].dtype) == "float32"
     assert str(new_aux["bn_moving_mean"].dtype) == "float32"
+
+
+def test_amp_convert_symbol_inserts_casts_and_roundtrips():
+    """VERDICT r4: the symbol graph pass inserts amp_cast nodes feeding
+    listed ops, survives tojson/load_json, and evaluates close to the
+    f32 original (the exported graph CARRIES its precision policy)."""
+    import json
+    import incubator_mxnet_tpu.symbol as S
+    from incubator_mxnet_tpu.symbol import _eval_symbol, load_json
+
+    rs = np.random.RandomState(4)
+    x = S.var("data")
+    y = S.FullyConnected(x, S.var("w"), S.var("b"), num_hidden=8,
+                         name="fc")
+    y = S.Activation(y, act_type="relu")
+    y = S.softmax(y, axis=-1, name="sm")
+    arg = {"w": nd.array(rs.randn(8, 6).astype(np.float32)),
+           "b": nd.array(rs.randn(8).astype(np.float32))}
+    xv = nd.array(rs.randn(3, 6).astype(np.float32))
+    want = _eval_symbol(y, {"data": xv, **arg}).asnumpy()
+
+    conv = amp.convert_symbol(y, target_dtype="bfloat16")
+    graph = json.loads(conv.tojson())
+    ops = [n["op"] for n in graph["nodes"]]
+    assert "amp_cast" in ops, ops
+    # fc inputs are cast to bf16; softmax input cast (back up) to f32
+    cast_dtypes = [n["attrs"]["dtype"] for n in graph["nodes"]
+                   if n["op"] == "amp_cast"]
+    assert "bfloat16" in str(cast_dtypes) and "float32" in str(
+        cast_dtypes), cast_dtypes
+
+    # round-trip + numerics (bf16 matmul tolerance)
+    rt = load_json(conv.tojson())
+    got = _eval_symbol(rt, {"data": xv, **arg}).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+def test_amp_convert_symbol_shares_one_cast_per_producer():
+    import json
+    import incubator_mxnet_tpu.symbol as S
+
+    x = S.var("data")
+    a = S.FullyConnected(x, S.var("w1"), S.var("b1"), num_hidden=4,
+                         name="fc1")
+    b = S.FullyConnected(x, S.var("w2"), S.var("b2"), num_hidden=4,
+                         name="fc2")
+    g = a + b
+    conv = amp.convert_symbol(g, target_dtype="bfloat16")
+    graph = json.loads(conv.tojson())
+    # 'data' feeds two fp16 ops but is cast ONCE
+    data_casts = [n for n in graph["nodes"] if n["op"] == "amp_cast"
+                  and "data_amp_cast" in n["name"]]
+    assert len(data_casts) == 1, [n["name"] for n in graph["nodes"]]
+
+
+def test_amp_multicast_op():
+    a = nd.array(np.ones((2, 2), np.float32))
+    b = nd.array(np.ones((2, 2)), dtype="bfloat16")
+    o1, o2 = nd.invoke("amp_multicast", a, b, num_outputs=2)
+    assert str(o1.dtype) == "float32" and str(o2.dtype) == "float32"
+    n1, n2 = nd.invoke("amp_multicast", a, b, num_outputs=2,
+                       cast_narrow=True)
+    assert str(n1.dtype) == "bfloat16" and str(n2.dtype) == "bfloat16"
+
+
+def test_amp_cast_op_leaves_ints_alone():
+    idx = nd.array(np.array([1, 2], np.int32), dtype="int32")
+    out = nd.invoke("amp_cast", idx, dtype="bfloat16")
+    assert str(out.dtype) == "int32"
+    f = nd.invoke("amp_cast", nd.array(np.ones(3, np.float32)),
+                  dtype="bfloat16")
+    assert str(f.dtype) == "bfloat16"

@@ -242,3 +242,31 @@ def test_dataloader_unpicklable_falls_back_to_threads():
         out = [b for b in dl]
     assert len(out) == 2
     assert out[0].asnumpy()[0, 0] == 2.0
+
+
+def test_uint8_mode_matches_float_mode(rec_file):
+    """dtype='uint8' ships raw augmented pixels; with identity mean/std
+    the float32 pipeline must agree bit-for-bit (same seed, same
+    augmentation draws)."""
+    path, _ = rec_file
+    kw = dict(batch_size=8, data_shape=(3, 32, 32), resize=36,
+              rand_crop=True, rand_mirror=True, shuffle=True, seed=11)
+    rf = native.NativeImageRecordReader(path, **kw)
+    ru = native.NativeImageRecordReader(path, dtype="uint8", **kw)
+    n = 0
+    for (df, lf), (du, lu) in zip(rf, ru):
+        assert du.dtype == onp.uint8
+        onp.testing.assert_array_equal(lf, lu)
+        onp.testing.assert_allclose(du.astype(onp.float32), df,
+                                    rtol=0, atol=0)
+        n += 1
+    assert n >= 4
+
+
+def test_uint8_mode_rejects_mean_std(rec_file):
+    path, _ = rec_file
+    with pytest.raises(ValueError):
+        native.NativeImageRecordReader(path, batch_size=4,
+                                       data_shape=(3, 16, 16),
+                                       dtype="uint8",
+                                       mean=(1.0, 1.0, 1.0))

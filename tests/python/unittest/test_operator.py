@@ -361,3 +361,31 @@ def test_grid_generator_warp():
     g2 = nd.invoke("GridGenerator", flow2, transform_type="warp",
                    target_shape=(3, 5)).asnumpy()
     assert np.allclose(g2[0, 0] - grid[0, 0], 2.0 / 4.0, atol=1e-6)
+
+
+def test_fused_softmax_ce_matches_decomposed():
+    """_fused_softmax_ce (memory-exact vjp: logits+lse residuals only)
+    vs log_softmax+pick — forward and input gradients."""
+    rs = np.random.RandomState(21)
+    pred_np = (rs.randn(5, 13) * 2).astype(np.float32)
+    lab = nd.array(rs.randint(0, 13, 5).astype(np.float32))
+
+    p1 = nd.array(pred_np)
+    p1.attach_grad()
+    with ag.record():
+        l1 = nd.invoke("_fused_softmax_ce", p1, lab, axis=-1)
+        (l1 * nd.array(np.arange(1.0, 6.0, dtype=np.float32))) \
+            .sum().backward()
+
+    p2 = nd.array(pred_np)
+    p2.attach_grad()
+    with ag.record():
+        ls = nd.log_softmax(p2, axis=-1)
+        l2 = -nd.pick(ls, lab, axis=-1)
+        (l2 * nd.array(np.arange(1.0, 6.0, dtype=np.float32))) \
+            .sum().backward()
+
+    np.testing.assert_allclose(l1.asnumpy(), l2.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(p1.grad.asnumpy(), p2.grad.asnumpy(),
+                               rtol=1e-4, atol=1e-5)

@@ -1,0 +1,72 @@
+"""Generate the round-4 backwards-compat assets (run ONCE in round 4;
+the committed outputs are loaded by test_backwards_compat.py in every
+later round — ref: tests/nightly/model_backwards_compat_train.py's
+train_utils.py generator half)."""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import incubator_mxnet_tpu as mx            # noqa: E402
+from incubator_mxnet_tpu import nd, gluon, autograd as ag  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "tests", "assets",
+                   "r4")
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    np.random.seed(42)
+    mx.random.seed(42)
+
+    # 1) raw ndarray save/load (0x112 format)
+    tensors = {
+        "a": nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4)),
+        "b": nd.array(np.ones((5,), np.int32), dtype="int32"),
+        "c": nd.array(np.linspace(-1, 1, 16).astype(np.float32)),
+    }
+    nd.save(os.path.join(OUT, "tensors.nd"), tensors)
+
+    # 2) trained gluon net params + trainer states + exported symbol
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu"))
+        net.add(gluon.nn.BatchNorm())
+        net.add(gluon.nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = nd.array(np.random.randn(8, 10).astype(np.float32))
+    y = nd.array(np.random.randint(0, 4, 8).astype(np.float32))
+    for _ in range(5):
+        with ag.record():
+            l = loss_fn(net(x), y)
+            l.backward()
+        trainer.step(8)
+    net.save_parameters(os.path.join(OUT, "mlp.params"))
+    trainer.save_states(os.path.join(OUT, "mlp.states"))
+    net.hybridize()
+    net(x)
+    net.export(os.path.join(OUT, "mlp"))
+
+    xin = np.random.RandomState(7).randn(3, 10).astype(np.float32)
+    out = net(nd.array(xin)).asnumpy()
+
+    meta = {
+        "tensors": {k: np.asarray(v.asnumpy()).ravel()[:8].tolist()
+                    for k, v in tensors.items()},
+        "input": xin.tolist(),
+        "output": out.tolist(),
+        "num_update": trainer._updaters[0].optimizer.num_update,
+    }
+    with open(os.path.join(OUT, "expect.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    print("assets written to", OUT)
+
+
+if __name__ == "__main__":
+    main()
