@@ -71,7 +71,7 @@ def _ensure_rec(n_images=_REC_N, path=_REC_PATH):
     return path
 
 
-def run_cachedop(batch=128, warmup=2, iters=12, extra=None):
+def run_cachedop(batch=128, warmup=3, iters=16, extra=None):
     """North-star config 1: hybridized Gluon net + autograd + Trainer.
 
     Also produces (into `extra`, budget-permitting) the INPUT-FED
@@ -170,7 +170,7 @@ def run_cachedop(batch=128, warmup=2, iters=12, extra=None):
     return rate
 
 
-def run_bert(batch=16, seq=512, warmup=2, iters=6):
+def run_bert(batch=16, seq=512, warmup=2, iters=10):
     """North-star config 2: BERT-base MLM pretrain step, tokens/sec/chip.
 
     Same user-facing path as config 1 (hybridize → CachedOp → Trainer),
@@ -220,7 +220,7 @@ def run_bert(batch=16, seq=512, warmup=2, iters=6):
     return batch * seq * iters / (time.perf_counter() - t0)
 
 
-def run_ssd(batch=8, size=512, warmup=2, iters=8):
+def run_ssd(batch=8, size=512, warmup=2, iters=10):
     """Config 3a: SSD-512 training step, images/sec/chip (hybridize →
     CachedOp → Trainer, MultiBoxTarget loss like example/ssd)."""
     import incubator_mxnet_tpu as mx
@@ -266,7 +266,7 @@ def run_ssd(batch=8, size=512, warmup=2, iters=8):
     return batch * iters / (time.perf_counter() - t0)
 
 
-def run_rcnn(batch=2, size=512, warmup=2, iters=8):
+def run_rcnn(batch=2, size=512, warmup=2, iters=10):
     """Config 3b: Faster-RCNN end-to-end training step, images/sec/chip
     (RPN → Proposal → ProposalTarget → ROIAlign → heads, the
     example/rcnn train_end2end graph; fixed shapes keep it ONE XLA
@@ -322,7 +322,7 @@ def run_rcnn(batch=2, size=512, warmup=2, iters=8):
     return batch * iters / (time.perf_counter() - t0)
 
 
-def run_gnmt(batch=128, src_len=32, tgt_len=32, warmup=3, iters=10):
+def run_gnmt(batch=128, src_len=32, tgt_len=32, warmup=3, iters=40):
     """Config 4: GNMT-style LSTM seq2seq training, target tokens/sec."""
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, gluon, autograd as ag
@@ -362,7 +362,7 @@ def run_gnmt(batch=128, src_len=32, tgt_len=32, warmup=3, iters=10):
     return batch * tgt_len * iters / (time.perf_counter() - t0)
 
 
-def run_wide_deep(batch=2048, fields=16, warmup=2, iters=10):
+def run_wide_deep(batch=2048, fields=16, warmup=3, iters=40):
     """Config 5: Wide&Deep recommender with row_sparse embedding grads,
     samples/sec."""
     import incubator_mxnet_tpu as mx
@@ -428,7 +428,7 @@ def build_sharded_trainer(batch):
     return trainer
 
 
-def run_sharded(batch=256, warmup=2, iters=12):
+def run_sharded(batch=256, warmup=2, iters=16):
     import jax
     import jax.numpy as jnp
     trainer = build_sharded_trainer(batch)

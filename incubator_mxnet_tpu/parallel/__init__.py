@@ -8,10 +8,15 @@ from .mesh import make_mesh, Mesh, NamedSharding, P, replicated, \
 from .functional import functionalize, extract_params, load_params
 from .trainer import (ShardedTrainer, softmax_ce_loss, sgd_momentum_tree,
                       adam_tree)
+from .pipeline import (pipeline_apply, split_microbatches,
+                       stack_stage_params)
+from .moe import switch_route, moe_apply, moe_ffn
 from .ring_attention import (ring_attention, ulysses_attention,
                              local_attention)
 
 __all__ = ["make_mesh", "Mesh", "NamedSharding", "P", "replicated",
+           "pipeline_apply", "split_microbatches", "stack_stage_params",
+           "switch_route", "moe_apply", "moe_ffn",
            "batch_sharded", "default_dp_mesh", "functionalize",
            "extract_params", "load_params", "ShardedTrainer",
            "softmax_ce_loss", "sgd_momentum_tree", "adam_tree",

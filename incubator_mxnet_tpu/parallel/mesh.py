@@ -46,3 +46,28 @@ def batch_sharded(mesh: Mesh, axis: str = "data",
     spec = [None] * (batch_dim + 1)
     spec[batch_dim] = axis
     return NamedSharding(mesh, P(*spec))
+
+
+def squeeze_stage_axis(tree):
+    """Strip the leading size-1 axis a P('<axis>')-sharded stacked tree
+    carries inside a shard_map body (each device sees its own slice)."""
+    import jax as _jax
+
+    def _squeeze(leaf):
+        return leaf[0] if getattr(leaf, "ndim", 0) and             leaf.shape[0] == 1 else leaf
+    return _jax.tree_util.tree_map(_squeeze, tree)
+
+
+def mark_varying(x, axis_name):
+    """Tag an unvarying value as device-varying for shard_map's vma
+    type system (scan carries that become per-device): lax.pcast on
+    current jax, lax.pvary fallback, no-op where vma doesn't exist."""
+    from jax import lax as _lax
+    try:
+        return _lax.pcast(x, (axis_name,), to="varying")
+    except (AttributeError, TypeError):
+        pass
+    try:
+        return _lax.pvary(x, axis_name)
+    except AttributeError:
+        return x

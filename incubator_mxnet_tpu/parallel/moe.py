@@ -1,0 +1,128 @@
+"""Expert parallelism: switch-routed Mixture-of-Experts over a mesh
+axis.
+
+Beyond-reference axis (absent in MXNet 1.x — SURVEY §2.3 lists only
+DP + ctx_group).  TPU-first shape, per the Switch-Transformer /
+scaling-book recipe: tokens live data-sharded, experts live one (or
+more) per device along the `expert` axis, and dispatch/return ride
+TWO `all_to_all` collectives over ICI.  Routing is the capacity-
+factored top-1 einsum dispatch — fixed shapes, no sorting, fully
+XLA-compilable; overflowing tokens are dropped (residual passes them
+through, the standard Switch behaviour).
+
+All functions are shard_map-body functions (like ring_attention):
+call them inside `shard_map` with `axis_name` bound to the expert
+axis.  Gradients flow through `all_to_all`/einsum natively.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["switch_route", "moe_apply", "moe_ffn"]
+
+
+def switch_route(router_logits, capacity):
+    """Top-1 capacity-factored routing (per-device local tokens).
+
+    router_logits: (T, E).  Returns (dispatch (T, E, C) one-hot,
+    combine (T, E, C) prob-weighted, aux_loss scalar — the Switch
+    load-balancing loss)."""
+    T, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                  # (T,)
+    mask = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # (T, E)
+    # position of each token in its expert's queue
+    pos = jnp.cumsum(mask, axis=0) * mask                # 1-based
+    keep = (pos <= capacity) * mask                      # (T, E)
+    pos_idx = (pos - 1.0) * keep                         # 0-based
+    dispatch = keep[..., None] * jax.nn.one_hot(
+        pos_idx.astype(jnp.int32), capacity, dtype=jnp.float32)
+    gate = jnp.sum(probs * keep, axis=-1, keepdims=True)  # (T, 1)
+    combine = dispatch * gate[..., None]
+    # load-balancing aux loss: E * sum_e fraction_tokens_e * mean_prob_e
+    frac = jnp.mean(mask, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def moe_apply(x, router_w, expert_fn, expert_params, axis_name,
+              capacity_factor=1.25):
+    """Expert-parallel switch MoE layer (shard_map body).
+
+    x: (T_local, d) this device's tokens.
+    router_w: (d, E_total) router weights (replicated).
+    expert_fn(params, tokens) -> tokens: one expert's computation;
+        `expert_params` is THIS device's expert's params (tree sharded
+        P('expert') outside; a leading axis of 1 is squeezed).
+    Returns (T_local, d) combined outputs + aux loss.  Tokens routed
+    past capacity are dropped (add x residually outside if desired).
+    """
+    n_dev = lax.psum(1, axis_name)
+    T, d = x.shape
+    E = router_w.shape[-1]
+    if E % n_dev:
+        raise ValueError("experts %d not divisible by axis size %d"
+                         % (E, n_dev))
+    e_local = E // n_dev
+    capacity = int(max(1, (T * capacity_factor) // E))
+
+    from .mesh import squeeze_stage_axis
+    eparams = squeeze_stage_axis(expert_params)
+
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    dispatch, combine, aux = switch_route(logits, capacity)
+
+    # gather this device's dispatched tokens: (E, C, d)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch,
+                           x.astype(jnp.float32))
+    # all_to_all: split the expert axis across devices, concat the
+    # sender shards — device e receives (e_local, n_dev*C, d): ALL
+    # devices' tokens for ITS experts
+    expert_in = expert_in.reshape(n_dev, e_local * capacity, d)
+    recv = lax.all_to_all(expert_in, axis_name, split_axis=0,
+                          concat_axis=0, tiled=False)
+    # recv: (n_dev, e_local*C, d) where axis 0 = source device
+    recv = recv.reshape(n_dev, e_local, capacity, d) \
+        .transpose(1, 0, 2, 3) \
+        .reshape(e_local, n_dev * capacity, d)
+    # run the local expert(s)
+    if e_local == 1:
+        out = expert_fn(eparams, recv[0].astype(x.dtype))[None]
+    else:
+        out = jax.vmap(lambda p, t: expert_fn(p, t.astype(x.dtype)),
+                       in_axes=(0, 0))(eparams, recv)
+    out = out.astype(jnp.float32)
+    # reverse the shuffle
+    back = out.reshape(e_local, n_dev, capacity, d) \
+        .transpose(1, 0, 2, 3) \
+        .reshape(n_dev, e_local * capacity, d)
+    sent = lax.all_to_all(back, axis_name, split_axis=0,
+                          concat_axis=0, tiled=False)
+    sent = sent.reshape(E, capacity, d)
+    # combine back to token order, weighted by the router gate
+    y = jnp.einsum("tec,ecd->td", combine, sent)
+    # aux is averaged across the axis so it is replicated (a scalar
+    # loss term addable outside shard_map)
+    return y.astype(x.dtype), lax.pmean(aux, axis_name)
+
+
+def moe_ffn(d_model, d_hidden, n_experts, key=None):
+    """Convenience: per-expert FFN params (stacked on the expert axis —
+    shard with P('expert')) + the matching expert_fn."""
+    import numpy as np
+    rs = np.random.RandomState(0 if key is None else key)
+    params = {
+        "w1": jnp.asarray(rs.randn(n_experts, d_model, d_hidden)
+                          * (1.0 / np.sqrt(d_model)), jnp.float32),
+        "w2": jnp.asarray(rs.randn(n_experts, d_hidden, d_model)
+                          * (1.0 / np.sqrt(d_hidden)), jnp.float32),
+    }
+
+    def expert_fn(p, t):
+        h = jax.nn.relu(t @ p["w1"])
+        return h @ p["w2"]
+
+    return params, expert_fn

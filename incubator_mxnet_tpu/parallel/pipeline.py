@@ -1,0 +1,100 @@
+"""Pipeline parallelism: GPipe-style microbatched collective pipeline.
+
+Beyond-reference axis (MXNet 1.x has no pipeline parallelism; its
+model-parallel story was ctx_group device placement — SURVEY §2.3).
+TPU-first realisation per the scaling-book recipe: every stage lives on
+one mesh slice along the `pipe` axis, all stages compute in lockstep on
+DIFFERENT microbatches, and activations hop stage→stage with ONE
+`ppermute` per step over ICI.  The whole schedule is a `lax.scan`
+inside `shard_map` — one compiled program, S+M-1 steps, bubble fraction
+(S-1)/(S+M-1).
+
+The backward comes from jax autodiff: the transpose of `ppermute` is
+the reverse `ppermute`, so the reverse pipeline schedule is derived,
+not hand-written.
+
+Constraint: `stage_fn(stage_params, x) -> y` must preserve the
+activation shape/dtype (transformer-block-style stages) — the hop
+buffer is shape-static across stages.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["pipeline_apply", "split_microbatches", "stack_stage_params"]
+
+
+def split_microbatches(x, n_microbatches):
+    """(B, ...) → (M, B/M, ...) microbatch axis for pipeline_apply."""
+    B = x.shape[0]
+    if B % n_microbatches:
+        raise ValueError("batch %d not divisible into %d microbatches"
+                         % (B, n_microbatches))
+    return x.reshape((n_microbatches, B // n_microbatches) + x.shape[1:])
+
+
+def stack_stage_params(per_stage_params):
+    """[stage0_tree, stage1_tree, ...] → one tree with a leading stage
+    axis; shard it with PartitionSpec('pipe', ...) so shard_map hands
+    each device its own stage's (squeezed) params."""
+    return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls),
+                                  *per_stage_params)
+
+
+def pipeline_apply(stage_fn, stage_params, x_mb, axis_name):
+    """Run the pipeline INSIDE a shard_map body.
+
+    stage_params: THIS device's stage parameters — a stacked tree
+        sharded ``P('pipe')`` arrives with a leading axis of size 1,
+        which is squeezed here.
+    x_mb: (M, mb, ...) microbatches, replicated across the pipe axis.
+    Returns (M, mb, ...) outputs, replicated (masked psum off the last
+    stage).
+    """
+    n_stages = lax.psum(1, axis_name)       # static inside shard_map
+    idx = lax.axis_index(axis_name)
+    M = x_mb.shape[0]
+
+    from .mesh import squeeze_stage_axis
+    params = squeeze_stage_axis(stage_params)
+
+    out_aval = jax.eval_shape(
+        stage_fn, params,
+        jax.ShapeDtypeStruct(x_mb.shape[1:], x_mb.dtype))
+    if tuple(out_aval.shape) != tuple(x_mb.shape[1:]):
+        raise ValueError("stage_fn must preserve activation shape, "
+                         "got %s -> %s" % (x_mb.shape[1:],
+                                           out_aval.shape))
+
+    n_steps = n_stages + M - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def body(carry, t):
+        state, outs = carry
+        # stage 0 ingests microbatch t (clipped: steps beyond M feed a
+        # repeat that never lands in the output window)
+        inp = lax.dynamic_index_in_dim(x_mb, jnp.clip(t, 0, M - 1), 0,
+                                       keepdims=False)
+        cur = jnp.where(idx == 0, inp, state)
+        y = stage_fn(params, cur)
+        # the LAST stage emits microbatch (t - (S-1)) at step t
+        pos = t - (n_stages - 1)
+        upd = lax.dynamic_update_index_in_dim(
+            outs, y, jnp.clip(pos, 0, M - 1), 0)
+        outs = jnp.where(pos >= 0, upd, outs)
+        state = lax.ppermute(y, axis_name, perm)
+        return (state, outs), None
+
+    state0 = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
+    outs0 = jnp.zeros((M,) + tuple(out_aval.shape), out_aval.dtype)
+    # the carry is device-varying (each stage computes its own): mark
+    # the unvarying zeros as varying for shard_map's vma type system
+    from .mesh import mark_varying
+    state0 = mark_varying(state0, axis_name)
+    outs0 = mark_varying(outs0, axis_name)
+    (_, outs), _ = lax.scan(body, (state0, outs0), jnp.arange(n_steps))
+    # only the last stage holds real outputs; mask + psum replicates
+    outs = jnp.where(idx == n_stages - 1, outs, jnp.zeros_like(outs))
+    return lax.psum(outs, axis_name)
