@@ -26,15 +26,24 @@ _SRC = os.path.join(os.path.dirname(os.path.dirname(
 _SO = os.path.join(os.path.dirname(_SRC), "libmxtpu_io.so")
 
 
-def build_library(force=False):
-    """Compile the pipeline .so (idempotent)."""
-    if os.path.exists(_SO) and not force and \
-            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return _SO
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-pthread",
-           _SRC, "-ljpeg", "-o", _SO]
+def build_library(force=False, src=None, out=None, march_native=True):
+    """Compile the pipeline .so (idempotent; also the ONE compile
+    recipe setup.py's wheel build calls — keep flags here)."""
+    src = src or _SRC
+    out = out or _SO
+    if os.path.exists(out) and not force and \
+            os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread"]
+    if march_native:
+        cmd.append("-march=native")
+    cmd += [src, "-ljpeg", "-o", out]
     subprocess.run(cmd, check=True, capture_output=True)
-    return _SO
+    return out
+
+
+_PACKAGED_SO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "libmxtpu_io.so")
 
 
 def _load():
@@ -43,10 +52,26 @@ def _load():
         if _LIB is not None or _TRIED:
             return _LIB
         _TRIED = True
-        try:
-            so = build_library()
-            lib = ctypes.CDLL(so)
-        except (OSError, subprocess.CalledProcessError):
+        # wheel installs ship the prebuilt library as package data
+        # (setup.py); in a dev checkout the mtime-checked compile from
+        # src/io wins so C++ edits always take effect, and a stale or
+        # wrong-arch packaged copy falls back to compiling
+        candidates = []
+        if os.path.exists(_PACKAGED_SO) and (
+                not os.path.exists(_SRC) or
+                os.path.getmtime(_PACKAGED_SO) >=
+                os.path.getmtime(_SRC)):
+            candidates.append(lambda: _PACKAGED_SO)
+        if os.path.exists(_SRC):
+            candidates.append(build_library)
+        lib = None
+        for get_so in candidates:
+            try:
+                lib = ctypes.CDLL(get_so())
+                break
+            except (OSError, subprocess.CalledProcessError):
+                continue
+        if lib is None:
             return None
         lib.mxio_create.restype = ctypes.c_void_p
         lib.mxio_create.argtypes = [

@@ -69,7 +69,10 @@ def pipeline_apply(stage_fn, stage_params, x_mb, axis_name):
                                            out_aval.shape))
 
     n_steps = n_stages + M - 1
-    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    # partial permutation: stage 0 always overwrites its incoming state
+    # with the next microbatch, so the wrap-around (last→0) hop would
+    # be a dead transfer every step — ppermute zero-fills the gap
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
 
     def body(carry, t):
         state, outs = carry
