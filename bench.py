@@ -225,16 +225,18 @@ def run_ssd(batch=8, size=512, warmup=2, iters=10):
     CachedOp → Trainer, MultiBoxTarget loss like example/ssd)."""
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, gluon, autograd as ag
-    from incubator_mxnet_tpu.models import ssd_512, ssd_training_targets
+    from incubator_mxnet_tpu.models import ssd_512, SSDTrainLoss
 
     ctx = mx.gpu()
     net = ssd_512(classes=20)
     net.initialize(ctx=ctx)
     net.hybridize()
+    # hybridized target+CE+smooth-L1 block: net -> loss is ONE fused
+    # train-step executable (+34% vs the eager composition, r4)
+    loss_b = SSDTrainLoss()
+    loss_b.hybridize()
     trainer = gluon.Trainer(net.collect_params(), "sgd",
                             {"learning_rate": 0.01, "momentum": 0.9})
-    sce = gluon.loss.SoftmaxCrossEntropyLoss()
-    sce.hybridize()
     rs = np.random.RandomState(0)
     x = nd.array(rs.randn(batch, 3, size, size).astype(np.float32),
                  ctx=ctx)
@@ -246,13 +248,7 @@ def run_ssd(batch=8, size=512, warmup=2, iters=10):
     def step():
         with ag.record():
             anchors, cls_preds, box_preds = net(x)
-            loc_t, loc_m, cls_t = ssd_training_targets(anchors,
-                                                       cls_preds, y)
-            B, N = cls_t.shape
-            cls_l = sce(cls_preds.reshape((B * N, -1)),
-                        cls_t.reshape((-1,)))
-            box_l = (nd.smooth_l1(box_preds - loc_t) * loc_m).mean()
-            loss = cls_l.mean() + box_l
+            loss = loss_b(anchors, cls_preds, box_preds, y)
             loss.backward()
         trainer.step(batch)
 
@@ -273,7 +269,7 @@ def run_rcnn(batch=2, size=512, warmup=2, iters=10):
     executable)."""
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, gluon, autograd as ag
-    from incubator_mxnet_tpu.models import FasterRCNN
+    from incubator_mxnet_tpu.models import FasterRCNN, RCNNTrainLoss
 
     ctx = mx.gpu()
     net = FasterRCNN(classes=20, backbone_channels=(32, 64, 128, 256),
@@ -283,10 +279,11 @@ def run_rcnn(batch=2, size=512, warmup=2, iters=10):
                      rpn_min_size=8, roi_size=7, top_units=1024)
     net.initialize(ctx=ctx)
     net.hybridize()
+    # hybridized head loss: ~4x vs the eager op chain (r4)
+    loss_b = RCNNTrainLoss()
+    loss_b.hybridize()
     trainer = gluon.Trainer(net.collect_params(), "sgd",
                             {"learning_rate": 1e-3, "momentum": 0.9})
-    sce = gluon.loss.SoftmaxCrossEntropyLoss()
-    sce.hybridize()
     rs = np.random.RandomState(0)
     x = nd.array(rs.randn(batch, 3, size, size).astype(np.float32),
                  ctx=ctx)
@@ -302,13 +299,7 @@ def run_rcnn(batch=2, size=512, warmup=2, iters=10):
             (cls_pred, box_pred, rois, labels, targets, weights,
              rpn_cls, rpn_box) = net(x, im_info, gt_boxes=gt_boxes,
                                      batch_rois=128)
-            mask = labels >= 0
-            safe = nd.invoke("clip", labels, a_min=0.0, a_max=1e9)
-            cls_l = (sce(cls_pred, safe) * mask).mean()
-            box_l = nd.invoke("smooth_l1",
-                              (box_pred - targets) * weights,
-                              scalar=1.0).sum(axis=1).mean()
-            loss = cls_l + 0.1 * box_l
+            loss = loss_b(cls_pred, box_pred, labels, targets, weights)
             loss.backward()
         trainer.step(batch)
 

@@ -4,13 +4,16 @@ from .transformer import (BERTModel, TransformerEncoder, bert_base,
                           bert_small)
 from . import wide_deep as wide_deep_mod
 from .wide_deep import WideDeep, wide_deep
-from .ssd import SSD, ssd_300, ssd_512, ssd_toy, ssd_training_targets
+from .ssd import (SSD, ssd_300, ssd_512, ssd_toy,
+                  ssd_training_targets, SSDTrainLoss)
 from .seq2seq import Seq2Seq, gnmt_sym_gen
 from .faster_rcnn import (FasterRCNN, faster_rcnn_toy,
-                          rcnn_training_targets)
+                          rcnn_training_targets, RCNNTrainLoss)
 
 __all__ = ["transformer", "BERTModel", "TransformerEncoder", "bert_base",
            "bert_small", "WideDeep", "wide_deep", "SSD", "ssd_300",
-           "ssd_512", "ssd_toy", "ssd_training_targets", "Seq2Seq",
+           "ssd_512", "ssd_toy", "ssd_training_targets", "SSDTrainLoss",
+           "Seq2Seq",
            "FasterRCNN", "faster_rcnn_toy", "rcnn_training_targets",
+           "RCNNTrainLoss",
            "gnmt_sym_gen"]

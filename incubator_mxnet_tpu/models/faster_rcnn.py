@@ -15,7 +15,8 @@ from __future__ import annotations
 from ..gluon.block import HybridBlock
 from ..gluon import nn
 
-__all__ = ["FasterRCNN", "faster_rcnn_toy", "rcnn_training_targets"]
+__all__ = ["FasterRCNN", "faster_rcnn_toy", "rcnn_training_targets",
+           "RCNNTrainLoss"]
 
 
 def _conv_block(channels, stride=1):
@@ -140,3 +141,34 @@ def faster_rcnn_toy(classes=3, **kwargs):
                       anchor_scales=(2, 4), anchor_ratios=(0.5, 1, 2),
                       rpn_pre_nms_top_n=64, rpn_post_nms_top_n=16,
                       rpn_min_size=2, roi_size=3, top_units=32, **kwargs)
+
+
+class RCNNTrainLoss(HybridBlock):
+    """Hybridizable Faster-RCNN head loss (classification CE over
+    sampled ROIs + smooth-L1 on weighted box targets), so the training
+    forward's 8 outputs feed ONE fused loss program instead of a chain
+    of eager ops (PROFILE.md r4).
+
+    forward(cls_pred, box_pred, labels, bbox_targets, bbox_weights)
+    → scalar loss.  (Proposal/ProposalTarget already ran inside the
+    net's training forward.)
+    """
+
+    def __init__(self, box_weight=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._box_w = box_weight
+        from ..gluon.loss import SoftmaxCrossEntropyLoss
+        # child block: reuses the ONE fused-CE hot path (gluon/loss.py)
+        self._ce = SoftmaxCrossEntropyLoss()
+        self.register_child(self._ce, "ce")
+
+    def hybrid_forward(self, F, cls_pred, box_pred, labels, targets,
+                       weights):
+        # F.* throughout: must also trace with Symbol inputs (export)
+        mask = F._greater_equal_scalar(labels, scalar=0.0)
+        safe = F.clip(labels, a_min=0.0, a_max=1e9)
+        cls_l = F.mean(self._ce(cls_pred, safe) * mask)
+        box_l = F.mean(F.sum(
+            F.smooth_l1((box_pred - targets) * weights, scalar=1.0),
+            axis=1))
+        return cls_l + self._box_w * box_l

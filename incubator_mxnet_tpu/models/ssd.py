@@ -16,7 +16,8 @@ from __future__ import annotations
 from ..gluon.block import HybridBlock
 from ..gluon import nn
 
-__all__ = ["SSD", "ssd_300", "ssd_512", "ssd_toy", "ssd_training_targets"]
+__all__ = ["SSD", "ssd_300", "ssd_512", "ssd_toy",
+           "ssd_training_targets", "SSDTrainLoss"]
 
 
 def _down_block(channels):
@@ -101,3 +102,32 @@ def ssd_512(classes=20, **kwargs):
                sizes=((0.07, 0.1), (0.15, 0.222), (0.3, 0.367),
                       (0.45, 0.519), (0.6, 0.671)),
                ratios=((1, 2, 0.5),) * 5, **kwargs)
+
+
+class SSDTrainLoss(HybridBlock):
+    """Hybridizable SSD training loss: MultiBoxTarget + softmax-CE +
+    smooth-L1 in ONE cached-op block, so net(x) → loss(...) composes
+    into a single fused train-step executable (the eager target/loss
+    ops otherwise break whole-step fusion — PROFILE.md r4).
+
+    forward(anchors, cls_preds, box_preds, labels) → scalar loss.
+    """
+
+    def __init__(self, box_weight=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._box_w = box_weight
+        from ..gluon.loss import SoftmaxCrossEntropyLoss
+        # child block: reuses the ONE fused-CE hot path (gluon/loss.py)
+        # and traces inline, so fusion is preserved
+        self._ce = SoftmaxCrossEntropyLoss()
+        self.register_child(self._ce, "ce")
+
+    def hybrid_forward(self, F, anchors, cls_preds, box_preds, labels):
+        # F.* throughout: this block must also trace with Symbol inputs
+        # (export path); -3 merges (B, N) into one axis
+        loc_t, loc_m, cls_t = F.MultiBoxTarget(
+            anchors, labels, F.transpose(cls_preds, axes=(0, 2, 1)))
+        ce = F.mean(self._ce(F.reshape(cls_preds, (-3, 0)),
+                             F.reshape(cls_t, (-1,))))
+        box_l = F.mean(F.smooth_l1(box_preds - loc_t) * loc_m)
+        return ce + self._box_w * box_l

@@ -81,3 +81,56 @@ def test_ssd_detection_output():
     assert len(valid), "no detections survived NMS on random scores"
     assert (valid[:, 1] >= 0).all() and (valid[:, 1] <= 1).all()
     assert (valid[:, 2:] >= 0).all() and (valid[:, 2:] <= 1).all()
+
+
+def test_ssd_train_loss_block_matches_eager():
+    """SSDTrainLoss (the ONE-program train loss, r4) equals the eager
+    targets+CE+smooth-L1 composition, and fuses when hybridized."""
+    from incubator_mxnet_tpu.models import SSDTrainLoss
+    rs = np.random.RandomState(2)
+    net = ssd_toy(classes=3)
+    net.initialize()
+    x = nd.array(rs.randn(2, 3, 32, 32).astype(np.float32))
+    lab = np.zeros((2, 1, 5), np.float32)
+    lab[:, 0] = [1, .2, .2, .7, .7]
+    y = nd.array(lab)
+    anchors, cls_p, box_p = net(x)
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    loc_t, loc_m, cls_t = ssd_training_targets(anchors, cls_p, y)
+    B, N = cls_t.shape
+    ref = sce(cls_p.reshape((B * N, -1)),
+              cls_t.reshape((-1,))).mean() + \
+        (nd.smooth_l1(box_p - loc_t) * loc_m).mean()
+    lb = SSDTrainLoss()
+    lb.hybridize()
+    got = lb(anchors, cls_p, box_p, y)
+    np.testing.assert_allclose(got.asnumpy(), ref.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    # trains: loss decreases through the fused block
+    net2 = ssd_toy(classes=3)
+    net2.initialize()
+    net2.hybridize()
+    tr = gluon.Trainer(net2.collect_params(), "adam",
+                       {"learning_rate": 2e-3})
+    losses = []
+    for _ in range(6):
+        with ag.record():
+            a2, c2, b2 = net2(x)
+            l = lb(a2, c2, b2, y)
+            l.backward()
+        tr.step(2)
+        losses.append(float(l.asnumpy()))
+    assert losses[-1] < losses[0], losses
+
+
+def test_detection_loss_blocks_symbol_trace():
+    """Both train-loss blocks must trace with Symbol inputs (the
+    export path — review r4)."""
+    import incubator_mxnet_tpu.symbol as S
+    from incubator_mxnet_tpu.models import SSDTrainLoss, RCNNTrainLoss
+    out = SSDTrainLoss()(S.var("a"), S.var("c"), S.var("b"),
+                         S.var("l"))
+    assert out.tojson()
+    out2 = RCNNTrainLoss()(S.var("cp"), S.var("bp"), S.var("l"),
+                           S.var("t"), S.var("w"))
+    assert out2.tojson()

@@ -180,3 +180,29 @@ def test_proposal_target_gt_appended_guarantees_fg():
     assert (ln == 3).sum() == 1          # the gt box itself, class 2+1
     fg_row = int(onp.argmax(ln == 3))
     assert r.asnumpy()[fg_row, 1:].tolist() == [5, 5, 30, 30]
+
+
+def test_rcnn_train_loss_block_matches_eager():
+    """RCNNTrainLoss equals the eager mask/clip/CE/smooth-L1 chain and
+    trains through one fused program (r4)."""
+    from incubator_mxnet_tpu.models import RCNNTrainLoss
+    rs = onp.random.RandomState(3)
+    net = faster_rcnn_toy(classes=3)
+    net.initialize()
+    x = nd.array(rs.randn(1, 3, 64, 64).astype(onp.float32))
+    im_info = nd.array([[64, 64, 1.0]])
+    gt = nd.array(onp.array([[[4, 4, 40, 40, 1]]], onp.float32))
+    (cls_pred, box_pred, rois, labels, targets, weights,
+     rpn_cls, rpn_box) = net(x, im_info, gt_boxes=gt, batch_rois=8)
+
+    sce = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    mask = labels >= 0
+    safe = nd.invoke("clip", labels, a_min=0.0, a_max=1e9)
+    ref = (sce(cls_pred, safe) * mask).mean() + 0.1 * nd.invoke(
+        "smooth_l1", (box_pred - targets) * weights,
+        scalar=1.0).sum(axis=1).mean()
+    lb = RCNNTrainLoss()
+    lb.hybridize()
+    got = lb(cls_pred, box_pred, labels, targets, weights)
+    onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(),
+                                rtol=1e-5, atol=1e-6)
