@@ -353,6 +353,49 @@ def run_gnmt(batch=128, src_len=32, tgt_len=32, warmup=3, iters=40):
     return batch * tgt_len * iters / (time.perf_counter() - t0)
 
 
+def run_transformer_nmt(batch=64, src_len=32, tgt_len=32, warmup=2,
+                        iters=10):
+    """Config 4b: Transformer NMT (Sockeye transformer) training,
+    target tokens/sec — teacher-forced, causal flash self-attention."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, autograd as ag
+    from incubator_mxnet_tpu.models import TransformerNMT
+
+    ctx = mx.gpu()
+    vocab = 32000
+    net = TransformerNMT(vocab, vocab, units=512, hidden_size=2048,
+                         num_layers=6, num_heads=8, dropout=0.0)
+    net.initialize(ctx=ctx)
+    net.hybridize(static_alloc=True, static_shape=True)
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    sce.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-4})
+    rs = np.random.RandomState(0)
+    src = nd.array(rs.randint(0, vocab, (batch, src_len)), ctx=ctx,
+                   dtype="int32")
+    tgt = nd.array(rs.randint(0, vocab, (batch, tgt_len)), ctx=ctx,
+                   dtype="int32")
+    lab = nd.array(rs.randint(0, vocab, (batch, tgt_len)).astype(
+        np.float32), ctx=ctx)
+
+    def step():
+        with ag.record():
+            logits = net(src, tgt)
+            loss = sce(logits.reshape((-1, vocab)), lab.reshape((-1,)))
+            loss.backward()
+        trainer.step(batch)
+
+    for _ in range(warmup):
+        step()
+    _dependent_sync(net)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        step()
+    _dependent_sync(net)
+    return batch * tgt_len * iters / (time.perf_counter() - t0)
+
+
 def run_wide_deep(batch=2048, fields=16, warmup=3, iters=40):
     """Config 5: Wide&Deep recommender with row_sparse embedding grads,
     samples/sec."""
@@ -508,6 +551,9 @@ _CONFIGS = {
         "rcnn_train_images_per_sec", run_rcnn, (2, 1)),
     "gnmt": lambda: _cfg_simple(
         "gnmt_train_tokens_per_sec", run_gnmt, (128, 32)),
+    "transformer_nmt": lambda: _cfg_simple(
+        "transformer_nmt_train_tokens_per_sec", run_transformer_nmt,
+        (64, 32)),
     "wide_deep": lambda: _cfg_simple(
         "wide_deep_train_samples_per_sec", run_wide_deep, (2048, 512)),
     "io": lambda: {"io_pipeline_images_per_sec": round(run_io(), 1),
@@ -564,7 +610,8 @@ def main():
 
     extra = {}
     times = {}
-    required = ("resnet", "bert", "ssd512", "rcnn", "gnmt", "wide_deep")
+    required = ("resnet", "bert", "ssd512", "rcnn", "gnmt",
+                "transformer_nmt", "wide_deep")
     optional = ("io", "sharded")
 
     for name in required + optional:

@@ -1,7 +1,8 @@
 """Model families beyond the Gluon model zoo (transformer/BERT etc.)."""
 from . import transformer
 from .transformer import (BERTModel, TransformerEncoder, bert_base,
-                          bert_small)
+                          bert_small, TransformerNMT,
+                          transformer_nmt_base, transformer_nmt_small)
 from . import wide_deep as wide_deep_mod
 from .wide_deep import WideDeep, wide_deep
 from .ssd import (SSD, ssd_300, ssd_512, ssd_toy,
@@ -11,6 +12,8 @@ from .faster_rcnn import (FasterRCNN, faster_rcnn_toy,
                           rcnn_training_targets, RCNNTrainLoss)
 
 __all__ = ["transformer", "BERTModel", "TransformerEncoder", "bert_base",
+           "TransformerNMT", "transformer_nmt_base",
+           "transformer_nmt_small",
            "bert_small", "WideDeep", "wide_deep", "SSD", "ssd_300",
            "ssd_512", "ssd_toy", "ssd_training_targets", "SSDTrainLoss",
            "Seq2Seq",

@@ -160,7 +160,6 @@ class RCNNTrainLoss(HybridBlock):
         from ..gluon.loss import SoftmaxCrossEntropyLoss
         # child block: reuses the ONE fused-CE hot path (gluon/loss.py)
         self._ce = SoftmaxCrossEntropyLoss()
-        self.register_child(self._ce, "ce")
 
     def hybrid_forward(self, F, cls_pred, box_pred, labels, targets,
                        weights):

@@ -120,7 +120,6 @@ class SSDTrainLoss(HybridBlock):
         # child block: reuses the ONE fused-CE hot path (gluon/loss.py)
         # and traces inline, so fusion is preserved
         self._ce = SoftmaxCrossEntropyLoss()
-        self.register_child(self._ce, "ce")
 
     def hybrid_forward(self, F, anchors, cls_preds, box_preds, labels):
         # F.* throughout: this block must also trace with Symbol inputs

@@ -16,8 +16,11 @@ import math
 from ..gluon.block import HybridBlock
 from ..gluon import nn
 
-__all__ = ["MultiHeadAttention", "PositionwiseFFN",
-           "TransformerEncoderLayer", "TransformerEncoder", "BERTModel",
+__all__ = ["MultiHeadAttention", "CrossAttention", "PositionwiseFFN",
+           "TransformerEncoderLayer", "TransformerEncoder",
+           "TransformerDecoderLayer", "TransformerDecoder",
+           "TransformerNMT", "transformer_nmt_base",
+           "transformer_nmt_small", "BERTModel",
            "bert_base", "bert_small"]
 
 
@@ -131,24 +134,21 @@ class MultiHeadAttention(HybridBlock):
             ctx = F._contrib_flash_attention(
                 self.query(x), self.key(x), self.value(x), num_heads=H)
             return self.proj(ctx)
-        B, T, C = x.shape
-        d = C // H
-        q = self.query(x).reshape((B, T, H, d)).transpose((0, 2, 1, 3))
-        k = self.key(x).reshape((B, T, H, d)).transpose((0, 2, 1, 3))
-        v = self.value(x).reshape((B, T, H, d)).transpose((0, 2, 1, 3))
-        scores = F.batch_dot(q.reshape((B * H, T, d)),
-                             k.reshape((B * H, T, d)),
-                             transpose_b=True) / math.sqrt(d)
-        if mask is not None:
-            scores = scores.reshape((B, H, T, T)) + mask
-            scores = scores.reshape((B * H, T, T))
-        attn = F.softmax(scores, axis=-1)
-        if self.dropout is not None:
-            attn = self.dropout(attn)
-        ctx = F.batch_dot(attn, v.reshape((B * H, T, d)))
-        ctx = ctx.reshape((B, H, T, d)).transpose((0, 2, 1, 3)) \
-            .reshape((B, T, C))
-        return self.proj(ctx)
+        q = _split_heads(F, self.query(x), H)
+        k = _split_heads(F, self.key(x), H)
+        v = _split_heads(F, self.value(x), H)
+        scale = 1.0 / math.sqrt(self._units // H)
+        if mask is None:
+            ctx = _scaled_dot_attention(F, q, k, v, scale, self.dropout)
+        else:
+            scores = F.batch_dot(q, k, transpose_b=True) * scale
+            # additive mask broadcasts over (B, H, T, T)
+            scores = F.reshape(scores, (-4, -1, H, 0, 0)) + mask
+            attn = F.reshape(F.softmax(scores, axis=-1), (-3, 0, 0))
+            if self.dropout is not None:
+                attn = self.dropout(attn)
+            ctx = F.batch_dot(attn, v)
+        return self.proj(_merge_heads(F, ctx, H))
 
 
 class PositionwiseFFN(HybridBlock):
@@ -225,8 +225,8 @@ class BERTModel(HybridBlock):
 
     def forward(self, tokens):
         from .. import ndarray as F
-        B, T = tokens.shape
-        pos = F.arange_like(tokens.slice_axis(0, 0, 1).reshape((-1,)))
+        pos = F.arange_like(F.reshape(
+            F.slice_axis(tokens, axis=0, begin=0, end=1), (-1,)))
         x = self.word_embed(tokens) + self.pos_embed(pos)
         x = self.ln(x)
         if self.dropout is not None:
@@ -249,3 +249,189 @@ def bert_small(vocab_size=1000, **kwargs):
     kwargs.setdefault("num_heads", 4)
     kwargs.setdefault("max_length", 128)
     return BERTModel(vocab_size=vocab_size, **kwargs)
+
+
+def _split_heads(F, t, num_heads):
+    """(B, T, C) → (B·H, T, d), shape-free F.* form (reshape codes
+    only — keeps every attention block symbol-traceable)."""
+    t = F.reshape(t, (0, 0, num_heads, -1))
+    t = F.transpose(t, axes=(0, 2, 1, 3))
+    return F.reshape(t, (-3, 0, 0))
+
+
+def _merge_heads(F, t, num_heads):
+    """(B·H, T, d) → (B, T, C), shape-free F.* form."""
+    t = F.reshape(t, (-4, -1, num_heads, 0, 0))
+    t = F.transpose(t, axes=(0, 2, 1, 3))
+    return F.reshape(t, (0, 0, -3))
+
+
+def _scaled_dot_attention(F, q, k, v, scale, dropout=None):
+    """The ONE unfused attention body shared by MultiHeadAttention's
+    fallback and CrossAttention: softmax(q kᵀ · scale) v."""
+    scores = F.batch_dot(q, k, transpose_b=True) * scale
+    attn = F.softmax(scores, axis=-1)
+    if dropout is not None:
+        attn = dropout(attn)
+    return F.batch_dot(attn, v)
+
+
+class CrossAttention(HybridBlock):
+    """Encoder-decoder attention: queries from the decoder stream,
+    keys/values from the encoder memory (ref: Sockeye transformer
+    decoder's source attention; the contrib
+    interleaved_matmul_encdec_* ops are the reference's fused form).
+    Shape-free throughout — symbol-traceable."""
+
+    def __init__(self, units, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        assert units % num_heads == 0
+        self._units = units
+        self._num_heads = num_heads
+        self._scale = 1.0 / math.sqrt(units // num_heads)
+        self.query = nn.Dense(units, flatten=False, use_bias=True)
+        self.key = nn.Dense(units, flatten=False, use_bias=True)
+        self.value = nn.Dense(units, flatten=False, use_bias=True)
+        self.proj = nn.Dense(units, flatten=False, use_bias=True)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x, memory):
+        from .. import ndarray as F
+        H = self._num_heads
+        q = _split_heads(F, self.query(x), H)
+        k = _split_heads(F, self.key(memory), H)
+        v = _split_heads(F, self.value(memory), H)
+        ctx = _scaled_dot_attention(F, q, k, v, self._scale,
+                                    self.dropout)
+        return self.proj(_merge_heads(F, ctx, H))
+
+
+class _CausalSelfAttention(MultiHeadAttention):
+    """Decoder self-attention: the fused flash kernel runs with
+    causal=True — no (T, T) mask tensor is ever built.  Attention-prob
+    dropout is NOT applied on this fused path (same contract as the
+    seq_parallel ring path; residual/FFN dropout still applies) — a
+    construction-time warning says so."""
+
+    def __init__(self, units, num_heads, dropout=0.0, **kwargs):
+        super().__init__(units, num_heads, dropout, **kwargs)
+        if dropout:
+            import warnings
+            warnings.warn(
+                "_CausalSelfAttention: attention-prob dropout is not "
+                "applied on the fused causal path (residual/FFN "
+                "dropout still applies)")
+
+    def forward(self, x, mask=None):
+        from .. import ndarray as F
+        if mask is not None:
+            raise ValueError("_CausalSelfAttention builds its causal "
+                             "mask inside the fused kernel; mask= is "
+                             "not supported")
+        ctx = F._contrib_flash_attention(
+            self.query(x), self.key(x), self.value(x),
+            num_heads=self._num_heads, causal=True)
+        return self.proj(ctx)
+
+
+class TransformerDecoderLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.self_attn = _CausalSelfAttention(units, num_heads, dropout)
+        self.cross_attn = CrossAttention(units, num_heads, dropout)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout)
+        self.ln1 = nn.LayerNorm(in_channels=units)
+        self.ln2 = nn.LayerNorm(in_channels=units)
+        self.ln3 = nn.LayerNorm(in_channels=units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x, memory):
+        h = self.self_attn(x)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        x = self.ln1(x + h)
+        h = self.cross_attn(x, memory)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        x = self.ln2(x + h)
+        h = self.ffn(x)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return self.ln3(x + h)
+
+
+class TransformerDecoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads,
+                 dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.layers = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.layers.add(TransformerDecoderLayer(
+                units, hidden_size, num_heads, dropout))
+
+    def forward(self, x, memory):
+        for layer in self.layers._children.values():
+            x = layer(x, memory)
+        return x
+
+
+class TransformerNMT(HybridBlock):
+    """Encoder-decoder Transformer for NMT (BASELINE config 4's second
+    half — ref: Sockeye's transformer model over the reference's
+    contrib interleaved_matmul_* fused attention ops).
+
+    forward(src_tokens, tgt_tokens) → (B, T_tgt, tgt_vocab) logits,
+    teacher-forced: tgt is the decoder input (shifted target), causal
+    self-attention via the Pallas flash kernel."""
+
+    def __init__(self, src_vocab, tgt_vocab, units=512, hidden_size=2048,
+                 num_layers=6, num_heads=8, max_length=1024,
+                 dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self.src_embed = nn.Embedding(src_vocab, units)
+        self.tgt_embed = nn.Embedding(tgt_vocab, units)
+        self.pos_embed = nn.Embedding(max_length, units)
+        self.enc_ln = nn.LayerNorm(in_channels=units)
+        self.dec_ln = nn.LayerNorm(in_channels=units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+        self.encoder = TransformerEncoder(num_layers, units, hidden_size,
+                                          num_heads, dropout)
+        self.decoder = TransformerDecoder(num_layers, units, hidden_size,
+                                          num_heads, dropout)
+        self.out_proj = nn.Dense(tgt_vocab, flatten=False)
+
+    def _embed(self, embed, ln, tokens):
+        from .. import ndarray as F
+        # F.* form: symbol-traceable (export path)
+        pos = F.arange_like(F.reshape(
+            F.slice_axis(tokens, axis=0, begin=0, end=1), (-1,)))
+        x = embed(tokens) * math.sqrt(self._units) + self.pos_embed(pos)
+        x = ln(x)
+        if self.dropout is not None:
+            x = self.dropout(x)
+        return x
+
+    def forward(self, src, tgt):
+        memory = self.encoder(self._embed(self.src_embed, self.enc_ln,
+                                          src))
+        h = self.decoder(self._embed(self.tgt_embed, self.dec_ln, tgt),
+                         memory)
+        return self.out_proj(h)
+
+
+def transformer_nmt_base(src_vocab, tgt_vocab, **kwargs):
+    """Sockeye/"base" geometry: 6+6 layers, 512 units, 8 heads."""
+    return TransformerNMT(src_vocab, tgt_vocab, units=512,
+                          hidden_size=2048, num_layers=6, num_heads=8,
+                          **kwargs)
+
+
+def transformer_nmt_small(src_vocab, tgt_vocab, **kwargs):
+    kwargs.setdefault("units", 64)
+    kwargs.setdefault("hidden_size", 128)
+    kwargs.setdefault("num_layers", 2)
+    kwargs.setdefault("num_heads", 4)
+    kwargs.setdefault("max_length", 128)
+    return TransformerNMT(src_vocab, tgt_vocab, **kwargs)

@@ -194,3 +194,23 @@ def test_mha_block_uses_fused_path(monkeypatch):
     out = blk(x)
     assert out.shape == (2, 16, 32)
     assert calls, "MultiHeadAttention did not dispatch the fused op"
+
+
+def test_mha_mask_branch_matches_fused():
+    """The masked (unfused) attention branch — refactored onto the
+    shape-free head helpers (r4) — equals the fused path when the mask
+    is all-zeros, and actually masks when it is -inf-like."""
+    from incubator_mxnet_tpu.models import transformer
+    rs = np.random.RandomState(5)
+    blk = transformer.MultiHeadAttention(32, 4, dropout=0.0)
+    blk.initialize()
+    x = nd.array(rs.randn(2, 8, 32).astype(np.float32))
+    fused = blk(x).asnumpy()
+    zero_mask = nd.array(np.zeros((1, 1, 8, 8), np.float32))
+    masked = blk(x, zero_mask).asnumpy()
+    np.testing.assert_allclose(masked, fused, rtol=1e-4, atol=1e-5)
+    # causal -inf mask: position 0 must only attend to itself →
+    # different from the unmasked result at later positions
+    causal = np.triu(np.full((8, 8), -1e9, np.float32), k=1)
+    out_c = blk(x, nd.array(causal[None, None])).asnumpy()
+    assert np.abs(out_c - fused).max() > 1e-3
