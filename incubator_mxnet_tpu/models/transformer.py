@@ -212,6 +212,7 @@ class BERTModel(HybridBlock):
                  dropout=0.1, seq_parallel=None, **kwargs):
         super().__init__(**kwargs)
         self._units = units
+        self._max_length = max_length
         self.word_embed = nn.Embedding(vocab_size, units)
         self.pos_embed = nn.Embedding(max_length, units)
         self.ln = nn.LayerNorm(in_channels=units)
@@ -225,8 +226,8 @@ class BERTModel(HybridBlock):
 
     def forward(self, tokens):
         from .. import ndarray as F
-        pos = F.arange_like(F.reshape(
-            F.slice_axis(tokens, axis=0, begin=0, end=1), (-1,)))
+        _check_max_length(tokens, self._max_length, "BERT")
+        pos = _position_ids(F, tokens)
         x = self.word_embed(tokens) + self.pos_embed(pos)
         x = self.ln(x)
         if self.dropout is not None:
@@ -249,6 +250,23 @@ def bert_small(vocab_size=1000, **kwargs):
     kwargs.setdefault("num_heads", 4)
     kwargs.setdefault("max_length", 128)
     return BERTModel(vocab_size=vocab_size, **kwargs)
+
+
+def _position_ids(F, tokens):
+    """(B, T) tokens → (T,) position indices, symbol-traceable."""
+    return F.arange_like(F.reshape(
+        F.slice_axis(tokens, axis=0, begin=0, end=1), (-1,)))
+
+
+def _check_max_length(tokens, max_length, where):
+    """Fail fast when a sequence exceeds the positional table —
+    the embedding op's gather otherwise CLAMPS silently (jnp.take
+    semantics) and reuses the last position vector."""
+    from ..symbol.symbol import Symbol as _Sym
+    if not isinstance(tokens, _Sym) and tokens.shape[1] > max_length:
+        raise ValueError(
+            "%s sequence length %d exceeds max_length=%d (positional "
+            "embedding table)" % (where, tokens.shape[1], max_length))
 
 
 def _split_heads(F, t, num_heads):
@@ -295,14 +313,24 @@ class CrossAttention(HybridBlock):
         self.proj = nn.Dense(units, flatten=False, use_bias=True)
         self.dropout = nn.Dropout(dropout) if dropout else None
 
-    def forward(self, x, memory):
+    def forward(self, x, memory, mem_mask=None):
+        """mem_mask: optional additive mask (B, 1, 1, T_mem) — 0 keep,
+        large-negative for source padding."""
         from .. import ndarray as F
         H = self._num_heads
         q = _split_heads(F, self.query(x), H)
         k = _split_heads(F, self.key(memory), H)
         v = _split_heads(F, self.value(memory), H)
-        ctx = _scaled_dot_attention(F, q, k, v, self._scale,
-                                    self.dropout)
+        if mem_mask is None:
+            ctx = _scaled_dot_attention(F, q, k, v, self._scale,
+                                        self.dropout)
+        else:
+            scores = F.batch_dot(q, k, transpose_b=True) * self._scale
+            scores = F.reshape(scores, (-4, -1, H, 0, 0)) + mem_mask
+            attn = F.reshape(F.softmax(scores, axis=-1), (-3, 0, 0))
+            if self.dropout is not None:
+                attn = self.dropout(attn)
+            ctx = F.batch_dot(attn, v)
         return self.proj(_merge_heads(F, ctx, H))
 
 
@@ -346,12 +374,12 @@ class TransformerDecoderLayer(HybridBlock):
         self.ln3 = nn.LayerNorm(in_channels=units)
         self.dropout = nn.Dropout(dropout) if dropout else None
 
-    def forward(self, x, memory):
+    def forward(self, x, memory, mem_mask=None):
         h = self.self_attn(x)
         if self.dropout is not None:
             h = self.dropout(h)
         x = self.ln1(x + h)
-        h = self.cross_attn(x, memory)
+        h = self.cross_attn(x, memory, mem_mask)
         if self.dropout is not None:
             h = self.dropout(h)
         x = self.ln2(x + h)
@@ -370,9 +398,9 @@ class TransformerDecoder(HybridBlock):
             self.layers.add(TransformerDecoderLayer(
                 units, hidden_size, num_heads, dropout))
 
-    def forward(self, x, memory):
+    def forward(self, x, memory, mem_mask=None):
         for layer in self.layers._children.values():
-            x = layer(x, memory)
+            x = layer(x, memory, mem_mask)
         return x
 
 
@@ -390,6 +418,7 @@ class TransformerNMT(HybridBlock):
                  dropout=0.1, **kwargs):
         super().__init__(**kwargs)
         self._units = units
+        self._max_length = max_length
         self.src_embed = nn.Embedding(src_vocab, units)
         self.tgt_embed = nn.Embedding(tgt_vocab, units)
         self.pos_embed = nn.Embedding(max_length, units)
@@ -404,20 +433,34 @@ class TransformerNMT(HybridBlock):
 
     def _embed(self, embed, ln, tokens):
         from .. import ndarray as F
-        # F.* form: symbol-traceable (export path)
-        pos = F.arange_like(F.reshape(
-            F.slice_axis(tokens, axis=0, begin=0, end=1), (-1,)))
-        x = embed(tokens) * math.sqrt(self._units) + self.pos_embed(pos)
+        _check_max_length(tokens, self._max_length, "NMT")
+        x = embed(tokens) * math.sqrt(self._units) + \
+            self.pos_embed(_position_ids(F, tokens))
         x = ln(x)
         if self.dropout is not None:
             x = self.dropout(x)
         return x
 
-    def forward(self, src, tgt):
+    def forward(self, src, tgt, src_valid_length=None):
+        """src_valid_length: optional (B,) source lengths — padding
+        positions are masked out of the cross-attention (two identical
+        sentences padded to different lengths produce identical
+        logits)."""
+        from .. import ndarray as F
+        mem_mask = None
+        if src_valid_length is not None:
+            steps = F.reshape(_position_ids(F, src), (1, -1))  # (1, Ts)
+            keep = F.broadcast_lesser(
+                steps, F.reshape(src_valid_length, (-1, 1)))   # (B, Ts)
+            mem_mask = F.expand_dims(F.expand_dims(
+                (keep - 1.0) * 1e9, axis=1), axis=1)  # (B,1,1,Ts)
+        # the SAME additive mask keeps pads out of the encoder's
+        # self-attention (valid rows must not depend on pad content)
+        # and out of the decoder's cross-attention
         memory = self.encoder(self._embed(self.src_embed, self.enc_ln,
-                                          src))
+                                          src), mask=mem_mask)
         h = self.decoder(self._embed(self.tgt_embed, self.dec_ln, tgt),
-                         memory)
+                         memory, mem_mask)
         return self.out_proj(h)
 
 

@@ -258,3 +258,50 @@ def test_transformer_nmt_symbol_traceable():
     net.initialize()
     out = net(S.var("src"), S.var("tgt"))
     assert out.tojson()
+
+
+def test_transformer_nmt_source_padding_invariance():
+    """With src_valid_length, PAD rows are masked out of the
+    cross-attention: the same sentence padded to different lengths
+    yields identical logits (review r4)."""
+    import warnings
+    from incubator_mxnet_tpu.models import transformer_nmt_small
+    rs = np.random.RandomState(9)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        net = transformer_nmt_small(src_vocab=30, tgt_vocab=30,
+                                    dropout=0.0)
+    net.initialize()
+    sent = rs.randint(2, 30, (1, 5)).astype(np.int32)
+    tgt = nd.array(rs.randint(2, 30, (1, 6)).astype(np.int32),
+                   dtype="int32")
+    vlen = nd.array(np.array([5], np.float32))
+
+    def run(pad_to):
+        src = np.zeros((1, pad_to), np.int32)
+        src[:, :5] = sent
+        return net(nd.array(src, dtype="int32"), tgt,
+                   src_valid_length=vlen).asnumpy()
+
+    np.testing.assert_allclose(run(8), run(12), rtol=1e-4, atol=1e-4)
+    # and WITHOUT the mask the padding leaks (the gap being guarded)
+    def run_nomask(pad_to):
+        src = np.zeros((1, pad_to), np.int32)
+        src[:, :5] = sent
+        return net(nd.array(src, dtype="int32"), tgt).asnumpy()
+    assert np.abs(run_nomask(8) - run_nomask(12)).max() > 1e-4
+
+
+def test_transformer_nmt_max_length_guard():
+    import warnings
+    from incubator_mxnet_tpu.models import transformer_nmt_small
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        net = transformer_nmt_small(src_vocab=20, tgt_vocab=20,
+                                    max_length=16)
+    net.initialize()
+    import pytest as _pytest
+    src = nd.array(np.zeros((1, 32), np.int32), dtype="int32")
+    tgt = nd.array(np.zeros((1, 8), np.int32), dtype="int32")
+    with _pytest.raises(ValueError, match="max_length"):
+        net(src, tgt)
