@@ -186,18 +186,24 @@ def run_bert(batch=16, seq=512, warmup=2, iters=10):
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, gluon, autograd as ag
     from incubator_mxnet_tpu import config as _cfg
-    from incubator_mxnet_tpu.models.transformer import bert_base
+    from incubator_mxnet_tpu.models.transformer import (bert_base,
+                                                        FusedMLMCELoss)
 
     _cfg.set("MXNET_USE_PALLAS", "2")
     ctx = mx.gpu()
-    net = bert_base(dropout=0.0)
+    # output_hidden + FusedMLMCELoss: the vocab projection is fused
+    # into a chunked CE (the (B·T, 30522) logits never materialise) —
+    # this is what moves the fitting batch past 16 (r4)
+    net = bert_base(dropout=0.0, output_hidden=True)
     net.initialize(ctx=ctx)
     net.cast("bfloat16")
     net.hybridize(static_alloc=True, static_shape=True)
-    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
-    loss_fn.hybridize()
-    trainer = gluon.Trainer(net.collect_params(), "adam",
-                            {"learning_rate": 1e-4})
+    loss_b = FusedMLMCELoss(30522, 768)
+    loss_b.initialize(ctx=ctx)
+    loss_b.cast("bfloat16")
+    loss_b.hybridize()
+    all_params = {**net.collect_params(), **loss_b.collect_params()}
+    trainer = gluon.Trainer(all_params, "adam", {"learning_rate": 1e-4})
     rs = np.random.RandomState(0)
     tokens = nd.array(rs.randint(0, 30522, (batch, seq)).astype(np.int32),
                       ctx=ctx, dtype="int32")
@@ -206,9 +212,8 @@ def run_bert(batch=16, seq=512, warmup=2, iters=10):
 
     def step():
         with ag.record():
-            logits = net(tokens)
-            l = loss_fn(logits.reshape((batch * seq, -1)),
-                        labels.reshape((-1,)))
+            h = net(tokens)
+            l = loss_b(h, labels)
             l.backward()
         trainer.step(batch)
 
@@ -543,27 +548,36 @@ def _try_batches(fn, batches, **kw):
 # ---------------------------------------------------------------------------
 
 _CONFIGS = {
-    "resnet": lambda: _cfg_resnet(),
-    "bert": lambda: _cfg_simple(
-        "bert_base_tokens_per_sec_per_chip", run_bert, (16, 8),
+    "resnet": lambda b=None: _cfg_resnet(),
+    # bert's batch fallback is driven by main() ACROSS subprocesses:
+    # an OOM wedges the remote allocator for the whole process (see
+    # driver comment below), so in-process retry at a smaller batch
+    # cannot work — each batch attempt must be its own process
+    "bert": lambda b=None: _cfg_simple(
+        "bert_base_tokens_per_sec_per_chip", run_bert,
+        (int(b),) if b else (16,),
         const={"bert_seq": 512}, batch_key="bert_batch"),
-    "ssd512": lambda: _cfg_simple(
+    "ssd512": lambda b=None: _cfg_simple(
         "ssd512_train_images_per_sec", run_ssd, (8, 4)),
-    "rcnn": lambda: _cfg_simple(
+    "rcnn": lambda b=None: _cfg_simple(
         "rcnn_train_images_per_sec", run_rcnn, (2, 1)),
-    "gnmt": lambda: _cfg_simple(
+    "gnmt": lambda b=None: _cfg_simple(
         "gnmt_train_tokens_per_sec", run_gnmt, (128, 32)),
-    "transformer_nmt": lambda: _cfg_simple(
+    "transformer_nmt": lambda b=None: _cfg_simple(
         "transformer_nmt_train_tokens_per_sec", run_transformer_nmt,
         (64, 32)),
-    "wide_deep": lambda: _cfg_simple(
+    "wide_deep": lambda b=None: _cfg_simple(
         "wide_deep_train_samples_per_sec", run_wide_deep, (2048, 512)),
-    "io": lambda: {"io_pipeline_images_per_sec": round(run_io(), 1),
-                   "io_host_cores": os.cpu_count()},
-    "sharded": lambda: _cfg_simple(
+    "io": lambda b=None: {"io_pipeline_images_per_sec": round(run_io(), 1),
+                          "io_host_cores": os.cpu_count()},
+    "sharded": lambda b=None: _cfg_simple(
         "sharded_trainer_value", run_sharded, (256, 128, 64),
         batch_key="sharded_trainer_batch"),
 }
+
+# batch ladders main() walks one-subprocess-per-attempt (first success
+# wins); configs not listed use their in-process ladders above
+_SUBPROC_BATCHES = {"bert": (32, 16, 8)}
 
 
 def _cfg_resnet():
@@ -581,9 +595,11 @@ def _cfg_simple(key, fn, batches, const=None, batch_key=None):
     return out
 
 
-def _run_config_subprocess(name, timeout_s):
+def _run_config_subprocess(name, timeout_s, batch=None):
     import subprocess
     cmd = [sys.executable, os.path.abspath(__file__), "--config", name]
+    if batch is not None:
+        cmd.append(str(batch))
     try:
         res = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=timeout_s,
@@ -625,7 +641,15 @@ def main():
         # long; the subprocess hard-timeout keeps the total bounded
         cap = max(remaining, 150 if name in required else 30)
         t0 = time.perf_counter()
-        extra.update(_run_config_subprocess(name, cap))
+        if name in _SUBPROC_BATCHES:
+            # one subprocess per batch attempt (OOM wedges a process)
+            for b in _SUBPROC_BATCHES[name]:
+                res = _run_config_subprocess(name, cap, batch=b)
+                if not any(k.endswith("_error") for k in res):
+                    break
+            extra.update(res)
+        else:
+            extra.update(_run_config_subprocess(name, cap))
         times[name] = round(time.perf_counter() - t0, 1)
 
     headline = extra.pop("value", 0.0)
@@ -647,8 +671,9 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--config":
         name = sys.argv[2]
+        batch = sys.argv[3] if len(sys.argv) >= 4 else None
         try:
-            print(json.dumps(_CONFIGS[name]()))
+            print(json.dumps(_CONFIGS[name](batch)))
             sys.exit(0)
         except Exception as e:
             print(json.dumps({name + "_error": str(e)[:160]}))

@@ -1,0 +1,127 @@
+/* Flat C ABI over the TPU-native runtime.
+ *
+ * Re-design of ref: include/mxnet/c_api.h (the reference's ONLY public
+ * native interface, ~300 MX* functions over handles).  Same contract,
+ * TPU-native realisation: handles are opaque references into the
+ * embedded runtime (the Python package IS the runtime orchestrator
+ * here — XLA/PJRT executes the math), every call returns 0/-1 with the
+ * error text retrievable per-thread via MXGetLastError (ref:
+ * src/c_api/c_api_error.cc), and output arrays are owned by
+ * thread-local return stores exactly like the reference's
+ * MXAPIThreadLocalEntry.
+ *
+ * This is the surface that makes non-Python bindings cheap (SURVEY
+ * §2.6): see include/mxnet_tpu/ndarray.hpp for the header-only C++
+ * front-end built on it (ref: cpp-package/), and
+ * tests/python/unittest/test_c_api.py for a compiled C++ client
+ * exercising create → invoke → copy-out → save/load with no Python in
+ * the client code.
+ *
+ * Build (mirrors src/io/recordio_pipeline.cc):
+ *   g++ -O2 -shared -fPIC src/c_api/c_api.cc \
+ *       $(python3-config --includes) -lpython3.12 \
+ *       -o src/c_api/libmxtpu_c.so
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+
+/* dtype codes: ref mshadow/base.h TypeFlag (kFloat32..kBfloat16). */
+enum MXDType {
+  kMXFloat32 = 0,
+  kMXFloat64 = 1,
+  kMXFloat16 = 2,
+  kMXUint8 = 3,
+  kMXInt32 = 4,
+  kMXInt8 = 5,
+  kMXInt64 = 6,
+  kMXBool = 7,
+  kMXInt16 = 8,
+  kMXUint16 = 9,
+  kMXUint32 = 10,
+  kMXUint64 = 11,
+  kMXBfloat16 = 12,
+};
+
+/* device codes: ref include/mxnet/base.h Context::DeviceType. */
+enum MXDeviceType {
+  kMXCPU = 1,
+  kMXGPU = 2, /* the accelerator (TPU chip on this backend) */
+  kMXCPUPinned = 3,
+};
+
+/* Last error message for the calling thread ("" if none). */
+const char *MXGetLastError(void);
+
+int MXGetVersion(int *out);
+
+/* Number of accelerator devices visible to the runtime. */
+int MXGetGPUCount(int *out);
+
+int MXRandomSeed(int seed);
+
+/* ---- NDArray ---------------------------------------------------- */
+
+int MXNDArrayCreate(const int64_t *shape, int ndim, int dtype,
+                    int dev_type, int dev_id, NDArrayHandle *out);
+int MXNDArrayFree(NDArrayHandle handle);
+
+/* size = element count of the host buffer; dtype must match. */
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size);
+
+/* Shape pointer stays valid until the next call on this handle. */
+int MXNDArrayGetShape(NDArrayHandle handle, int *out_dim,
+                      const int64_t **out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int *out);
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id);
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+int MXNDArrayWaitAll(void);
+
+/* ---- Imperative op invocation (ref: MXImperativeInvokeEx) -------- */
+
+/* Invoke a registered operator by name.  Scalar/tuple/bool parameters
+ * are passed as strings (dmlc-parameter style: "0.5", "(1, 2)",
+ * "True") and parsed by the runtime.  *num_outputs/*outputs are
+ * filled from a thread-local store valid until the next invoke on the
+ * calling thread; returned handles must be freed with MXNDArrayFree. */
+int MXImperativeInvoke(const char *op_name, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals);
+
+/* All registered operator names (thread-local store). */
+int MXListAllOpNames(int *out_size, const char ***out_array);
+
+/* ---- Serialization (ref: MXNDArraySave/Load, magic-framed) ------- */
+
+int MXNDArraySave(const char *fname, uint32_t num_args,
+                  NDArrayHandle *args, const char **keys);
+int MXNDArrayLoad(const char *fname, uint32_t *out_size,
+                  NDArrayHandle **out_arr, uint32_t *out_name_size,
+                  const char ***out_names);
+
+/* ---- Symbol (graph JSON interchange, ref: c_api_symbolic.cc) ----- */
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json);
+int MXSymbolGetName(SymbolHandle sym, const char **out);
+int MXSymbolFree(SymbolHandle handle);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* MXNET_TPU_C_API_H_ */

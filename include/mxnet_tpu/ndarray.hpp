@@ -1,0 +1,116 @@
+/* Header-only C++ front-end over the flat C ABI.
+ *
+ * Re-design of ref: cpp-package/include/mxnet-cpp/ (the reference's
+ * header-only C++ binding, generated over the C API).  Same shape:
+ * RAII handles + operator invocation by registry name; nothing here
+ * touches the runtime directly — every call goes through c_api.h,
+ * which is the point: this file is the proof that non-Python bindings
+ * stay cheap (SURVEY §2.6).
+ *
+ * Usage (see tests/python/unittest/test_c_api.py for a compiled run):
+ *   mxtpu::NDArray a({2, 3}, kMXFloat32);
+ *   a.CopyFrom(host_data);
+ *   mxtpu::NDArray c = mxtpu::Op("broadcast_add", {a, b});
+ *   c.CopyTo(out_data);
+ */
+#ifndef MXNET_TPU_NDARRAY_HPP_
+#define MXNET_TPU_NDARRAY_HPP_
+
+#include <cstdint>
+#include <initializer_list>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "c_api.h"
+
+namespace mxtpu {
+
+inline void Check(int rc) {
+  if (rc != 0) throw std::runtime_error(MXGetLastError());
+}
+
+class NDArray {
+ public:
+  NDArray() = default;
+  NDArray(const std::vector<int64_t> &shape, int dtype = kMXFloat32,
+          int dev_type = kMXCPU, int dev_id = 0) {
+    Check(MXNDArrayCreate(shape.data(), static_cast<int>(shape.size()),
+                          dtype, dev_type, dev_id, &handle_));
+  }
+  explicit NDArray(NDArrayHandle h) : handle_(h) {}
+  NDArray(const NDArray &) = delete;
+  NDArray &operator=(const NDArray &) = delete;
+  NDArray(NDArray &&o) noexcept : handle_(o.handle_) {
+    o.handle_ = nullptr;
+  }
+  NDArray &operator=(NDArray &&o) noexcept {
+    std::swap(handle_, o.handle_);
+    return *this;
+  }
+  ~NDArray() {
+    if (handle_ != nullptr) MXNDArrayFree(handle_);
+  }
+
+  NDArrayHandle handle() const { return handle_; }
+
+  std::vector<int64_t> Shape() const {
+    int ndim = 0;
+    const int64_t *data = nullptr;
+    Check(MXNDArrayGetShape(handle_, &ndim, &data));
+    return std::vector<int64_t>(data, data + ndim);
+  }
+  int DType() const {
+    int dt = 0;
+    Check(MXNDArrayGetDType(handle_, &dt));
+    return dt;
+  }
+  int64_t Size() const {
+    int64_t n = 1;
+    for (int64_t d : Shape()) n *= d;
+    return n;
+  }
+  template <typename T>
+  void CopyFrom(const std::vector<T> &src) {
+    Check(MXNDArraySyncCopyFromCPU(handle_, src.data(), src.size()));
+  }
+  template <typename T>
+  void CopyTo(std::vector<T> *dst) const {
+    dst->resize(static_cast<size_t>(Size()));
+    Check(MXNDArraySyncCopyToCPU(handle_, dst->data(), dst->size()));
+  }
+  void WaitToRead() const { Check(MXNDArrayWaitToRead(handle_)); }
+
+ private:
+  NDArrayHandle handle_ = nullptr;
+};
+
+/* Invoke a registered operator; returns its (first) output. */
+inline NDArray Op(const std::string &name,
+                  const std::vector<const NDArray *> &inputs,
+                  const std::map<std::string, std::string> &params = {}) {
+  std::vector<NDArrayHandle> in;
+  in.reserve(inputs.size());
+  for (const NDArray *a : inputs) in.push_back(a->handle());
+  std::vector<const char *> keys, vals;
+  for (const auto &kv : params) {
+    keys.push_back(kv.first.c_str());
+    vals.push_back(kv.second.c_str());
+  }
+  int n_out = 0;
+  NDArrayHandle *out = nullptr;
+  Check(MXImperativeInvoke(name.c_str(), static_cast<int>(in.size()),
+                           in.data(), &n_out, &out,
+                           static_cast<int>(keys.size()), keys.data(),
+                           vals.data()));
+  if (n_out < 1) throw std::runtime_error("op returned no outputs");
+  NDArray first(out[0]);
+  for (int i = 1; i < n_out; ++i) MXNDArrayFree(out[i]);
+  return first;
+}
+
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_NDARRAY_HPP_

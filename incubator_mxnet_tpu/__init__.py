@@ -12,6 +12,12 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+from . import config
+if config.get("MXNET_INT64_TENSOR_SIZE"):
+    # large-tensor build flag (ref: USE_INT64_TENSOR_SIZE): must flip
+    # before the first trace anywhere below
+    import jax as _jax
+    _jax.config.update("jax_enable_x64", True)
 from .base import MXNetError, MXTPUError, ensure_jax_distributed
 # distributed workers (DMLC_* env set) must join the coordination
 # service before the first XLA backend touch anywhere below

@@ -193,3 +193,10 @@ register("MXNET_ENFORCE_DETERMINISM", bool, False,
 register("MXNET_SAFE_ACCUMULATION", bool, True,
          "Accumulate norms/softmax in float32 when inputs are "
          "half-precision (always on in XLA lowerings here)")
+register("MXNET_INT64_TENSOR_SIZE", bool, False,
+         "Large-tensor support: enable 64-bit index arithmetic so "
+         "arrays past 2**31 elements index correctly (ref: the "
+         "USE_INT64_TENSOR_SIZE build flag). Honored at import time "
+         "only (flips jax_enable_x64 before any trace). Off by "
+         "default for the reference's reason: wider index math costs "
+         "speed/memory on every gather")

@@ -209,7 +209,8 @@ class BERTModel(HybridBlock):
 
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
-                 dropout=0.1, seq_parallel=None, **kwargs):
+                 dropout=0.1, seq_parallel=None, output_hidden=False,
+                 **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._max_length = max_length
@@ -222,7 +223,11 @@ class BERTModel(HybridBlock):
                                           seq_parallel=seq_parallel)
         self.mlm_dense = nn.Dense(units, flatten=False, activation=None)
         self.mlm_ln = nn.LayerNorm(in_channels=units)
-        self.decoder = nn.Dense(vocab_size, flatten=False)
+        # output_hidden: stop after the MLM transform and let
+        # FusedMLMCELoss own the vocab projection — the (B·T, vocab)
+        # logits then never materialise (see _fused_linear_softmax_ce)
+        self.decoder = None if output_hidden \
+            else nn.Dense(vocab_size, flatten=False)
 
     def forward(self, tokens):
         from .. import ndarray as F
@@ -235,7 +240,41 @@ class BERTModel(HybridBlock):
         x = self.encoder(x)
         h = F.LeakyReLU(self.mlm_dense(x), act_type="gelu")
         h = self.mlm_ln(h)
-        return self.decoder(h)
+        return h if self.decoder is None else self.decoder(h)
+
+
+class FusedMLMCELoss(HybridBlock):
+    """Vocab projection fused into the softmax-CE loss, chunked over
+    rows so the (B·T, vocab) logits never materialise (the LM-head
+    memory wall; ref: the reference fused SoftmaxOutput for the same
+    reason, one matmul earlier).  Owns the projection params — pair
+    with ``BERTModel(output_hidden=True)``.
+
+    forward(h, label): h (B, T, D) or (N, D); label (B, T) or (N,).
+    Returns per-row loss (N,).
+    """
+
+    def __init__(self, vocab_size, in_units, num_chunks=0,
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self._vocab = vocab_size
+        self._nchunk = num_chunks
+        self.weight = self.params.get(
+            "weight", shape=(vocab_size, in_units), dtype=dtype,
+            allow_deferred_init=True)
+        self.bias = self.params.get(
+            "bias", shape=(vocab_size,), dtype=dtype, init="zeros",
+            allow_deferred_init=True)
+
+    def hybrid_forward(self, F, h, label, weight, bias):
+        # (B, T, D) → (B·T, D): -3 merges the leading two dims, -2
+        # keeps the rest (ref reshape special codes).  Symbols carry no
+        # shape, so the symbolic trace assumes the 3-D (B, T, D) form;
+        # already-flat (N, D) arrays pass through on the ndarray path.
+        h2 = h if getattr(h, "ndim", 3) == 2 else F.reshape(h, (-3, -2))
+        l1 = F.reshape(label, (-1,))
+        return F._fused_linear_softmax_ce(h2, weight, bias, l1,
+                                          num_chunks=self._nchunk)
 
 
 def bert_base(vocab_size=30522, **kwargs):

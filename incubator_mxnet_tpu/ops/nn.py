@@ -425,6 +425,120 @@ def fused_softmax_ce(pred, label, axis=-1):
     return _softmax_ce_core(pred, label, ax)
 
 
+# --- chunked projection + CE: the (rows, vocab) logits never exist ---
+#
+# For LM/MLM heads the loss-side memory wall is the logits tensor
+# itself (BERT-base MLM at batch 32: 16384×30522 ≥ 1 GB per
+# materialisation, several live at once through autodiff).  This op
+# fuses the vocab projection INTO the loss and scans row-chunks:
+# forward keeps only per-row lse; backward recomputes each chunk's
+# logits and accumulates dW/db in f32.  Same reasoning as the
+# reference's fused SoftmaxOutput kernel, taken one matmul further.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _linear_ce_core(hidden, weight, bias, label, nchunk):
+    loss, _ = _linear_ce_fwd_impl(hidden, weight, bias, label, nchunk)
+    return loss
+
+
+def _linear_ce_chunk_logits(hc, weight, bias):
+    # bf16 MXU matmul with f32 accumulation
+    logits = jnp.dot(hc, weight.T,
+                     preferred_element_type=jnp.float32)
+    return logits + bias.astype(jnp.float32)
+
+
+def _linear_ce_fwd_impl(hidden, weight, bias, label, nchunk):
+    n, d = hidden.shape
+    c = n // nchunk
+    h3 = hidden.reshape(nchunk, c, d)
+    l2 = label.astype(jnp.int32).reshape(nchunk, c)
+
+    def body(_, hl):
+        hc, lc = hl
+        logits = _linear_ce_chunk_logits(hc, weight, bias)
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        lse = (m + jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1,
+                                   keepdims=True))).squeeze(-1)
+        picked = jnp.take_along_axis(logits, lc[:, None],
+                                     axis=-1).squeeze(-1)
+        return None, (lse - picked, lse)
+
+    _, (loss, lse) = lax.scan(body, None, (h3, l2))
+    return loss.reshape(n), (hidden, weight, bias, l2, lse)
+
+
+def _linear_ce_core_fwd(hidden, weight, bias, label, nchunk):
+    return _linear_ce_fwd_impl(hidden, weight, bias, label, nchunk)
+
+
+def _linear_ce_core_bwd(nchunk, res, dy):
+    hidden, weight, bias, l2, lse = res
+    n, d = hidden.shape
+    v = weight.shape[0]
+    c = n // nchunk
+    h3 = hidden.reshape(nchunk, c, d)
+    dy3 = dy.astype(jnp.float32).reshape(nchunk, c)
+
+    def body(carry, hl):
+        dw, db = carry
+        hc, lc, lsec, dyc = hl
+        logits = _linear_ce_chunk_logits(hc, weight, bias)
+        p = jnp.exp(logits - lsec[:, None])
+        onehot = jax.nn.one_hot(lc, v, dtype=jnp.float32)
+        dlogits = (p - onehot) * dyc[:, None]
+        dl16 = dlogits.astype(hidden.dtype)
+        dh = jnp.dot(dl16, weight,
+                     preferred_element_type=jnp.float32)
+        dw = dw + jnp.dot(dl16.T, hc,
+                          preferred_element_type=jnp.float32)
+        db = db + jnp.sum(dlogits, axis=0)
+        return (dw, db), dh.astype(hidden.dtype)
+
+    (dw, db), dh = lax.scan(
+        body, (jnp.zeros((v, d), jnp.float32), jnp.zeros((v,), jnp.float32)),
+        (h3, l2, lse, dy3))
+    return (dh.reshape(n, d), dw.astype(weight.dtype),
+            db.astype(bias.dtype), None)
+
+
+_linear_ce_core.defvjp(_linear_ce_core_fwd, _linear_ce_core_bwd)
+
+
+@register("_fused_linear_softmax_ce",
+          ndarray_inputs=("hidden", "weight", "bias", "label"),
+          nograd_argnums=(3,))
+def fused_linear_softmax_ce(hidden, weight, bias, label, num_chunks=0):
+    """Per-row -log softmax(hidden @ weight.T + bias)[label] without
+    materialising the (rows, vocab) logits.  hidden: (N, D); weight:
+    (V, D) (FullyConnected layout); bias: (V,); label: (N,) int.
+    num_chunks=0 picks the largest power-of-two chunking with chunks of
+    ~1024 rows; N must be divisible by the chunk count."""
+    n = hidden.shape[0]
+    nchunk = int(num_chunks)
+    if nchunk <= 0:
+        # largest chunk size in [256, 2048] that divides n — not just
+        # powers of two, so odd-but-composite row counts still chunk;
+        # a prime n degrades to one chunk (full logits) loudly
+        nchunk = 1
+        for chunk in range(min(n, 2048), 255, -1):
+            if n % chunk == 0:
+                nchunk = n // chunk
+                break
+        if nchunk == 1 and n > 4096:
+            import warnings
+            warnings.warn(
+                "_fused_linear_softmax_ce: %d rows have no divisor in "
+                "[256, 2048]; computing UNCHUNKED (full logits "
+                "materialise) — pass num_chunks explicitly" % n)
+    if n % nchunk != 0:
+        raise ValueError(
+            "_fused_linear_softmax_ce: %d rows not divisible into %d "
+            "chunks" % (n, nchunk))
+    return _linear_ce_core(hidden, weight, bias, label, nchunk)
+
+
 # --- SyncBatchNorm: cross-replica moments over a named mesh axis -----
 #
 # TPU-first note: under pjit/GSPMD (ShardedTrainer), a plain BatchNorm's

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as _np
 
+from ..base import dtype_np as _dtype_np
+
 from .registry import register, alias
 
 # ---------------------------------------------------------------------------
@@ -395,19 +397,21 @@ def norm(data, ord=2, axis=None, keepdims=False, out_dtype=None):
 
 
 @register("argmax", ndarray_inputs=("data",), differentiable=False)
-def argmax(data, axis=None, keepdims=False):
+def argmax(data, axis=None, keepdims=False, dtype="float32"):
+    # dtype param follows the reference's large-tensor pattern (topk/
+    # argsort grew one so positions past 2**24 survive the float cast)
     out = jnp.argmax(data, axis=axis)
     if keepdims and axis is not None:
         out = jnp.expand_dims(out, axis)
-    return out.astype(jnp.float32)   # MXNet returns float indices
+    return out.astype(_dtype_np(dtype))   # MXNet default: float indices
 
 
 @register("argmin", ndarray_inputs=("data",), differentiable=False)
-def argmin(data, axis=None, keepdims=False):
+def argmin(data, axis=None, keepdims=False, dtype="float32"):
     out = jnp.argmin(data, axis=axis)
     if keepdims and axis is not None:
         out = jnp.expand_dims(out, axis)
-    return out.astype(jnp.float32)
+    return out.astype(_dtype_np(dtype))
 
 
 @register("argmax_channel", ndarray_inputs=("data",), differentiable=False)
@@ -647,15 +651,23 @@ def space_to_depth(data, block_size=1):
 # ---------------------------------------------------------------------------
 
 
+def _idx(indices):
+    """Index dtype for gathers: int32 (TPU-native) unless the
+    large-tensor flag enabled 64-bit index math (MXNET_INT64_TENSOR_SIZE
+    ≙ ref USE_INT64_TENSOR_SIZE — positions past 2**31 would wrap)."""
+    return indices.astype(
+        jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+
+
 @register("take", ndarray_inputs=("a", "indices"), nograd_argnums=(1,))
 def take(a, indices, axis=0, mode="clip"):
     jmode = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
-    return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=jmode)
+    return jnp.take(a, _idx(indices), axis=axis, mode=jmode)
 
 
 @register("pick", ndarray_inputs=("data", "index"), nograd_argnums=(1,))
 def pick(data, index, axis=-1, keepdims=False, mode="clip"):
-    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    idx = jnp.clip(_idx(index), 0, data.shape[axis] - 1)
     out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
     if not keepdims:
         out = jnp.squeeze(out, axis=axis)
@@ -665,21 +677,21 @@ def pick(data, index, axis=-1, keepdims=False, mode="clip"):
 @register("gather_nd", ndarray_inputs=("data", "indices"), nograd_argnums=(1,))
 def gather_nd(data, indices):
     """ref: tensor/indexing_op.h GatherNDForward. indices shape (M, ...)"""
-    idx = tuple(indices.astype(jnp.int32))
+    idx = tuple(_idx(indices))
     return data[idx]
 
 
 @register("scatter_nd", ndarray_inputs=("data", "indices"), nograd_argnums=(1,))
 def scatter_nd(data, indices, shape=()):
     out = jnp.zeros(tuple(shape), dtype=data.dtype)
-    idx = tuple(indices.astype(jnp.int32))
+    idx = tuple(_idx(indices))
     return out.at[idx].set(data)
 
 
 @register("_scatter_set_nd", ndarray_inputs=("lhs", "rhs", "indices"),
           nograd_argnums=(2,))
 def _scatter_set_nd(lhs, rhs, indices, shape=()):
-    idx = tuple(indices.astype(jnp.int32))
+    idx = tuple(_idx(indices))
     return lhs.at[idx].set(rhs)
 
 

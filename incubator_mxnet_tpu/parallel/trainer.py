@@ -99,15 +99,20 @@ class ShardedTrainer:
     mesh: jax Mesh (default: 1-d data mesh over all devices)
     param_spec_fn: name, shape → PartitionSpec for tensor-parallel layouts
         (default: fully replicated — pure DP)
+    zero: 0 (off) or 1 — ZeRO stage-1: per-param optimizer state is
+        sharded along the data axis (memory /= data-parallel degree;
+        the reference's server-side-optimizer semantic, SURVEY §5.8)
     """
 
     def __init__(self, block, loss_fn=softmax_ce_loss, optimizer="sgd",
                  lr=0.01, momentum=0.9, wd=0.0, mesh: Optional[Mesh] = None,
-                 batch_axis="data", param_spec_fn=None, donate=True):
+                 batch_axis="data", param_spec_fn=None, donate=True,
+                 zero=0):
         self.block = block
         self.mesh = mesh or make_mesh()
         self.batch_axis = batch_axis
         self.loss_fn = loss_fn
+        self.zero = int(zero)
         if optimizer == "sgd":
             self._opt_init, self._opt_update = sgd_momentum_tree(
                 lr, momentum, wd)
@@ -125,15 +130,62 @@ class ShardedTrainer:
         self.params = {
             n: jax.device_put(v, self._param_shardings[n])
             for n, v in self.params.items()}
-        self.opt_state = self._opt_init(self.params)
+        # ZeRO stage 1 (zero=1): per-param optimizer state lives SHARDED
+        # along the data axis — the TPU-native form of the reference's
+        # server-side optimizer (SURVEY §5.8: ps-lite servers each hold
+        # a key shard and update it; here each mesh slice holds a state
+        # shard and XLA's partitioner turns the gradient all-reduce into
+        # reduce-scatter + sharded update + param all-gather).
+        self._opt_shardings = {
+            n: NamedSharding(self.mesh, self._zero_spec(n, v.shape))
+            for n, v in self.params.items()}
+        self.opt_state = self._place_opt_tree(
+            self._opt_init(self.params), jax.device_put)
         self._batch_sharding = NamedSharding(self.mesh, P(batch_axis))
         self._step = None
         self._n_step = 0
+
+    def _zero_spec(self, name, shape):
+        """PartitionSpec for this param's optimizer-state leaves: the
+        param's own spec (TP axes follow the weight layout), plus —
+        under zero=1 — the first free axis divisible by the data-mesh
+        size sharded on the batch axis."""
+        base = list(self._param_shardings[name].spec)
+        base += [None] * (len(shape) - len(base))
+        if not self.zero:
+            return P(*base)
+        ndata = self.mesh.shape[self.batch_axis]
+        if ndata <= 1 or self.batch_axis in base:
+            # a mesh axis may map to only one tensor dim; if the param
+            # spec already uses the batch axis, the state follows it
+            return P(*base)
+        for i, dim in enumerate(shape):
+            if base[i] is None and dim % ndata == 0 and dim >= ndata:
+                base[i] = self.batch_axis
+                return P(*base)
+        return P(*base)             # indivisible (biases): replicated
+
+    def _place_opt_tree(self, tree, place):
+        """Walk an optimizer-state tree, applying `place(leaf, sharding)`
+        — param-name-keyed dicts take the matching state shardings,
+        scalars/step counters replicate."""
+        rep = NamedSharding(self.mesh, P())
+        def walk(sub):
+            if isinstance(sub, dict):
+                if set(sub) == set(self.params):
+                    return {n: place(v, self._opt_shardings[n])
+                            for n, v in sub.items()}
+                return {k: walk(v) for k, v in sub.items()}
+            return place(sub, rep)
+        return walk(tree)
 
     def _build_step(self, donate=True):
         fwd = self._fwd
         loss_fn = self.loss_fn
         opt_update = self._opt_update
+        constrain = functools.partial(self._place_opt_tree,
+                                      place=jax.lax.with_sharding_constraint) \
+            if self.zero else (lambda tree, **_: tree)
 
         def step(params, opt_state, batch, labels, rng_bits):
             def lf(p):
@@ -142,10 +194,19 @@ class ShardedTrainer:
             (loss, states), grads = jax.value_and_grad(
                 lf, has_aux=True)(params)
             new_params, new_opt = opt_update(params, grads, opt_state)
+            # keep optimizer state on its ZeRO shards: the constraint is
+            # what makes XLA compute the update on the shard (and lower
+            # the gradient sum to reduce-scatter where profitable)
+            # instead of re-replicating
+            new_opt = constrain(new_opt)
             # fold running-stat updates (BatchNorm) back into params
             for k, v in states.items():
                 if k in new_params:
                     new_params[k] = v.astype(new_params[k].dtype)
+            new_params = {
+                n: jax.lax.with_sharding_constraint(
+                    v, self._param_shardings[n])
+                for n, v in new_params.items()}
             return new_params, new_opt, loss
 
         return jax.jit(step, donate_argnums=(0, 1) if donate else ())
@@ -236,17 +297,10 @@ class ShardedTrainer:
             for n, v in params.items()}
 
         # optimizer-state subtrees keyed by param name take the matching
-        # param shardings (sgd: {n: m}; adam: {"m": {...}, "v": {...}});
-        # scalars (step counters) replicate
-        def _place_state(sub):
-            if isinstance(sub, dict):
-                if set(sub) == set(self.params):
-                    return {n: jax.device_put(
-                        jnp.asarray(v), self._param_shardings[n])
-                        for n, v in sub.items()}
-                return {k: _place_state(v) for k, v in sub.items()}
-            return jax.device_put(jnp.asarray(sub),
-                                  NamedSharding(self.mesh, P()))
-        self.opt_state = _place_state(restored["opt_state"])
+        # state shardings (ZeRO shards under zero=1, else the param
+        # shardings); scalars (step counters) replicate
+        self.opt_state = self._place_opt_tree(
+            restored["opt_state"],
+            lambda v, sh: jax.device_put(jnp.asarray(v), sh))
         self._n_step = int(restored["n_step"])
         self._step = None          # rebuild with the restored layouts

@@ -1,0 +1,586 @@
+// Flat C ABI implementation (see include/mxnet_tpu/c_api.h).
+//
+// Re-design of ref: src/c_api/{c_api.cc,c_api_ndarray.cc,
+// c_api_symbolic.cc,c_api_error.cc}.  The reference's C API marshals
+// handles into the C++ runtime; here the runtime orchestrator is the
+// embedded Python package (XLA/PJRT underneath executes the math), so
+// every entry point bridges C <-> the runtime under the GIL and keeps
+// the reference's contracts:
+//   - return 0/-1, per-thread error text (MXAPIThreadLocalEntry's
+//     last_error ≙ thread_local std::string here),
+//   - output arrays owned by thread-local return stores,
+//   - handles are opaque and must be freed by the caller.
+//
+// Works both embedded (client process has no Python: we initialize the
+// interpreter on first use, honouring PYTHONPATH) and in-process
+// (loaded into an existing Python process: we just take the GIL).
+//
+// Build: g++ -O2 -shared -fPIC src/c_api/c_api.cc \
+//            $(python3-config --includes) -lpython3.12 \
+//            -o src/c_api/libmxtpu_c.so
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "../../include/mxnet_tpu/c_api.h"
+
+namespace {
+
+thread_local std::string tls_last_error;
+
+// thread-local return stores (ref: MXAPIThreadLocalEntry)
+thread_local std::vector<NDArrayHandle> tls_handles;
+thread_local std::vector<std::string> tls_strings;
+thread_local std::vector<const char *> tls_cstrs;
+thread_local std::string tls_json;
+
+struct PyRuntime {
+  PyObject *helpers = nullptr;  // dict with bootstrap helper functions
+  bool we_initialized = false;
+};
+
+PyRuntime g_rt;
+std::once_flag g_init_once;
+
+// Helper functions compiled into the embedded interpreter once.  All
+// C<->runtime marshalling that is natural in Python lives here; the C
+// side only moves raw buffers and handles.
+const char *kBootstrapSrc = R"PY(
+import ast
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray, invoke
+from incubator_mxnet_tpu.base import dtype_np
+from incubator_mxnet_tpu.ops import registry as _registry
+
+# ref: mshadow/base.h TypeFlag order
+_DTYPE_BY_CODE = {0: 'float32', 1: 'float64', 2: 'float16', 3: 'uint8',
+                  4: 'int32', 5: 'int8', 6: 'int64', 7: 'bool',
+                  8: 'int16', 9: 'uint16', 10: 'uint32', 11: 'uint64',
+                  12: 'bfloat16'}
+_CODE_BY_DTYPE = {v: k for k, v in _DTYPE_BY_CODE.items()}
+
+
+def _ctx(dev_type, dev_id):
+    return {1: mx.cpu, 2: mx.gpu, 3: mx.cpu_pinned}[dev_type](dev_id)
+
+
+def _create(shape, dtype_code, dev_type, dev_id):
+    return nd.zeros(tuple(shape), ctx=_ctx(dev_type, dev_id),
+                    dtype=_DTYPE_BY_CODE[dtype_code])
+
+
+def _copy_from(arr, mem):
+    src = np.frombuffer(mem, dtype=dtype_np(str(arr.dtype)))
+    if src.size != arr.size:
+        raise ValueError('SyncCopyFromCPU: size mismatch (%d vs %d)'
+                         % (src.size, arr.size))
+    arr[:] = nd.array(src.reshape(arr.shape), ctx=arr.context,
+                      dtype=str(arr.dtype))
+
+
+def _copy_to(arr):
+    return np.ascontiguousarray(arr.asnumpy()).tobytes()
+
+
+def _dtype_code(arr):
+    return _CODE_BY_DTYPE[str(np.dtype(arr.dtype))
+                          if str(arr.dtype) != 'bfloat16' else 'bfloat16']
+
+
+def _context(arr):
+    c = arr.context
+    code = {'cpu': 1, 'gpu': 2, 'tpu': 2, 'cpu_pinned': 3,
+            'cpu_shared': 1}[c.device_type]
+    return code, c.device_id
+
+
+def _invoke(opname, inputs, keys, vals):
+    kw = {}
+    for k, v in zip(keys, vals):
+        try:
+            kw[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            kw[k] = v
+    out = invoke(opname, *inputs, **kw)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def _list_ops():
+    return _registry.list_ops()
+
+
+def _save(fname, handles, keys):
+    if keys is None:
+        data = handles if len(handles) != 1 else handles[0]
+    else:
+        data = dict(zip(keys, handles))
+    nd.save(fname, data)
+
+
+def _load(fname):
+    data = nd.load(fname)
+    if isinstance(data, dict):
+        return list(data.values()), list(data.keys())
+    if isinstance(data, NDArray):
+        data = [data]
+    return list(data), []
+
+
+def _sym_from_file(fname):
+    from incubator_mxnet_tpu import symbol
+    return symbol.load(fname)
+
+
+def _sym_from_json(js):
+    from incubator_mxnet_tpu import symbol
+    return symbol.load_json(js)
+
+
+def _seed(s):
+    mx.random.seed(s)
+
+
+def _n_devices():
+    import jax
+    try:
+        return len([d for d in jax.devices()
+                    if d.platform != 'cpu']) or len(jax.devices())
+    except Exception:
+        return 0
+)PY";
+
+void init_runtime() {
+  std::call_once(g_init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);  // honours PYTHONPATH for package discovery
+      g_rt.we_initialized = true;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *globals = PyDict_New();
+    PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
+    PyObject *res =
+        PyRun_String(kBootstrapSrc, Py_file_input, globals, globals);
+    if (res == nullptr) {
+      PyErr_Print();
+      Py_DECREF(globals);
+      PyGILState_Release(g);
+      if (g_rt.we_initialized) PyEval_SaveThread();
+      throw std::runtime_error(
+          "mxnet_tpu c_api: failed to import runtime (is the package on "
+          "PYTHONPATH?)");
+    }
+    Py_DECREF(res);
+    g_rt.helpers = globals;  // keep alive forever
+    PyGILState_Release(g);
+    if (g_rt.we_initialized) {
+      // release the GIL from the init thread so PyGILState_Ensure works
+      // from any client thread afterwards
+      PyEval_SaveThread();
+    }
+  });
+  if (g_rt.helpers == nullptr)
+    throw std::runtime_error("mxnet_tpu c_api: runtime unavailable");
+}
+
+struct GILGuard {
+  PyGILState_STATE state;
+  GILGuard() { state = PyGILState_Ensure(); }
+  ~GILGuard() { PyGILState_Release(state); }
+};
+
+void capture_py_error() {
+  if (!PyErr_Occurred()) return;
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  PyObject *s = value ? PyObject_Str(value) : nullptr;
+  tls_last_error = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+  Py_XDECREF(s);
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  PyErr_Clear();
+}
+
+// Call helper `name` with already-referenced args; steals nothing.
+PyObject *call_helper(const char *name, PyObject *args) {
+  PyObject *fn = PyDict_GetItemString(g_rt.helpers, name);  // borrowed
+  if (fn == nullptr) throw std::runtime_error("missing helper");
+  PyObject *out = PyObject_CallObject(fn, args);
+  if (out == nullptr) {
+    capture_py_error();
+    throw std::runtime_error(tls_last_error);
+  }
+  return out;
+}
+
+// An NDArray handle owns a python reference + a shape cache for
+// MXNDArrayGetShape pointer stability.
+struct HandleBox {
+  PyObject *obj;
+  std::vector<int64_t> shape;
+};
+
+HandleBox *box_of(NDArrayHandle h) { return static_cast<HandleBox *>(h); }
+
+NDArrayHandle make_handle(PyObject *obj /* new ref, stolen */) {
+  HandleBox *b = new HandleBox();
+  b->obj = obj;
+  return b;
+}
+
+}  // namespace
+
+#define API_BEGIN()            \
+  try {                        \
+    init_runtime();            \
+    GILGuard gil__;            \
+    (void)gil__;
+
+#define API_END()                        \
+    return 0;                           \
+  } catch (const std::exception &e) {   \
+    if (tls_last_error.empty()) tls_last_error = e.what(); \
+    return -1;                          \
+  } catch (...) {                       \
+    tls_last_error = "unknown c_api error";                \
+    return -1;                          \
+  }
+
+extern "C" {
+
+const char *MXGetLastError(void) { return tls_last_error.c_str(); }
+
+int MXGetVersion(int *out) {
+  *out = 20400;  // 2.4.0 -- round-4 build of the TPU-native framework
+  return 0;
+}
+
+int MXGetGPUCount(int *out) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *r = call_helper("_n_devices", nullptr);
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXRandomSeed(int seed) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(i)", seed);
+  PyObject *r = call_helper("_seed", args);
+  Py_DECREF(args);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayCreate(const int64_t *shape, int ndim, int dtype,
+                    int dev_type, int dev_id, NDArrayHandle *out) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  PyObject *args = Py_BuildValue("(Niii)", shp, dtype, dev_type, dev_id);
+  PyObject *r = call_helper("_create", args);
+  Py_DECREF(args);
+  *out = make_handle(r);
+  API_END();
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  tls_last_error.clear();
+  API_BEGIN();
+  HandleBox *b = box_of(handle);
+  Py_XDECREF(b->obj);
+  delete b;
+  API_END();
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  tls_last_error.clear();
+  API_BEGIN();
+  HandleBox *b = box_of(handle);
+  // size is an element count (reference contract); bytes = itemsize *
+  // count is resolved python-side via the array dtype, so wrap the raw
+  // memory read-only at its full byte extent.
+  PyObject *itemsize_o = PyObject_GetAttrString(b->obj, "dtype");
+  if (itemsize_o == nullptr) { capture_py_error(); throw std::runtime_error(tls_last_error); }
+  PyObject *np_itemsize = PyObject_GetAttrString(itemsize_o, "itemsize");
+  Py_DECREF(itemsize_o);
+  long isz = np_itemsize ? PyLong_AsLong(np_itemsize) : -1;
+  Py_XDECREF(np_itemsize);
+  if (isz <= 0) {
+    PyErr_Clear();
+    throw std::runtime_error("SyncCopyFromCPU: cannot resolve itemsize");
+  }
+  PyObject *mem = PyMemoryView_FromMemory(
+      const_cast<char *>(static_cast<const char *>(data)),
+      static_cast<Py_ssize_t>(size * isz), PyBUF_READ);
+  PyObject *args = PyTuple_Pack(2, b->obj, mem);
+  Py_DECREF(mem);
+  PyObject *r = call_helper("_copy_from", args);
+  Py_DECREF(args);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
+  tls_last_error.clear();
+  API_BEGIN();
+  HandleBox *b = box_of(handle);
+  PyObject *args = PyTuple_Pack(1, b->obj);
+  PyObject *bytes = call_helper("_copy_to", args);
+  Py_DECREF(args);
+  char *buf;
+  Py_ssize_t blen;
+  if (PyBytes_AsStringAndSize(bytes, &buf, &blen) != 0) {
+    Py_DECREF(bytes);
+    capture_py_error();
+    throw std::runtime_error(tls_last_error);
+  }
+  Py_ssize_t want = static_cast<Py_ssize_t>(size);
+  // `size` is an element count; blen is bytes.  Copy min(all, size*item)
+  Py_ssize_t item = blen;  // resolve per-element below
+  PyObject *dt = PyObject_GetAttrString(b->obj, "dtype");
+  PyObject *iszo = dt ? PyObject_GetAttrString(dt, "itemsize") : nullptr;
+  Py_XDECREF(dt);
+  if (iszo != nullptr) {
+    item = PyLong_AsLong(iszo);
+    Py_DECREF(iszo);
+  } else {
+    PyErr_Clear();
+  }
+  Py_ssize_t limit = want * item;
+  if (limit > blen) limit = blen;
+  std::memcpy(data, buf, static_cast<size_t>(limit));
+  Py_DECREF(bytes);
+  API_END();
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, int *out_dim,
+                      const int64_t **out_pdata) {
+  tls_last_error.clear();
+  API_BEGIN();
+  HandleBox *b = box_of(handle);
+  PyObject *shp = PyObject_GetAttrString(b->obj, "shape");
+  if (shp == nullptr) { capture_py_error(); throw std::runtime_error(tls_last_error); }
+  Py_ssize_t n = PyTuple_Size(shp);
+  b->shape.resize(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i)
+    b->shape[static_cast<size_t>(i)] =
+        PyLong_AsLongLong(PyTuple_GET_ITEM(shp, i));
+  Py_DECREF(shp);
+  *out_dim = static_cast<int>(n);
+  *out_pdata = b->shape.data();
+  API_END();
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *args = PyTuple_Pack(1, box_of(handle)->obj);
+  PyObject *r = call_helper("_dtype_code", args);
+  Py_DECREF(args);
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *args = PyTuple_Pack(1, box_of(handle)->obj);
+  PyObject *r = call_helper("_context", args);
+  Py_DECREF(args);
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *r =
+      PyObject_CallMethod(box_of(handle)->obj, "wait_to_read", nullptr);
+  if (r == nullptr) { capture_py_error(); throw std::runtime_error(tls_last_error); }
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayWaitAll(void) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *fn = PyDict_GetItemString(g_rt.helpers, "nd");
+  if (fn == nullptr) throw std::runtime_error("runtime not loaded");
+  PyObject *r = PyObject_CallMethod(fn, "waitall", nullptr);
+  if (r == nullptr) { capture_py_error(); throw std::runtime_error(tls_last_error); }
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXImperativeInvoke(const char *op_name, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject *o = box_of(inputs[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(ins, i, o);
+  }
+  PyObject *keys = PyList_New(num_params);
+  PyObject *vals = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SET_ITEM(keys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SET_ITEM(vals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject *args = Py_BuildValue("(sNNN)", op_name, ins, keys, vals);
+  PyObject *r = call_helper("_invoke", args);
+  Py_DECREF(args);
+  Py_ssize_t n = PyList_Size(r);
+  tls_handles.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GET_ITEM(r, i);
+    Py_INCREF(o);
+    tls_handles.push_back(make_handle(o));
+  }
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(n);
+  *outputs = tls_handles.data();
+  API_END();
+}
+
+int MXListAllOpNames(int *out_size, const char ***out_array) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *r = call_helper("_list_ops", nullptr);
+  Py_ssize_t n = PyList_Size(r);
+  tls_strings.clear();
+  tls_cstrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tls_strings.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(r, i)));
+  Py_DECREF(r);
+  for (auto &s : tls_strings) tls_cstrs.push_back(s.c_str());
+  *out_size = static_cast<int>(n);
+  *out_array = tls_cstrs.data();
+  API_END();
+}
+
+int MXNDArraySave(const char *fname, uint32_t num_args,
+                  NDArrayHandle *args_in, const char **keys) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *arrs = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    PyObject *o = box_of(args_in[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(arrs, i, o);
+  }
+  PyObject *pykeys;
+  if (keys == nullptr) {
+    pykeys = Py_None;
+    Py_INCREF(Py_None);
+  } else {
+    pykeys = PyList_New(num_args);
+    for (uint32_t i = 0; i < num_args; ++i)
+      PyList_SET_ITEM(pykeys, i, PyUnicode_FromString(keys[i]));
+  }
+  PyObject *args = Py_BuildValue("(sNN)", fname, arrs, pykeys);
+  PyObject *r = call_helper("_save", args);
+  Py_DECREF(args);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayLoad(const char *fname, uint32_t *out_size,
+                  NDArrayHandle **out_arr, uint32_t *out_name_size,
+                  const char ***out_names) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(s)", fname);
+  PyObject *r = call_helper("_load", args);
+  Py_DECREF(args);
+  PyObject *arrs = PyTuple_GET_ITEM(r, 0);
+  PyObject *names = PyTuple_GET_ITEM(r, 1);
+  Py_ssize_t n = PyList_Size(arrs);
+  Py_ssize_t nn = PyList_Size(names);
+  tls_handles.clear();
+  tls_strings.clear();
+  tls_cstrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GET_ITEM(arrs, i);
+    Py_INCREF(o);
+    tls_handles.push_back(make_handle(o));
+  }
+  for (Py_ssize_t i = 0; i < nn; ++i)
+    tls_strings.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(names, i)));
+  for (auto &s : tls_strings) tls_cstrs.push_back(s.c_str());
+  Py_DECREF(r);
+  *out_size = static_cast<uint32_t>(n);
+  *out_arr = tls_handles.data();
+  *out_name_size = static_cast<uint32_t>(nn);
+  *out_names = tls_cstrs.data();
+  API_END();
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(s)", fname);
+  PyObject *r = call_helper("_sym_from_file", args);
+  Py_DECREF(args);
+  *out = make_handle(r);
+  API_END();
+}
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(s)", json);
+  PyObject *r = call_helper("_sym_from_json", args);
+  Py_DECREF(args);
+  *out = make_handle(r);
+  API_END();
+}
+
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *r = PyObject_CallMethod(box_of(sym)->obj, "tojson", nullptr);
+  if (r == nullptr) { capture_py_error(); throw std::runtime_error(tls_last_error); }
+  tls_json = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out_json = tls_json.c_str();
+  API_END();
+}
+
+int MXSymbolGetName(SymbolHandle sym, const char **out) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *r = PyObject_GetAttrString(box_of(sym)->obj, "name");
+  if (r == nullptr) { capture_py_error(); throw std::runtime_error(tls_last_error); }
+  tls_json = (r == Py_None) ? "" : PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out = tls_json.c_str();
+  API_END();
+}
+
+int MXSymbolFree(SymbolHandle handle) { return MXNDArrayFree(handle); }
+
+}  // extern "C"

@@ -1,0 +1,125 @@
+"""Flat C ABI smoke: build libmxtpu_c.so + a pure-C++ client and run it
+as a foreign process (ref: the role of include/mxnet/c_api.h +
+cpp-package/example — the C ABI is what made non-Python bindings cheap,
+SURVEY §2.6).
+
+The client (tests/cpp/c_api_smoke.cc) contains no Python: it links the
+C ABI, which embeds the runtime on first use.  Build artifacts are
+cached in /tmp keyed on source mtimes; skipped when g++ or libpython
+are unavailable.
+"""
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+CAPI_CC = os.path.join(REPO, "src", "c_api", "c_api.cc")
+SMOKE_CC = os.path.join(REPO, "tests", "cpp", "c_api_smoke.cc")
+INCLUDE = os.path.join(REPO, "include")
+
+_LIBDIR = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+_PYLIB = "python%d.%d" % sys.version_info[:2]
+
+
+def _py_includes():
+    return sysconfig.get_paths()["include"]
+
+
+def _build(cache_dir):
+    lib = os.path.join(cache_dir, "libmxtpu_c.so")
+    exe = os.path.join(cache_dir, "c_api_smoke")
+    srcs = [CAPI_CC, SMOKE_CC, os.path.join(INCLUDE, "mxnet_tpu",
+                                            "c_api.h"),
+            os.path.join(INCLUDE, "mxnet_tpu", "ndarray.hpp")]
+    newest = max(os.path.getmtime(s) for s in srcs)
+    if (os.path.exists(exe) and os.path.exists(lib)
+            and os.path.getmtime(exe) > newest
+            and os.path.getmtime(lib) > newest):
+        return lib, exe
+    os.makedirs(cache_dir, exist_ok=True)
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", CAPI_CC,
+         "-I" + _py_includes(), "-I" + INCLUDE,
+         "-L" + _LIBDIR, "-l" + _PYLIB, "-o", lib],
+        check=True, capture_output=True, text=True)
+    subprocess.run(
+        ["g++", "-O2", SMOKE_CC, "-I" + INCLUDE, lib,
+         "-Wl,-rpath," + cache_dir, "-Wl,-rpath," + _LIBDIR,
+         "-o", exe],
+        check=True, capture_output=True, text=True)
+    return lib, exe
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="needs g++")
+def test_c_api_smoke_from_cpp_client():
+    cache = "/tmp/mxtpu_c_api_build"
+    try:
+        lib, exe = _build(cache)
+    except subprocess.CalledProcessError as e:
+        raise AssertionError("c_api build failed:\n%s" % e.stderr[-3000:])
+    env = dict(os.environ)
+    # the embedded interpreter discovers the package via PYTHONPATH;
+    # force the CPU platform for a hermetic foreign-process run
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run([exe], env=env, cwd=REPO, capture_output=True,
+                         text=True, timeout=300)
+    assert res.returncode == 0, \
+        "smoke client failed:\n%s\n%s" % (res.stdout[-1500:],
+                                          res.stderr[-1500:])
+    assert "C_API_SMOKE_OK" in res.stdout
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="needs g++")
+def test_c_api_in_process_via_ctypes():
+    """The same ABI loaded into an EXISTING Python process (the
+    in-process path: Py_IsInitialized short-circuits embedding)."""
+    import ctypes
+
+    lib, _exe = _build("/tmp/mxtpu_c_api_build")
+    L = ctypes.CDLL(lib)
+    L.MXGetLastError.restype = ctypes.c_char_p
+    # 64-bit hygiene: size_t/handle params must not be passed as c_int
+    L.MXNDArrayCreate.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p)]
+    L.MXNDArraySyncCopyFromCPU.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    L.MXNDArraySyncCopyToCPU.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    L.MXImperativeInvoke.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_void_p)),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_char_p)]
+    L.MXNDArrayFree.argtypes = [ctypes.c_void_p]
+
+    ver = ctypes.c_int()
+    assert L.MXGetVersion(ctypes.byref(ver)) == 0 and ver.value > 0
+
+    shape = (ctypes.c_int64 * 2)(2, 2)
+    h = ctypes.c_void_p()
+    rc = L.MXNDArrayCreate(shape, 2, 0, 1, 0, ctypes.byref(h))
+    assert rc == 0, L.MXGetLastError()
+    src = (ctypes.c_float * 4)(1, 2, 3, 4)
+    assert L.MXNDArraySyncCopyFromCPU(h, src, 4) == 0, L.MXGetLastError()
+
+    n_out = ctypes.c_int()
+    out = ctypes.POINTER(ctypes.c_void_p)()
+    ins = (ctypes.c_void_p * 2)(h, h)
+    rc = L.MXImperativeInvoke(b"elemwise_add", 2, ins,
+                              ctypes.byref(n_out), ctypes.byref(out),
+                              0, None, None)
+    assert rc == 0, L.MXGetLastError()
+    assert n_out.value == 1
+    dst = (ctypes.c_float * 4)()
+    assert L.MXNDArraySyncCopyToCPU(out[0], dst, 4) == 0
+    assert list(dst) == [2.0, 4.0, 6.0, 8.0]
+    assert L.MXNDArrayFree(out[0]) == 0
+    assert L.MXNDArrayFree(h) == 0

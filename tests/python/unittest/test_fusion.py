@@ -421,3 +421,107 @@ def test_hybridized_loss_exports_via_symbol_namespace():
     out = lf(S.var("pred"), S.var("label"))
     g = load_json(out.tojson())
     assert g is not None
+
+
+def test_fused_linear_softmax_ce_matches_composition():
+    """Chunked projection+CE == Dense→softmax-CE composition, values
+    and all three grads (dh, dW, db), without materialising logits."""
+    rs = np.random.RandomState(3)
+    n, d, v = 24, 16, 37            # 24 rows -> nchunk divides (use 4)
+    h = nd.array(rs.randn(n, d).astype("float32"))
+    w = nd.array((rs.randn(v, d) * 0.1).astype("float32"))
+    b = nd.array(rs.randn(v).astype("float32"))
+    lab = nd.array(rs.randint(0, v, n).astype("float32"))
+
+    for arr in (h, w, b):
+        arr.attach_grad()
+
+    with ag.record():
+        loss = nd._fused_linear_softmax_ce(h, w, b, lab, num_chunks=4)
+        loss.backward()
+    got = (loss.asnumpy(), h.grad.asnumpy(), w.grad.asnumpy(),
+           b.grad.asnumpy())
+
+    h2 = nd.array(h.asnumpy()); w2 = nd.array(w.asnumpy())
+    b2 = nd.array(b.asnumpy())
+    for arr in (h2, w2, b2):
+        arr.attach_grad()
+    with ag.record():
+        logits = nd.FullyConnected(h2, w2, b2, num_hidden=v)
+        ref_loss = nd._fused_softmax_ce(logits, lab)
+        ref_loss.backward()
+    ref = (ref_loss.asnumpy(), h2.grad.asnumpy(), w2.grad.asnumpy(),
+           b2.grad.asnumpy())
+
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(g, r, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_mlm_ce_loss_block_trains_like_dense_head():
+    """BERTModel(output_hidden=True) + FusedMLMCELoss == the Dense
+    decoder head + SoftmaxCrossEntropyLoss, end to end through one
+    training step."""
+    from incubator_mxnet_tpu.models import bert_small
+    from incubator_mxnet_tpu.models.transformer import FusedMLMCELoss
+
+    vocab, seq = 64, 16
+    rs = np.random.RandomState(0)
+    tokens_np = rs.randint(0, vocab, (4, seq)).astype("int32")
+    labels_np = rs.randint(0, vocab, (4, seq)).astype("float32")
+
+    dec_w = (rs.randn(vocab, 64) * 0.05).astype("float32")
+    dec_b = np.zeros(vocab, "float32")
+
+    def run(fused):
+        mx.random.seed(5)
+        net = bert_small(vocab_size=vocab, max_length=seq, dropout=0.0,
+                         output_hidden=fused, prefix="fmlm_")
+        net.initialize(force_reinit=True)
+        # materialise the net's deferred params NOW so both runs draw
+        # the same RNG sequence for the encoder (the fused run's loss
+        # block would otherwise initialize first and shift the chain)
+        net(nd.array(tokens_np[:1], dtype="int32"))
+        tokens = nd.array(tokens_np, dtype="int32")
+        labels = nd.array(labels_np)
+        if fused:
+            loss_b = FusedMLMCELoss(vocab, net._units, num_chunks=4,
+                                    prefix="fmlm_decoder_")
+            loss_b.initialize()
+            loss_b.weight.set_data(nd.array(dec_w))
+            loss_b.bias.set_data(nd.array(dec_b))
+            params = {**net.collect_params(), **loss_b.collect_params()}
+        else:
+            # pin the decoder to the same weights the fused run uses —
+            # encoder gradients depend on them
+            net.decoder.weight.set_data(nd.array(dec_w))
+            net.decoder.bias.set_data(nd.array(dec_b))
+            loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+            params = net.collect_params()
+        trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1})
+        with ag.record():
+            out = net(tokens)
+            if fused:
+                loss = loss_b(out, labels)
+            else:
+                loss = loss_fn(out.reshape((4 * seq, -1)),
+                               labels.reshape((-1,)))
+            loss.backward()
+        trainer.step(4)
+        return float(loss.mean().asscalar()), params
+
+    loss_dense, params_dense = run(False)
+    loss_fused, params_fused = run(True)
+    np.testing.assert_allclose(loss_dense, loss_fused, rtol=2e-5,
+                               atol=2e-5)
+    # child auto-prefixes differ between runs, but registration ORDER
+    # is identical: net params align positionally, with the decoder
+    # weight/bias last in both (BERTModel registers the decoder last;
+    # the fused run appends the loss block's weight/bias)
+    dense_vals = list(params_dense.values())
+    fused_vals = list(params_fused.values())
+    assert len(dense_vals) == len(fused_vals) > 12
+    for i, (pd_, pf_) in enumerate(zip(dense_vals, fused_vals)):
+        np.testing.assert_allclose(
+            pd_.data().asnumpy(), pf_.data().asnumpy(), rtol=2e-4,
+            atol=2e-4, err_msg="param #%d %s vs %s" % (i, pd_.name,
+                                                       pf_.name))
