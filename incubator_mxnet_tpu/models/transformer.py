@@ -450,11 +450,15 @@ class TransformerNMT(HybridBlock):
 
     forward(src_tokens, tgt_tokens) → (B, T_tgt, tgt_vocab) logits,
     teacher-forced: tgt is the decoder input (shifted target), causal
-    self-attention via the Pallas flash kernel."""
+    self-attention via the Pallas flash kernel.  With
+    ``output_hidden=True`` the vocab projection is omitted and forward
+    returns (B, T_tgt, units) hidden states — pair with
+    ``FusedMLMCELoss(tgt_vocab, units)`` so the logits never
+    materialise."""
 
     def __init__(self, src_vocab, tgt_vocab, units=512, hidden_size=2048,
                  num_layers=6, num_heads=8, max_length=1024,
-                 dropout=0.1, **kwargs):
+                 dropout=0.1, output_hidden=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._max_length = max_length
@@ -468,7 +472,10 @@ class TransformerNMT(HybridBlock):
                                           num_heads, dropout)
         self.decoder = TransformerDecoder(num_layers, units, hidden_size,
                                           num_heads, dropout)
-        self.out_proj = nn.Dense(tgt_vocab, flatten=False)
+        # output_hidden: pair with FusedMLMCELoss(tgt_vocab, units) so
+        # the (B·T, tgt_vocab) logits never materialise (see BERTModel)
+        self.out_proj = None if output_hidden \
+            else nn.Dense(tgt_vocab, flatten=False)
 
     def _embed(self, embed, ln, tokens):
         from .. import ndarray as F
@@ -500,7 +507,7 @@ class TransformerNMT(HybridBlock):
                                           src), mask=mem_mask)
         h = self.decoder(self._embed(self.tgt_embed, self.dec_ln, tgt),
                          memory, mem_mask)
-        return self.out_proj(h)
+        return h if self.out_proj is None else self.out_proj(h)
 
 
 def transformer_nmt_base(src_vocab, tgt_vocab, **kwargs):

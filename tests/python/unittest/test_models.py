@@ -305,3 +305,67 @@ def test_transformer_nmt_max_length_guard():
     tgt = nd.array(np.zeros((1, 8), np.int32), dtype="int32")
     with _pytest.raises(ValueError, match="max_length"):
         net(src, tgt)
+
+
+def test_transformer_nmt_fused_head_matches_dense():
+    """output_hidden + FusedMLMCELoss == dense out_proj + fused CE:
+    same loss, same encoder/decoder gradients (r4 head fusion)."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, autograd as ag
+    from incubator_mxnet_tpu.models import transformer_nmt_small
+    from incubator_mxnet_tpu.models.transformer import FusedMLMCELoss
+
+    vocab, B, T = 40, 2, 8
+    rs = np.random.RandomState(2)
+    src_np = rs.randint(0, vocab, (B, T)).astype("int32")
+    tgt_np = rs.randint(0, vocab, (B, T)).astype("int32")
+    lab_np = rs.randint(0, vocab, (B, T)).astype("float32")
+    w_np = (rs.randn(vocab, 64) * 0.05).astype("float32")
+
+    def run(fused):
+        mx.random.seed(9)
+        net = transformer_nmt_small(src_vocab=vocab, tgt_vocab=vocab,
+                                    dropout=0.0, units=64,
+                                    output_hidden=fused)
+        net.initialize(force_reinit=True)
+        src, tgt = nd.array(src_np, dtype="int32"), \
+            nd.array(tgt_np, dtype="int32")
+        net(src, tgt)               # materialise deferred params first
+        lab = nd.array(lab_np)
+        if fused:
+            head = FusedMLMCELoss(vocab, 64, num_chunks=2)
+            head.initialize()
+            head.weight.set_data(nd.array(w_np))
+            head.bias.set_data(nd.zeros((vocab,)))
+            with ag.record():
+                loss = head(net(src, tgt), lab).mean()
+                loss.backward()
+        else:
+            net.out_proj.weight.set_data(nd.array(w_np))
+            net.out_proj.bias.set_data(nd.zeros((vocab,)))
+            with ag.record():
+                logits = net(src, tgt)
+                loss = nd._fused_softmax_ce(
+                    logits.reshape((B * T, vocab)),
+                    lab.reshape((-1,))).mean()
+                loss.backward()
+        # positional gradient list (auto prefixes differ between the
+        # two fresh nets); the dense run drops its out_proj params so
+        # both lists cover exactly the encoder/decoder/embeddings
+        skip = set()
+        if not fused:
+            skip = {id(q) for q in net.out_proj.collect_params()
+                    .values()}
+        grads = [p.grad().asnumpy()
+                 for p in net.collect_params().values()
+                 if p.grad_req != "null" and id(p) not in skip]
+        return float(loss.asscalar()), grads
+
+    loss_d, grads_d = run(False)
+    loss_f, grads_f = run(True)
+    np.testing.assert_allclose(loss_d, loss_f, rtol=2e-5, atol=2e-5)
+    assert len(grads_d) == len(grads_f) > 20
+    for i, (gd, gf) in enumerate(zip(grads_d, grads_f)):
+        np.testing.assert_allclose(gd, gf, rtol=2e-4, atol=2e-4,
+                                   err_msg="grad #%d" % i)

@@ -748,6 +748,7 @@ case("multi_sgd_mom_update", [_W, _G, _S1, _W * 2, _G * 2, _S1 * 2],
 TESTED_ELSEWHERE = {
     "_contrib_SyncBatchNorm": "test_gluon_contrib.py",
     "_fused_softmax_ce": "test_operator.py",
+    "_fused_linear_softmax_ce": "test_fusion.py",
     "amp_cast": "test_amp.py",
     "amp_multicast": "test_amp.py",
     "_contrib_Proposal": "test_rcnn.py",
