@@ -120,6 +120,30 @@ int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json);
 int MXSymbolGetName(SymbolHandle sym, const char **out);
 int MXSymbolFree(SymbolHandle handle);
 
+/* ---- Predict API (deployment surface, ref: c_predict_api.h) ------ */
+
+typedef void *PredictorHandle;
+
+/* symbol_json_str: contents of an export()ed -symbol.json;
+ * param_bytes/param_size: raw bytes of the matching .params file.
+ * Input shapes use the reference's CSR layout: input i has dims
+ * input_shape_data[indptr[i] .. indptr[i+1]). */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char **input_keys,
+                 const uint32_t *input_shape_indptr,
+                 const uint32_t *input_shape_data,
+                 PredictorHandle *out);
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const float *data, uint32_t size);
+int MXPredForward(PredictorHandle handle);
+/* shape pointer valid until the next call on the calling thread */
+int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
+                         uint32_t **shape_data, uint32_t *shape_ndim);
+int MXPredGetOutput(PredictorHandle handle, uint32_t index, float *data,
+                    uint32_t size);
+int MXPredFree(PredictorHandle handle);
+
 #ifdef __cplusplus
 } /* extern "C" */
 #endif

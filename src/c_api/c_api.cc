@@ -149,6 +149,70 @@ def _seed(s):
     mx.random.seed(s)
 
 
+# ---- predict API (ref: include/mxnet/c_predict_api.h) ----------------
+def _pred_create(symbol_json, param_blob, dev_type, dev_id, input_keys,
+                 input_shapes):
+    import os
+    import tempfile
+    from incubator_mxnet_tpu.gluon.block import SymbolBlock
+    from incubator_mxnet_tpu import symbol as sym_mod
+    from incubator_mxnet_tpu.symbol import var
+
+    sym = sym_mod.load_json(symbol_json)
+    block = SymbolBlock(sym, [var(k) for k in input_keys])
+    ctx = _ctx(dev_type, dev_id)
+    if param_blob:
+        fd, fname = tempfile.mkstemp(suffix=".params")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(param_blob)
+            block.load_parameters(fname, ctx=ctx, ignore_extra=True)
+        finally:
+            os.unlink(fname)
+    return {"block": block, "ctx": ctx, "keys": list(input_keys),
+            "shapes": {k: tuple(s) for k, s in zip(input_keys,
+                                                   input_shapes)},
+            "feed": {}, "outputs": None}
+
+
+def _pred_set_input(pred, key, mem):
+    if key not in pred["shapes"]:
+        raise KeyError("unknown input %r (declared: %r)"
+                       % (key, pred["keys"]))
+    shape = pred["shapes"][key]
+    src = np.frombuffer(mem, dtype=np.float32)
+    n = 1
+    for d in shape:
+        n *= d
+    if src.size != n:
+        raise ValueError("input %r: got %d elements, shape %r needs %d"
+                         % (key, src.size, shape, n))
+    pred["feed"][key] = nd.array(src.reshape(shape), ctx=pred["ctx"])
+
+
+def _pred_forward(pred):
+    missing = [k for k in pred["keys"] if k not in pred["feed"]]
+    if missing:
+        raise ValueError("inputs not set before forward: %r" % missing)
+    out = pred["block"](*[pred["feed"][k] for k in pred["keys"]])
+    pred["outputs"] = list(out) if isinstance(out, (list, tuple)) \
+        else [out]
+
+
+def _pred_out_shape(pred, index):
+    if pred["outputs"] is None:
+        raise RuntimeError("call MXPredForward first")
+    return tuple(pred["outputs"][index].shape)
+
+
+def _pred_get_output(pred, index):
+    if pred["outputs"] is None:
+        raise RuntimeError("call MXPredForward first")
+    return np.ascontiguousarray(
+        pred["outputs"][index].asnumpy().astype(np.float32,
+                                                copy=False)).tobytes()
+
+
 def _n_devices():
     import jax
     try:
@@ -582,5 +646,112 @@ int MXSymbolGetName(SymbolHandle sym, const char **out) {
 }
 
 int MXSymbolFree(SymbolHandle handle) { return MXNDArrayFree(handle); }
+
+// ---- predict API (ref: src/c_api/c_predict_api.cc) ------------------
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char **input_keys,
+                 const uint32_t *input_shape_indptr,
+                 const uint32_t *input_shape_data,
+                 PredictorHandle *out) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *keys = PyList_New(num_input_nodes);
+  PyObject *shapes = PyList_New(num_input_nodes);
+  for (uint32_t i = 0; i < num_input_nodes; ++i) {
+    PyList_SET_ITEM(keys, i, PyUnicode_FromString(input_keys[i]));
+    uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject *shp = PyTuple_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(shp, j - lo,
+                       PyLong_FromUnsignedLong(input_shape_data[j]));
+    PyList_SET_ITEM(shapes, i, shp);
+  }
+  PyObject *blob = PyBytes_FromStringAndSize(
+      static_cast<const char *>(param_bytes), param_size);
+  PyObject *args = Py_BuildValue("(sNiiNN)", symbol_json_str, blob,
+                                 dev_type, dev_id, keys, shapes);
+  PyObject *r = call_helper("_pred_create", args);
+  Py_DECREF(args);
+  *out = make_handle(r);
+  API_END();
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const float *data, uint32_t size) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *mem = PyMemoryView_FromMemory(
+      const_cast<char *>(reinterpret_cast<const char *>(data)),
+      static_cast<Py_ssize_t>(size) * 4, PyBUF_READ);
+  PyObject *args = Py_BuildValue("(OsN)", box_of(handle)->obj, key, mem);
+  PyObject *r = call_helper("_pred_set_input", args);
+  Py_DECREF(args);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXPredForward(PredictorHandle handle) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *args = PyTuple_Pack(1, box_of(handle)->obj);
+  PyObject *r = call_helper("_pred_forward", args);
+  Py_DECREF(args);
+  Py_DECREF(r);
+  API_END();
+}
+
+// per-handle uint32 shape cache for MXPredGetOutputShape
+thread_local std::vector<uint32_t> tls_u32_shape;
+
+int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
+                         uint32_t **shape_data, uint32_t *shape_ndim) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(OI)", box_of(handle)->obj, index);
+  PyObject *r = call_helper("_pred_out_shape", args);
+  Py_DECREF(args);
+  Py_ssize_t n = PyTuple_Size(r);
+  tls_u32_shape.resize(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tls_u32_shape[static_cast<size_t>(i)] = static_cast<uint32_t>(
+        PyLong_AsUnsignedLongLong(PyTuple_GET_ITEM(r, i)));
+  Py_DECREF(r);
+  *shape_data = tls_u32_shape.data();
+  *shape_ndim = static_cast<uint32_t>(n);
+  API_END();
+}
+
+int MXPredGetOutput(PredictorHandle handle, uint32_t index, float *data,
+                    uint32_t size) {
+  tls_last_error.clear();
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(OI)", box_of(handle)->obj, index);
+  PyObject *bytes = call_helper("_pred_get_output", args);
+  Py_DECREF(args);
+  char *buf;
+  Py_ssize_t blen;
+  if (PyBytes_AsStringAndSize(bytes, &buf, &blen) != 0) {
+    Py_DECREF(bytes);
+    capture_py_error();
+    throw std::runtime_error(tls_last_error);
+  }
+  // strict size contract (ref: c_predict_api CHECKs equality) — a
+  // silent short copy would hand the caller uninitialized floats
+  if (static_cast<Py_ssize_t>(size) * 4 != blen) {
+    Py_ssize_t want = blen / 4;
+    Py_DECREF(bytes);
+    tls_last_error = "MXPredGetOutput: size mismatch (caller " +
+                     std::to_string(size) + " elements, output has " +
+                     std::to_string(want) + ")";
+    throw std::runtime_error(tls_last_error);
+  }
+  std::memcpy(data, buf, static_cast<size_t>(blen));
+  Py_DECREF(bytes);
+  API_END();
+}
+
+int MXPredFree(PredictorHandle handle) { return MXNDArrayFree(handle); }
 
 }  // extern "C"

@@ -5,7 +5,9 @@
 // tests/python/unittest/test_c_api.py.
 #include <cmath>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <fstream>
 #include <string>
 #include <vector>
 
@@ -21,7 +23,46 @@
     }                                                      \
   } while (0)
 
-int main() {
+// Predict-API leg (ref: c_predict_api.h deployment workflow): load an
+// export()ed symbol+params pair, feed ones, compare output[0] against
+// the expected value the test harness computed in Python.
+static int run_predict(const char *sym_path, const char *params_path,
+                       float expected) {
+  std::ifstream sf(sym_path);
+  std::string json((std::istreambuf_iterator<char>(sf)),
+                   std::istreambuf_iterator<char>());
+  std::ifstream pf(params_path, std::ios::binary);
+  std::string blob((std::istreambuf_iterator<char>(pf)),
+                   std::istreambuf_iterator<char>());
+  ASSERT_MSG(!json.empty() && !blob.empty(), "predict artifacts read");
+
+  const char *keys[] = {"data"};
+  uint32_t indptr[] = {0, 2};
+  uint32_t dims[] = {2, 5};
+  PredictorHandle pred = nullptr;
+  ASSERT_MSG(MXPredCreate(json.c_str(), blob.data(),
+                          static_cast<int>(blob.size()), kMXCPU, 0, 1,
+                          keys, indptr, dims, &pred) == 0,
+             "MXPredCreate");
+  std::vector<float> input(10, 1.0f);
+  ASSERT_MSG(MXPredSetInput(pred, "data", input.data(), 10) == 0,
+             "MXPredSetInput");
+  ASSERT_MSG(MXPredForward(pred) == 0, "MXPredForward");
+  uint32_t *oshape = nullptr, ondim = 0;
+  ASSERT_MSG(MXPredGetOutputShape(pred, 0, &oshape, &ondim) == 0 &&
+                 ondim == 2 && oshape[0] == 2,
+             "MXPredGetOutputShape");
+  std::vector<float> outv(oshape[0] * oshape[1]);
+  ASSERT_MSG(MXPredGetOutput(pred, 0, outv.data(),
+                             static_cast<uint32_t>(outv.size())) == 0,
+             "MXPredGetOutput");
+  ASSERT_MSG(std::fabs(outv[0] - expected) < 1e-4f, "predict value");
+  ASSERT_MSG(MXPredFree(pred) == 0, "MXPredFree");
+  std::printf("C_PREDICT_OK out0=%f\n", outv[0]);
+  return 0;
+}
+
+int main(int argc, char **argv) {
   int version = 0;
   ASSERT_MSG(MXGetVersion(&version) == 0 && version > 0, "version");
 
@@ -106,6 +147,12 @@ int main() {
 
   int ndev = -1;
   ASSERT_MSG(MXGetGPUCount(&ndev) == 0 && ndev >= 0, "device count");
+
+  if (argc >= 4) {
+    if (run_predict(argv[1], argv[2],
+                    std::strtof(argv[3], nullptr)) != 0)
+      return 1;
+  }
 
   std::printf("C_API_SMOKE_OK version=%d ops=%d devices=%d\n", version,
               n_ops, ndev);

@@ -56,23 +56,44 @@ def _build(cache_dir):
 
 
 @pytest.mark.skipif(shutil.which("g++") is None, reason="needs g++")
-def test_c_api_smoke_from_cpp_client():
+def test_c_api_smoke_from_cpp_client(tmp_path):
     cache = "/tmp/mxtpu_c_api_build"
     try:
         lib, exe = _build(cache)
     except subprocess.CalledProcessError as e:
         raise AssertionError("c_api build failed:\n%s" % e.stderr[-3000:])
+
+    # export a small net for the predict-API leg (ref: the deploy
+    # workflow — export() in python, MXPredCreate in the C client)
+    import numpy as np
+    from incubator_mxnet_tpu import nd, gluon
+    net = gluon.nn.Dense(3, in_units=5)
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.ones((2, 5), np.float32))
+    net(x)                       # materialise + build the cached graph
+    net.export(str(tmp_path / "cpred"))
+    # expected value from numpy on the exported params: hermetic no
+    # matter which backend THIS process runs on (the client is forced
+    # to CPU; a TPU-computed bf16 reference here would miss 1e-4)
+    w = net.weight.data().asnumpy().astype(np.float64)
+    b = net.bias.data().asnumpy().astype(np.float64)
+    expected = float(np.ones(5) @ w[0] + b[0])
+
     env = dict(os.environ)
     # the embedded interpreter discovers the package via PYTHONPATH;
     # force the CPU platform for a hermetic foreign-process run
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
-    res = subprocess.run([exe], env=env, cwd=REPO, capture_output=True,
-                         text=True, timeout=300)
+    res = subprocess.run(
+        [exe, str(tmp_path / "cpred-symbol.json"),
+         str(tmp_path / "cpred-0000.params"), repr(expected)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
     assert res.returncode == 0, \
         "smoke client failed:\n%s\n%s" % (res.stdout[-1500:],
                                           res.stderr[-1500:])
     assert "C_API_SMOKE_OK" in res.stdout
+    assert "C_PREDICT_OK" in res.stdout
 
 
 @pytest.mark.skipif(shutil.which("g++") is None, reason="needs g++")
