@@ -1,0 +1,29 @@
+"""The ONE compile recipe for the flat C ABI library (libmxtpu_c.so).
+
+Mirrors io/native.py's role for libmxtpu_io.so: setup.py's wheel hook
+and tests/python/unittest/test_c_api.py both call this, so the shipped
+artifact and the tested artifact are always built the same way.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def build_capi_library(out, src=None, include_dir=None):
+    """Compile src/c_api/c_api.cc into `out`. Raises CalledProcessError
+    with captured stderr on failure."""
+    src = src or os.path.join(_REPO, "src", "c_api", "c_api.cc")
+    include_dir = include_dir or os.path.join(_REPO, "include")
+    py_inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    pylib = "python%d.%d" % sys.version_info[:2]
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", src, "-I" + py_inc,
+         "-I" + include_dir, "-L" + libdir, "-l" + pylib, "-o", out],
+        check=True, capture_output=True, text=True)
+    return out

@@ -34,14 +34,28 @@ class BuildWithNativeIO(build_py):
         except Exception as e:
             # pure-python install still works (python RecordIO fallback)
             print("WARNING: native io build skipped:", e)
+        # flat C ABI (c_api.h surface) — optional: the python package
+        # does not depend on it, but a wheel that carries it lets C/C++
+        # clients dlopen the installed library
+        capi_out = os.path.join(here, "incubator_mxnet_tpu",
+                                "libmxtpu_c.so")
+        try:
+            from incubator_mxnet_tpu._capi_build import build_capi_library
+            build_capi_library(capi_out)
+            print("built c_api ->", capi_out)
+        except Exception as e:
+            print("WARNING: c_api build skipped:", e)
         super().run()
-        # place the artifact into the build tree as package data
-        if os.path.exists(out):
-            dst = os.path.join(self.build_lib, "incubator_mxnet_tpu",
-                               "io", "libmxtpu_io.so")
-            os.makedirs(os.path.dirname(dst), exist_ok=True)
-            shutil.copyfile(out, dst)
+        # place the artifacts into the build tree as package data
+        for rel in (("io", "libmxtpu_io.so"), ("libmxtpu_c.so",)):
+            built = os.path.join(here, "incubator_mxnet_tpu", *rel)
+            if os.path.exists(built):
+                dst = os.path.join(self.build_lib,
+                                   "incubator_mxnet_tpu", *rel)
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                shutil.copyfile(built, dst)
 
 
 setup(cmdclass={"build_py": BuildWithNativeIO},
-      package_data={"incubator_mxnet_tpu.io": ["libmxtpu_io.so"]})
+      package_data={"incubator_mxnet_tpu.io": ["libmxtpu_io.so"],
+                    "incubator_mxnet_tpu": ["libmxtpu_c.so"]})

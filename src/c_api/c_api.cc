@@ -187,7 +187,11 @@ def _pred_set_input(pred, key, mem):
     if src.size != n:
         raise ValueError("input %r: got %d elements, shape %r needs %d"
                          % (key, src.size, shape, n))
-    pred["feed"][key] = nd.array(src.reshape(shape), ctx=pred["ctx"])
+    # .copy(): frombuffer ALIASES the caller's memory and CPU device_put
+    # can zero-copy it — the reference contract is a synchronous copy
+    # (the caller may free the buffer right after SetInput returns)
+    pred["feed"][key] = nd.array(src.reshape(shape).copy(),
+                                 ctx=pred["ctx"])
 
 
 def _pred_forward(pred):

@@ -42,11 +42,10 @@ def _build(cache_dir):
             and os.path.getmtime(lib) > newest):
         return lib, exe
     os.makedirs(cache_dir, exist_ok=True)
-    subprocess.run(
-        ["g++", "-O2", "-shared", "-fPIC", CAPI_CC,
-         "-I" + _py_includes(), "-I" + INCLUDE,
-         "-L" + _LIBDIR, "-l" + _PYLIB, "-o", lib],
-        check=True, capture_output=True, text=True)
+    # the ONE compile recipe — shared with setup.py's wheel hook so the
+    # tested artifact and the shipped artifact never diverge
+    from incubator_mxnet_tpu._capi_build import build_capi_library
+    build_capi_library(lib, src=CAPI_CC, include_dir=INCLUDE)
     subprocess.run(
         ["g++", "-O2", SMOKE_CC, "-I" + INCLUDE, lib,
          "-Wl,-rpath," + cache_dir, "-Wl,-rpath," + _LIBDIR,
