@@ -492,6 +492,36 @@ def run_sharded(batch=256, warmup=2, iters=16):
     return batch * iters / (time.perf_counter() - t0)
 
 
+def run_int8_infer(batch=64, warmup=3, iters=20):
+    """Optional extra: post-training-quantized (int8, naive calib)
+    ResNet-50 inference, images/sec — the deploy-side MXU int8 story
+    (ref: example/quantization/imagenet_inference.py)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.contrib.quantization import quantize_net
+    from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet50_v1b
+
+    ctx = mx.gpu()
+    net = resnet50_v1b(classes=1000)
+    net.initialize(ctx=ctx)
+    rs = np.random.RandomState(0)
+    calib = [nd.array(rs.randn(8, 3, 224, 224).astype(np.float32),
+                      ctx=ctx) for _ in range(2)]
+    net(calib[0])
+    qnet = quantize_net(net, calib_data=calib, calib_mode="naive")
+    qnet.hybridize(static_alloc=True, static_shape=True)
+    x = nd.array(rs.randn(batch, 3, 224, 224).astype(np.float32),
+                 ctx=ctx)
+    for _ in range(warmup):
+        out = qnet(x)
+    float(out.reshape((-1,))[:1].asnumpy()[0])    # forced D2H sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = qnet(x)
+    float(out.reshape((-1,))[:1].asnumpy()[0])
+    return batch * iters / (time.perf_counter() - t0)
+
+
 def run_io(batch=128):
     """Input-pipeline-only throughput: native C++ RecordIO+JPEG pipeline
     (src/io/recordio_pipeline.cc), images/sec/host-core — SURVEY §2.4
@@ -579,6 +609,8 @@ _CONFIGS = {
     "sharded": lambda b=None: _cfg_simple(
         "sharded_trainer_value", run_sharded, (256, 128, 64),
         batch_key="sharded_trainer_batch"),
+    "int8": lambda b=None: _cfg_simple(
+        "resnet50_int8_infer_images_per_sec", run_int8_infer, (64, 32)),
 }
 
 # batch ladders main() walks one-subprocess-per-attempt (first success
@@ -637,7 +669,7 @@ def main():
     times = {}
     required = ("resnet", "bert", "ssd512", "rcnn", "gnmt",
                 "transformer_nmt", "wide_deep")
-    optional = ("io", "sharded")
+    optional = ("io", "sharded", "int8")
 
     for name in required + optional:
         remaining = budget - (time.perf_counter() - t_start)

@@ -40,8 +40,16 @@ class BuildWithNativeIO(build_py):
         capi_out = os.path.join(here, "incubator_mxnet_tpu",
                                 "libmxtpu_c.so")
         try:
-            from incubator_mxnet_tpu._capi_build import build_capi_library
-            build_capi_library(capi_out)
+            # load the recipe module directly from its file: a package
+            # import would execute incubator_mxnet_tpu/__init__ (jax
+            # import), which build environments may not have
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                "_capi_build", os.path.join(here, "incubator_mxnet_tpu",
+                                            "_capi_build.py"))
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            mod.build_capi_library(capi_out)
             print("built c_api ->", capi_out)
         except Exception as e:
             print("WARNING: c_api build skipped:", e)

@@ -83,7 +83,10 @@ def _copy_from(arr, mem):
     if src.size != arr.size:
         raise ValueError('SyncCopyFromCPU: size mismatch (%d vs %d)'
                          % (src.size, arr.size))
-    arr[:] = nd.array(src.reshape(arr.shape), ctx=arr.context,
+    # .copy(): frombuffer aliases the caller's memory; "Sync" promises
+    # the buffer is free to reuse the moment this returns (same hazard
+    # as _pred_set_input)
+    arr[:] = nd.array(src.reshape(arr.shape).copy(), ctx=arr.context,
                       dtype=str(arr.dtype))
 
 

@@ -23,19 +23,16 @@ SMOKE_CC = os.path.join(REPO, "tests", "cpp", "c_api_smoke.cc")
 INCLUDE = os.path.join(REPO, "include")
 
 _LIBDIR = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
-_PYLIB = "python%d.%d" % sys.version_info[:2]
-
-
-def _py_includes():
-    return sysconfig.get_paths()["include"]
 
 
 def _build(cache_dir):
+    from incubator_mxnet_tpu import _capi_build
     lib = os.path.join(cache_dir, "libmxtpu_c.so")
     exe = os.path.join(cache_dir, "c_api_smoke")
     srcs = [CAPI_CC, SMOKE_CC, os.path.join(INCLUDE, "mxnet_tpu",
                                             "c_api.h"),
-            os.path.join(INCLUDE, "mxnet_tpu", "ndarray.hpp")]
+            os.path.join(INCLUDE, "mxnet_tpu", "ndarray.hpp"),
+            _capi_build.__file__]       # recipe changes rebuild too
     newest = max(os.path.getmtime(s) for s in srcs)
     if (os.path.exists(exe) and os.path.exists(lib)
             and os.path.getmtime(exe) > newest
@@ -44,8 +41,7 @@ def _build(cache_dir):
     os.makedirs(cache_dir, exist_ok=True)
     # the ONE compile recipe — shared with setup.py's wheel hook so the
     # tested artifact and the shipped artifact never diverge
-    from incubator_mxnet_tpu._capi_build import build_capi_library
-    build_capi_library(lib, src=CAPI_CC, include_dir=INCLUDE)
+    _capi_build.build_capi_library(lib, src=CAPI_CC, include_dir=INCLUDE)
     subprocess.run(
         ["g++", "-O2", SMOKE_CC, "-I" + INCLUDE, lib,
          "-Wl,-rpath," + cache_dir, "-Wl,-rpath," + _LIBDIR,
