@@ -38,7 +38,11 @@ def sgd_momentum_tree(lr, momentum=0.9, wd=0.0):
     one executable updates every tensor)."""
 
     def init(params):
-        return jax.tree_util.tree_map(jnp.zeros_like, params)
+        # zeros from shape/dtype metadata (NOT zeros_like: the params
+        # may be multi-controller global arrays, and the state is
+        # re-placed onto its own shardings anyway)
+        return jax.tree_util.tree_map(
+            lambda w: jnp.zeros(w.shape, w.dtype), params)
 
     def update(params, grads, state, scale=1.0):
         def upd(w, g, m):
@@ -128,7 +132,7 @@ class ShardedTrainer:
             n: NamedSharding(self.mesh, pspec(n, v.shape))
             for n, v in self.params.items()}
         self.params = {
-            n: jax.device_put(v, self._param_shardings[n])
+            n: self._place_value(v, self._param_shardings[n])
             for n, v in self.params.items()}
         # ZeRO stage 1 (zero=1): per-param optimizer state lives SHARDED
         # along the data axis — the TPU-native form of the reference's
@@ -139,11 +143,29 @@ class ShardedTrainer:
         self._opt_shardings = {
             n: NamedSharding(self.mesh, self._zero_spec(n, v.shape))
             for n, v in self.params.items()}
-        self.opt_state = self._place_opt_tree(
-            self._opt_init(self.params), jax.device_put)
+        # zeros are created DIRECTLY on their shardings (jit with
+        # out_shardings): no full-size host materialisation, so zero=1
+        # init never needs the unsharded state to fit one device
+        opt_shapes = jax.eval_shape(self._opt_init, self.params)
+        opt_out_sh = self._place_opt_tree(opt_shapes,
+                                          lambda leaf, sh: sh)
+        self.opt_state = jax.jit(
+            self._opt_init, out_shardings=opt_out_sh)(self.params)
         self._batch_sharding = NamedSharding(self.mesh, P(batch_axis))
         self._step = None
         self._n_step = 0
+
+    def _place_value(self, value, sharding):
+        """Host value → global array on `sharding`.  Multi-controller:
+        device_put would need cross-host transfers (unsupported on some
+        backends); instead every process fills only its ADDRESSABLE
+        shards from the (identical) host value."""
+        import numpy as _np
+        if jax.process_count() > 1:
+            arr = _np.asarray(value)
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx])
+        return jax.device_put(jnp.asarray(value), sharding)
 
     def _zero_spec(self, name, shape):
         """PartitionSpec for this param's optimizer-state leaves: the
@@ -211,15 +233,30 @@ class ShardedTrainer:
 
         return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
+    def _place_batch(self, arr, sharding):
+        """Single-controller: the full global batch device_puts onto the
+        mesh.  Multi-controller (jax.distributed, mesh spanning
+        processes): each process passes only ITS rows — the per-process
+        shard of the global batch — and the global array is assembled
+        from the process-local data (SURVEY §5.8: multi-host workers
+        each feed their slice, as reference workers read disjoint
+        RecordIO partitions)."""
+        import numpy as _np
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(
+                sharding, _np.asarray(arr))
+        return jax.device_put(jnp.asarray(arr), sharding)
+
     def step(self, batch, labels, rng_bits=None):
-        """batch/labels: jax or numpy arrays (global batch). Returns loss
+        """batch/labels: jax or numpy arrays (global batch; in
+        multi-controller runs, this process's rows of it). Returns loss
         (device scalar — don't block on it every step)."""
         from .. import random as _rnd
         if self._step is None:
             self._step = self._build_step()
-        batch = jax.device_put(jnp.asarray(batch), self._batch_sharding)
-        labels = jax.device_put(jnp.asarray(labels),
-                                NamedSharding(self.mesh, P(self.batch_axis)))
+        batch = self._place_batch(batch, self._batch_sharding)
+        labels = self._place_batch(
+            labels, NamedSharding(self.mesh, P(self.batch_axis)))
         if rng_bits is None:
             rng_bits = jax.random.key_data(_rnd.split_key())
         self.params, self.opt_state, loss = self._step(
@@ -293,14 +330,13 @@ class ShardedTrainer:
                     "expects %s" % (n, tuple(v.shape),
                                     tuple(self.params[n].shape)))
         self.params = {
-            n: jax.device_put(jnp.asarray(v), self._param_shardings[n])
+            n: self._place_value(v, self._param_shardings[n])
             for n, v in params.items()}
 
         # optimizer-state subtrees keyed by param name take the matching
         # state shardings (ZeRO shards under zero=1, else the param
         # shardings); scalars (step counters) replicate
         self.opt_state = self._place_opt_tree(
-            restored["opt_state"],
-            lambda v, sh: jax.device_put(jnp.asarray(v), sh))
+            restored["opt_state"], self._place_value)
         self._n_step = int(restored["n_step"])
         self._step = None          # rebuild with the restored layouts

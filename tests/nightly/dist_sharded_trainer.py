@@ -1,0 +1,83 @@
+"""Multi-CONTROLLER ShardedTrainer: N localhost processes, each owning
+a slice of a global device mesh, train one model in SPMD lockstep
+(ref: the reference's multi-node data-parallel training over ps-lite /
+launched by tools/launch.py; here the TPU-native form — jax.distributed
+coordination + one global Mesh whose collectives compile into the step).
+
+Run per worker (the pytest launcher in test_parallel.py does this):
+
+    DMLC_NUM_WORKER=2 DMLC_WORKER_ID=<r> DMLC_PS_ROOT_PORT=<p> \
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+        python tests/nightly/dist_sharded_trainer.py <out_json>
+
+Each process feeds ITS rows of a deterministic global batch; worker 0
+writes the final loss and a param checksum, which the launcher compares
+against a single-process 8-device run of the same schedule — the
+multi-host result must match the single-host result exactly (same
+global batch, same mesh size, same seeds).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import incubator_mxnet_tpu as mx                       # noqa: E402
+from incubator_mxnet_tpu import nd, gluon, parallel    # noqa: E402
+
+GLOBAL_BATCH = 16
+STEPS = 3
+
+
+def build_trainer():
+    mx.random.seed(31)
+    net = gluon.nn.HybridSequential(prefix="dst_")
+    net.add(gluon.nn.Dense(16, in_units=8, activation="relu",
+                           prefix="dst_d1_"),
+            gluon.nn.Dense(4, in_units=16, prefix="dst_d2_"))
+    net.initialize(force_reinit=True)
+    net(nd.ones((2, 8)))
+    return parallel.ShardedTrainer(net, optimizer="adam", lr=1e-2,
+                                   zero=1)
+
+
+def global_data(step):
+    rs = np.random.RandomState(100 + step)
+    x = rs.randn(GLOBAL_BATCH, 8).astype(np.float32)
+    y = rs.randint(0, 4, GLOBAL_BATCH)
+    return x, y
+
+
+def main():
+    out_path = sys.argv[1] if len(sys.argv) > 1 else None
+    rank = jax.process_index()
+    nproc = jax.process_count()
+    trainer = build_trainer()
+    ndev_global = trainer.mesh.devices.size
+    rows = GLOBAL_BATCH // nproc
+
+    loss = None
+    for i in range(STEPS):
+        x, y = global_data(i)
+        lo, hi = rank * rows, (rank + 1) * rows
+        loss = trainer.step(x[lo:hi], y[lo:hi],
+                            rng_bits=jax.random.key_data(
+                                jax.random.PRNGKey(i)))
+    final_loss = float(loss)
+    checksum = float(sum(float(abs(v).sum())
+                         for v in trainer.params.values()))
+    print("rank %d/%d devices=%d loss=%.6f checksum=%.6f"
+          % (rank, nproc, ndev_global, final_loss, checksum))
+    if rank == 0 and out_path:
+        with open(out_path, "w") as f:
+            json.dump({"loss": final_loss, "checksum": checksum,
+                       "n_devices": ndev_global,
+                       "n_processes": nproc}, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
