@@ -165,3 +165,26 @@ def test_trainer_horovod_slot_custom_reducer():
     assert calls, "custom allreduce_grads was not invoked by step()"
     w = net.weight.data().asnumpy()
     assert onp.allclose(w, -0.5), w
+
+
+def test_op_docs_in_sync(tmp_path):
+    """docs/ops.md is GENERATED from the registry; adding/changing an op
+    must regenerate it (run: python tools/gen_op_docs.py) — the same
+    docs-cannot-drift contract as the reference's dmlc-param docgen."""
+    import os
+    import sys
+    repo = os.path.abspath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "..", ".."))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import gen_op_docs
+    finally:
+        sys.path.pop(0)
+    out = str(tmp_path / "ops.md")
+    gen_op_docs.generate(out)
+    with open(out) as f:
+        fresh = f.read()
+    with open(os.path.join(repo, "docs", "ops.md")) as f:
+        committed = f.read()
+    assert fresh == committed, \
+        "docs/ops.md is stale — run `python tools/gen_op_docs.py`"
