@@ -245,8 +245,10 @@ def run_ssd(batch=8, size=512, warmup=2, iters=10):
     trainer = gluon.Trainer(net.collect_params(), "sgd",
                             {"learning_rate": 0.01, "momentum": 0.9})
     rs = np.random.RandomState(0)
+    # bf16 input: conv weights cast into the activation dtype inside
+    # the program (r4: +15% on this config, 43 -> 50 img/s)
     x = nd.array(rs.randn(batch, 3, size, size).astype(np.float32),
-                 ctx=ctx)
+                 ctx=ctx, dtype="bfloat16")
     # one gt box per image: [cls, x1, y1, x2, y2] normalized
     labels = np.zeros((batch, 1, 5), np.float32)
     labels[:, 0] = [1, 0.2, 0.2, 0.7, 0.7]
@@ -292,8 +294,12 @@ def run_rcnn(batch=2, size=512, warmup=2, iters=10):
     trainer = gluon.Trainer(net.collect_params(), "sgd",
                             {"learning_rate": 1e-3, "momentum": 0.9})
     rs = np.random.RandomState(0)
+    # bf16 input: adjacent-run A/B showed bf16 ~= f32 within run noise
+    # for this config (24.9 vs 23.0 img/s, r4 — proposal/ROI ops
+    # dominate); bf16 kept for dtype consistency with the other convnet
+    # configs
     x = nd.array(rs.randn(batch, 3, size, size).astype(np.float32),
-                 ctx=ctx)
+                 ctx=ctx, dtype="bfloat16")
     im_info = nd.array(np.tile([size, size, 1.0],
                                (batch, 1)).astype(np.float32), ctx=ctx)
     gt = np.zeros((batch, 2, 5), np.float32)
