@@ -615,7 +615,8 @@ _CONFIGS = {
     "rcnn": lambda b=None: _cfg_simple(
         "rcnn_train_images_per_sec", run_rcnn, (2, 1)),
     "gnmt": lambda b=None: _cfg_simple(
-        "gnmt_train_tokens_per_sec", run_gnmt, (128, 32)),
+        "gnmt_train_tokens_per_sec", run_gnmt,
+        (int(b),) if b else (128,)),
     "transformer_nmt": lambda b=None: _cfg_simple(
         "transformer_nmt_train_tokens_per_sec", run_transformer_nmt,
         (int(b),) if b else (64,)),
@@ -632,7 +633,11 @@ _CONFIGS = {
 # batch ladders main() walks one-subprocess-per-attempt (first success
 # wins); configs not listed use their in-process ladders above
 _SUBPROC_BATCHES = {"bert": (32, 16, 8),
-                    "transformer_nmt": (256, 128, 64)}
+                    "transformer_nmt": (256, 128, 64),
+                    # recurrence-bound scan: step time is ~flat in
+                    # batch, so tokens/s scales with it (b512 = 1.26M
+                    # tok/s vs 310k at b128, r4); b1024 dips, b2048 OOMs
+                    "gnmt": (512, 256, 128, 32)}
 
 
 def _cfg_resnet():
