@@ -740,7 +740,10 @@ def main():
                     remaining = budget - (time.perf_counter() - t_start)
                     cap = max(remaining, 60)
                 res = _run_config_subprocess(name, cap, batch=b)
-                if not any(k.endswith("_error") for k in res):
+                # retry on the config's OWN failure key only — a
+                # secondary-metric error (e.g. wide_deep_sparse_path_
+                # error) must not discard a successful headline
+                if (name + "_error") not in res:
                     break
             extra.update(res)
         else:

@@ -57,3 +57,38 @@ def test_dist_sync_kvstore_multiprocess(nworkers):
     assert not fails, "\n\n".join(
         "worker %s rc=%s\n%s" % (r, rc, o.decode(errors="replace")[-3000:])
         for r, rc, o in fails)
+
+
+def test_launch_py_runs_dist_workers():
+    """tools/launch.py (the dmlc local-tracker analogue) must start N
+    coordinated workers end to end — here the nightly dist-kvstore
+    invariants under it, exactly the reference's usage
+    (tools/launch.py -n 2 python dist_sync_kvstore.py)."""
+    import io
+    import sys as _sys
+    repo = os.path.abspath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "..", ".."))
+    _sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import launch as launch_mod
+    finally:
+        _sys.path.pop(0)
+    env_backup = dict(os.environ)
+    out = io.StringIO()
+    try:
+        os.environ["PYTHONPATH"] = repo + os.pathsep + \
+            os.environ.get("PYTHONPATH", "")
+        # workers: 1-device CPU (the worker script also forces the cpu
+        # platform itself; belt and braces for accelerator hosts)
+        os.environ.pop("XLA_FLAGS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        rc = launch_mod.launch(
+            2, [sys.executable,
+                os.path.join(repo, "tests", "nightly",
+                             "dist_sync_kvstore.py")],
+            timeout=300, out=out)
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+    assert rc == 0, "launch.py workers failed:\n%s" % out.getvalue()[-3000:]
+    assert "[0]" in out.getvalue() and "[1]" in out.getvalue()
