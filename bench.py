@@ -725,7 +725,7 @@ def main():
     # optional configs need this much budget left to be worth starting
     # (below it they'd time out AT the budget edge instead of skipping
     # cleanly — int8's quantization calibration alone needs ~4 min cold)
-    optional_min = {"io": 30, "sharded": 90, "int8": 300}
+    optional_min = {"io": 30, "sharded": 90, "int8": 250}
 
     for name in required + optional:
         remaining = budget - (time.perf_counter() - t_start)
