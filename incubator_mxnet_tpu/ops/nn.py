@@ -513,8 +513,10 @@ def fused_linear_softmax_ce(hidden, weight, bias, label, num_chunks=0):
     """Per-row -log softmax(hidden @ weight.T + bias)[label] without
     materialising the (rows, vocab) logits.  hidden: (N, D); weight:
     (V, D) (FullyConnected layout); bias: (V,); label: (N,) int.
-    num_chunks=0 picks the largest power-of-two chunking with chunks of
-    ~1024 rows; N must be divisible by the chunk count."""
+    num_chunks=0 auto-chunks: it picks the largest chunk size in
+    [256, 2048] that divides N (falling back to a single unchunked pass,
+    with a warning when N > 4096, if N has no divisor in that range —
+    e.g. prime N); N must be divisible by the chunk count."""
     n = hidden.shape[0]
     nchunk = int(num_chunks)
     if nchunk <= 0:

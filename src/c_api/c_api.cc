@@ -223,8 +223,7 @@ def _pred_get_output(pred, index):
 def _n_devices():
     import jax
     try:
-        return len([d for d in jax.devices()
-                    if d.platform != 'cpu']) or len(jax.devices())
+        return len([d for d in jax.devices() if d.platform != 'cpu'])
     except Exception:
         return 0
 )PY";
@@ -274,7 +273,14 @@ void capture_py_error() {
   PyErr_Fetch(&type, &value, &tb);
   PyErr_NormalizeException(&type, &value, &tb);
   PyObject *s = value ? PyObject_Str(value) : nullptr;
-  tls_last_error = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+  // AsUTF8 itself can fail (lone surrogates via surrogateescape'd
+  // paths); never assign a nullptr into the std::string
+  const char *msg = s ? PyUnicode_AsUTF8(s) : nullptr;
+  if (msg == nullptr) {
+    PyErr_Clear();
+    msg = "unknown python error";
+  }
+  tls_last_error = msg;
   Py_XDECREF(s);
   Py_XDECREF(type);
   Py_XDECREF(value);
@@ -282,17 +288,43 @@ void capture_py_error() {
   PyErr_Clear();
 }
 
-// Call helper `name` with already-referenced args; steals nothing.
+// Call helper `name`; STEALS the reference to `args` (may be nullptr),
+// releasing it on every path so throwing callers cannot leak the tuple.
 PyObject *call_helper(const char *name, PyObject *args) {
   PyObject *fn = PyDict_GetItemString(g_rt.helpers, name);  // borrowed
-  if (fn == nullptr) throw std::runtime_error("missing helper");
+  if (fn == nullptr) {
+    Py_XDECREF(args);
+    throw std::runtime_error("missing helper");
+  }
   PyObject *out = PyObject_CallObject(fn, args);
+  Py_XDECREF(args);
   if (out == nullptr) {
     capture_py_error();
     throw std::runtime_error(tls_last_error);
   }
   return out;
 }
+
+// PyUnicode_AsUTF8 returns nullptr on non-UTF-8 data; feeding that into
+// std::string is UB, so every conversion funnels through here.
+const char *safe_utf8(PyObject *s) {
+  const char *c = s ? PyUnicode_AsUTF8(s) : nullptr;
+  if (c == nullptr) {
+    PyErr_Clear();
+    throw std::runtime_error("c_api: string is not valid UTF-8");
+  }
+  return c;
+}
+
+// Owning reference guard so result objects are released even when a
+// conversion (e.g. safe_utf8) throws mid-extraction.
+struct PyRef {
+  PyObject *o;
+  explicit PyRef(PyObject *p) : o(p) {}
+  ~PyRef() { Py_XDECREF(o); }
+  PyRef(const PyRef &) = delete;
+  PyRef &operator=(const PyRef &) = delete;
+};
 
 // An NDArray handle owns a python reference + a shape cache for
 // MXNDArrayGetShape pointer stability.
@@ -350,7 +382,6 @@ int MXRandomSeed(int seed) {
   API_BEGIN();
   PyObject *args = Py_BuildValue("(i)", seed);
   PyObject *r = call_helper("_seed", args);
-  Py_DECREF(args);
   Py_DECREF(r);
   API_END();
 }
@@ -364,7 +395,6 @@ int MXNDArrayCreate(const int64_t *shape, int ndim, int dtype,
     PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
   PyObject *args = Py_BuildValue("(Niii)", shp, dtype, dev_type, dev_id);
   PyObject *r = call_helper("_create", args);
-  Py_DECREF(args);
   *out = make_handle(r);
   API_END();
 }
@@ -402,7 +432,6 @@ int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
   PyObject *args = PyTuple_Pack(2, b->obj, mem);
   Py_DECREF(mem);
   PyObject *r = call_helper("_copy_from", args);
-  Py_DECREF(args);
   Py_DECREF(r);
   API_END();
 }
@@ -413,7 +442,6 @@ int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
   HandleBox *b = box_of(handle);
   PyObject *args = PyTuple_Pack(1, b->obj);
   PyObject *bytes = call_helper("_copy_to", args);
-  Py_DECREF(args);
   char *buf;
   Py_ssize_t blen;
   if (PyBytes_AsStringAndSize(bytes, &buf, &blen) != 0) {
@@ -422,20 +450,29 @@ int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
     throw std::runtime_error(tls_last_error);
   }
   Py_ssize_t want = static_cast<Py_ssize_t>(size);
-  // `size` is an element count; blen is bytes.  Copy min(all, size*item)
-  Py_ssize_t item = blen;  // resolve per-element below
+  // `size` is an element count; blen is bytes.  The reference CHECKs the
+  // caller's count against the array's true extent — mirror that (and the
+  // MXPredGetOutput contract in this file) instead of truncating.
   PyObject *dt = PyObject_GetAttrString(b->obj, "dtype");
   PyObject *iszo = dt ? PyObject_GetAttrString(dt, "itemsize") : nullptr;
   Py_XDECREF(dt);
-  if (iszo != nullptr) {
-    item = PyLong_AsLong(iszo);
-    Py_DECREF(iszo);
-  } else {
+  Py_ssize_t item = iszo ? PyLong_AsLong(iszo) : -1;
+  Py_XDECREF(iszo);
+  if (item <= 0) {
     PyErr_Clear();
+    Py_DECREF(bytes);
+    throw std::runtime_error("SyncCopyToCPU: cannot resolve itemsize");
   }
-  Py_ssize_t limit = want * item;
-  if (limit > blen) limit = blen;
-  std::memcpy(data, buf, static_cast<size_t>(limit));
+  if (want * item != blen) {
+    Py_DECREF(bytes);
+    throw std::runtime_error(
+        "SyncCopyToCPU: size mismatch (caller passed " +
+        std::to_string(static_cast<long long>(want)) + " elements = " +
+        std::to_string(static_cast<long long>(want * item)) +
+        " bytes, array holds " +
+        std::to_string(static_cast<long long>(blen)) + " bytes)");
+  }
+  std::memcpy(data, buf, static_cast<size_t>(blen));
   Py_DECREF(bytes);
   API_END();
 }
@@ -463,7 +500,6 @@ int MXNDArrayGetDType(NDArrayHandle handle, int *out) {
   API_BEGIN();
   PyObject *args = PyTuple_Pack(1, box_of(handle)->obj);
   PyObject *r = call_helper("_dtype_code", args);
-  Py_DECREF(args);
   *out = static_cast<int>(PyLong_AsLong(r));
   Py_DECREF(r);
   API_END();
@@ -475,7 +511,6 @@ int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
   API_BEGIN();
   PyObject *args = PyTuple_Pack(1, box_of(handle)->obj);
   PyObject *r = call_helper("_context", args);
-  Py_DECREF(args);
   *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 0)));
   *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
   Py_DECREF(r);
@@ -523,7 +558,6 @@ int MXImperativeInvoke(const char *op_name, int num_inputs,
   }
   PyObject *args = Py_BuildValue("(sNNN)", op_name, ins, keys, vals);
   PyObject *r = call_helper("_invoke", args);
-  Py_DECREF(args);
   Py_ssize_t n = PyList_Size(r);
   tls_handles.clear();
   for (Py_ssize_t i = 0; i < n; ++i) {
@@ -540,13 +574,12 @@ int MXImperativeInvoke(const char *op_name, int num_inputs,
 int MXListAllOpNames(int *out_size, const char ***out_array) {
   tls_last_error.clear();
   API_BEGIN();
-  PyObject *r = call_helper("_list_ops", nullptr);
-  Py_ssize_t n = PyList_Size(r);
+  PyRef r(call_helper("_list_ops", nullptr));
+  Py_ssize_t n = PyList_Size(r.o);
   tls_strings.clear();
   tls_cstrs.clear();
   for (Py_ssize_t i = 0; i < n; ++i)
-    tls_strings.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(r, i)));
-  Py_DECREF(r);
+    tls_strings.emplace_back(safe_utf8(PyList_GET_ITEM(r.o, i)));
   for (auto &s : tls_strings) tls_cstrs.push_back(s.c_str());
   *out_size = static_cast<int>(n);
   *out_array = tls_cstrs.data();
@@ -574,7 +607,6 @@ int MXNDArraySave(const char *fname, uint32_t num_args,
   }
   PyObject *args = Py_BuildValue("(sNN)", fname, arrs, pykeys);
   PyObject *r = call_helper("_save", args);
-  Py_DECREF(args);
   Py_DECREF(r);
   API_END();
 }
@@ -585,24 +617,24 @@ int MXNDArrayLoad(const char *fname, uint32_t *out_size,
   tls_last_error.clear();
   API_BEGIN();
   PyObject *args = Py_BuildValue("(s)", fname);
-  PyObject *r = call_helper("_load", args);
-  Py_DECREF(args);
-  PyObject *arrs = PyTuple_GET_ITEM(r, 0);
-  PyObject *names = PyTuple_GET_ITEM(r, 1);
+  PyRef r(call_helper("_load", args));
+  PyObject *arrs = PyTuple_GET_ITEM(r.o, 0);
+  PyObject *names = PyTuple_GET_ITEM(r.o, 1);
   Py_ssize_t n = PyList_Size(arrs);
   Py_ssize_t nn = PyList_Size(names);
   tls_handles.clear();
   tls_strings.clear();
   tls_cstrs.clear();
+  // convert names BEFORE minting handles: safe_utf8 can throw, and a
+  // throw after handles exist would leak them (caller never sees them)
+  for (Py_ssize_t i = 0; i < nn; ++i)
+    tls_strings.emplace_back(safe_utf8(PyList_GET_ITEM(names, i)));
+  for (auto &s : tls_strings) tls_cstrs.push_back(s.c_str());
   for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject *o = PyList_GET_ITEM(arrs, i);
     Py_INCREF(o);
     tls_handles.push_back(make_handle(o));
   }
-  for (Py_ssize_t i = 0; i < nn; ++i)
-    tls_strings.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(names, i)));
-  for (auto &s : tls_strings) tls_cstrs.push_back(s.c_str());
-  Py_DECREF(r);
   *out_size = static_cast<uint32_t>(n);
   *out_arr = tls_handles.data();
   *out_name_size = static_cast<uint32_t>(nn);
@@ -615,7 +647,6 @@ int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
   API_BEGIN();
   PyObject *args = Py_BuildValue("(s)", fname);
   PyObject *r = call_helper("_sym_from_file", args);
-  Py_DECREF(args);
   *out = make_handle(r);
   API_END();
 }
@@ -625,7 +656,6 @@ int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
   API_BEGIN();
   PyObject *args = Py_BuildValue("(s)", json);
   PyObject *r = call_helper("_sym_from_json", args);
-  Py_DECREF(args);
   *out = make_handle(r);
   API_END();
 }
@@ -633,10 +663,9 @@ int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
 int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json) {
   tls_last_error.clear();
   API_BEGIN();
-  PyObject *r = PyObject_CallMethod(box_of(sym)->obj, "tojson", nullptr);
-  if (r == nullptr) { capture_py_error(); throw std::runtime_error(tls_last_error); }
-  tls_json = PyUnicode_AsUTF8(r);
-  Py_DECREF(r);
+  PyRef r(PyObject_CallMethod(box_of(sym)->obj, "tojson", nullptr));
+  if (r.o == nullptr) { capture_py_error(); throw std::runtime_error(tls_last_error); }
+  tls_json = safe_utf8(r.o);
   *out_json = tls_json.c_str();
   API_END();
 }
@@ -644,10 +673,9 @@ int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json) {
 int MXSymbolGetName(SymbolHandle sym, const char **out) {
   tls_last_error.clear();
   API_BEGIN();
-  PyObject *r = PyObject_GetAttrString(box_of(sym)->obj, "name");
-  if (r == nullptr) { capture_py_error(); throw std::runtime_error(tls_last_error); }
-  tls_json = (r == Py_None) ? "" : PyUnicode_AsUTF8(r);
-  Py_DECREF(r);
+  PyRef r(PyObject_GetAttrString(box_of(sym)->obj, "name"));
+  if (r.o == nullptr) { capture_py_error(); throw std::runtime_error(tls_last_error); }
+  tls_json = (r.o == Py_None) ? "" : safe_utf8(r.o);
   *out = tls_json.c_str();
   API_END();
 }
@@ -680,7 +708,6 @@ int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
   PyObject *args = Py_BuildValue("(sNiiNN)", symbol_json_str, blob,
                                  dev_type, dev_id, keys, shapes);
   PyObject *r = call_helper("_pred_create", args);
-  Py_DECREF(args);
   *out = make_handle(r);
   API_END();
 }
@@ -694,7 +721,6 @@ int MXPredSetInput(PredictorHandle handle, const char *key,
       static_cast<Py_ssize_t>(size) * 4, PyBUF_READ);
   PyObject *args = Py_BuildValue("(OsN)", box_of(handle)->obj, key, mem);
   PyObject *r = call_helper("_pred_set_input", args);
-  Py_DECREF(args);
   Py_DECREF(r);
   API_END();
 }
@@ -704,7 +730,6 @@ int MXPredForward(PredictorHandle handle) {
   API_BEGIN();
   PyObject *args = PyTuple_Pack(1, box_of(handle)->obj);
   PyObject *r = call_helper("_pred_forward", args);
-  Py_DECREF(args);
   Py_DECREF(r);
   API_END();
 }
@@ -718,7 +743,6 @@ int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
   API_BEGIN();
   PyObject *args = Py_BuildValue("(OI)", box_of(handle)->obj, index);
   PyObject *r = call_helper("_pred_out_shape", args);
-  Py_DECREF(args);
   Py_ssize_t n = PyTuple_Size(r);
   tls_u32_shape.resize(static_cast<size_t>(n));
   for (Py_ssize_t i = 0; i < n; ++i)
@@ -736,7 +760,6 @@ int MXPredGetOutput(PredictorHandle handle, uint32_t index, float *data,
   API_BEGIN();
   PyObject *args = Py_BuildValue("(OI)", box_of(handle)->obj, index);
   PyObject *bytes = call_helper("_pred_get_output", args);
-  Py_DECREF(args);
   char *buf;
   Py_ssize_t blen;
   if (PyBytes_AsStringAndSize(bytes, &buf, &blen) != 0) {
