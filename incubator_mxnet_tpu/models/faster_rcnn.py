@@ -15,8 +15,8 @@ from __future__ import annotations
 from ..gluon.block import HybridBlock
 from ..gluon import nn
 
-__all__ = ["FasterRCNN", "faster_rcnn_toy", "rcnn_training_targets",
-           "RCNNTrainLoss"]
+__all__ = ["FasterRCNN", "faster_rcnn_toy", "faster_rcnn_resnet50_v1b",
+           "rcnn_training_targets", "RCNNTrainLoss"]
 
 
 def _conv_block(channels, stride=1):
@@ -41,7 +41,8 @@ class FasterRCNN(HybridBlock):
                  feature_stride=8, rpn_channels=64,
                  anchor_scales=(2, 4), anchor_ratios=(0.5, 1, 2),
                  rpn_pre_nms_top_n=256, rpn_post_nms_top_n=64,
-                 rpn_min_size=4, roi_size=7, top_units=128, **kwargs):
+                 rpn_min_size=4, roi_size=7, top_units=128,
+                 features=None, top_features=None, **kwargs):
         super().__init__(**kwargs)
         self._classes = classes
         self._stride = feature_stride
@@ -53,22 +54,34 @@ class FasterRCNN(HybridBlock):
         self._roi = roi_size
         num_anchors = len(anchor_scales) * len(anchor_ratios)
 
-        # backbone: simple strided conv stack (stride = prod of 2s)
-        import math
-        n_down = int(math.log2(feature_stride))
-        self.features = nn.HybridSequential()
-        for i, ch in enumerate(backbone_channels):
-            self.features.add(_conv_block(ch, stride=2 if i < n_down
-                                          else 1))
+        if features is not None:
+            # externally supplied backbone (e.g. resnet50_v1b stages
+            # 1-3), mirroring the reference's pretrained-backbone
+            # assembly (ref: example/rcnn/symdata resnet conv4 feature)
+            self.features = features
+        else:
+            # toy backbone: simple strided conv stack (stride = prod 2s)
+            import math
+            n_down = int(math.log2(feature_stride))
+            self.features = nn.HybridSequential()
+            for i, ch in enumerate(backbone_channels):
+                self.features.add(_conv_block(ch, stride=2 if i < n_down
+                                              else 1))
         # RPN
         self.rpn_conv = nn.Conv2D(rpn_channels, kernel_size=3, padding=1,
                                   activation="relu")
         self.rpn_cls = nn.Conv2D(2 * num_anchors, kernel_size=1)
         self.rpn_box = nn.Conv2D(4 * num_anchors, kernel_size=1)
-        # heads
-        self.top = nn.HybridSequential()
-        self.top.add(nn.Dense(top_units, activation="relu"),
-                     nn.Dense(top_units, activation="relu"))
+        # heads: a conv `top_features` (e.g. resnet stage 4 + global avg
+        # pool, the reference's conv5 head) consumes the 4-D pooled
+        # rois; the default dense top consumes them flattened
+        self._conv_top = top_features is not None
+        if self._conv_top:
+            self.top = top_features
+        else:
+            self.top = nn.HybridSequential()
+            self.top.add(nn.Dense(top_units, activation="relu"),
+                         nn.Dense(top_units, activation="relu"))
         self.cls_head = nn.Dense(classes + 1)
         self.box_head = nn.Dense(4 * (classes + 1))
 
@@ -111,7 +124,11 @@ class FasterRCNN(HybridBlock):
         pooled = F.invoke("ROIAlign", feat, rois,
                           pooled_size=(self._roi, self._roi),
                           spatial_scale=1.0 / self._stride)
-        top = self.top(F.reshape(pooled, (pooled.shape[0], -1)))
+        if self._conv_top:
+            top = self.top(pooled)
+            top = F.reshape(top, (top.shape[0], -1))
+        else:
+            top = self.top(F.reshape(pooled, (pooled.shape[0], -1)))
         cls_pred = self.cls_head(top)
         box_pred = self.box_head(top)
         if target is not None:
@@ -132,6 +149,36 @@ def rcnn_training_targets(rois, gt_boxes, num_classes,
                     batch_images=int(gt_boxes.shape[0]),
                     batch_rois=batch_rois, fg_fraction=fg_fraction,
                     fg_overlap=fg_overlap)
+
+
+def faster_rcnn_resnet50_v1b(classes=20, **kwargs):
+    """Config-3b headline geometry: Faster-RCNN on resnet50_v1b — the
+    backbone the reference benchmarks (ref: example/rcnn resnet
+    symbol: conv1-conv4 as the shared feature, conv5 as the per-roi
+    head; GluonCV faster_rcnn_resnet50_v1b).  Stages 1-3 (stride 16,
+    1024 ch) feed the RPN; stage 4 + global average pooling is the
+    per-roi top — ROIAlign at 14x14, stage 4's stride-2 takes it to
+    7x7, pooled to a 2048-vector per roi.
+
+    TPU-first: proposals are the padded mask-based NMS over the top
+    2000 anchors, sampling keeps rois fixed-shape, so the whole train
+    graph is one XLA executable at ~600x800 input."""
+    from ..gluon.model_zoo.vision import resnet50_v1b
+    base = resnet50_v1b()
+    features = nn.HybridSequential()
+    for i in range(7):          # stem (conv, bn, relu, pool) + stages 1-3
+        features.add(base.features[i])
+    top = nn.HybridSequential()
+    top.add(base.features[7])   # stage 4 (stride 2: 14x14 roi -> 7x7)
+    from ..gluon.nn import GlobalAvgPool2D
+    top.add(GlobalAvgPool2D())
+    kwargs.setdefault("rpn_pre_nms_top_n", 2000)
+    kwargs.setdefault("rpn_post_nms_top_n", 1000)
+    return FasterRCNN(classes, features=features, top_features=top,
+                      feature_stride=16, rpn_channels=512,
+                      anchor_scales=(8, 16, 32),
+                      anchor_ratios=(0.5, 1, 2),
+                      rpn_min_size=16, roi_size=14, **kwargs)
 
 
 def faster_rcnn_toy(classes=3, **kwargs):

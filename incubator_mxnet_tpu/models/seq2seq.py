@@ -20,7 +20,7 @@ from __future__ import annotations
 from ..gluon.block import HybridBlock
 from ..gluon import nn, rnn
 
-__all__ = ["Seq2Seq", "gnmt_sym_gen"]
+__all__ = ["Seq2Seq", "GNMT", "gnmt_large", "gnmt_sym_gen"]
 
 
 class Seq2Seq(HybridBlock):
@@ -62,6 +62,103 @@ class Seq2Seq(HybridBlock):
         ctx = F.batch_dot(attn, k)                            # (B, Tt, H)
         mix = self.att_dense(ctx) + q
         return self.proj(mix)                                 # (B, Tt, V)
+
+
+class GNMT(HybridBlock):
+    """GNMT-architecture LSTM seq2seq at reference geometry (BASELINE
+    config 4 headline model; the small `Seq2Seq` above stays as the
+    test/smoke model).
+
+    Parity target: the Sockeye GNMT config on the reference — a
+    bidirectional bottom encoder layer, residual unidirectional layers
+    above it, a deep unidirectional decoder initialised from the
+    encoder state, and Luong dot attention over encoder outputs (ref:
+    Sockeye GNMT config over the reference's fused RNN op
+    src/operator/rnn.cc; GNMT paper arch — bi bottom layer, residuals
+    from the 3rd layer).
+
+    TPU-first notes: every LSTM layer is one `lax.scan` over the fused
+    RNN op (gates batched into a single (B, 4H) matmul per step — MXU-
+    shaped at large batch); attention is two batched matmuls; with
+    ``output_hidden=True`` the vocab projection is fused into the
+    chunked softmax-CE (`FusedMLMCELoss`) so the (B·T, 32k) logits
+    never materialise.
+
+    src/tgt: (B, Ts)/(B, Tt) int ids.  Returns logits (B, Tt, vocab),
+    or the pre-projection mix (B, Tt, H) with ``output_hidden=True``.
+    ``src_valid_len`` (B,) optionally masks attention over source pad
+    positions.
+    """
+
+    def __init__(self, src_vocab, tgt_vocab, embed_dim=1024, hidden=1024,
+                 enc_layers=4, dec_layers=4, output_hidden=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        assert enc_layers >= 2, "GNMT: bi bottom layer + >=1 uni layer"
+        self._hidden = hidden
+        self._dec_layers = dec_layers
+        self._output_hidden = output_hidden
+        self.src_embed = nn.Embedding(src_vocab, embed_dim)
+        self.tgt_embed = nn.Embedding(tgt_vocab, embed_dim)
+        # bottom layer reads the source in both directions
+        self.enc_bi = rnn.LSTM(hidden, num_layers=1, bidirectional=True,
+                               layout="TNC")
+        # unidirectional stack above it; residual adds once widths
+        # match (GNMT: residuals from the 3rd layer)
+        self._uni = []
+        for i in range(enc_layers - 1):
+            layer = rnn.LSTM(hidden, num_layers=1, layout="TNC")
+            setattr(self, "enc_uni%d" % i, layer)
+            self._uni.append(layer)
+        self.decoder = rnn.LSTM(hidden, num_layers=dec_layers,
+                                layout="TNC")
+        self.att_dense = nn.Dense(hidden, flatten=False, use_bias=False)
+        if not output_hidden:
+            self.proj = nn.Dense(tgt_vocab, flatten=False)
+
+    def forward(self, src, tgt, src_valid_len=None):
+        from .. import ndarray as F
+        B = src.shape[0]
+        x = self.src_embed(src).transpose((1, 0, 2))        # (Ts, B, E)
+        h, _ = self.enc_bi(x, self.enc_bi.begin_state(batch_size=B))
+        states = None                                       # (Ts, B, 2H)
+        for i, layer in enumerate(self._uni):
+            out, states = layer(h, layer.begin_state(batch_size=B))
+            # first uni layer narrows 2H -> H (no residual possible)
+            h = out if i == 0 else out + h
+        # decoder recurrence starts from the top encoder layer's final
+        # (h, c), tiled across decoder layers, so source information
+        # flows through the state path as well as the attention readout
+        dh = F.concat(*([states[0]] * self._dec_layers), dim=0)
+        dc = F.concat(*([states[1]] * self._dec_layers), dim=0)
+        d_in = self.tgt_embed(tgt).transpose((1, 0, 2))     # (Tt, B, E)
+        dec_out, _ = self.decoder(d_in, [dh, dc])           # (Tt, B, H)
+        q = dec_out.transpose((1, 0, 2))                    # (B, Tt, H)
+        k = h.transpose((1, 0, 2))                          # (B, Ts, H)
+        scores = F.batch_dot(q, k, transpose_b=True) \
+            * (1.0 / float(self._hidden) ** 0.5)            # (B, Tt, Ts)
+        if src_valid_len is not None:
+            # additive -1e9 over source pad columns
+            Ts = k.shape[1]
+            pos = F.arange(0, Ts).reshape((1, 1, Ts))
+            invalid = pos >= src_valid_len.reshape((B, 1, 1))
+            scores = scores + invalid * -1e9
+        attn = F.softmax(scores, axis=-1)
+        ctx = F.batch_dot(attn, k)                          # (B, Tt, H)
+        mix = self.att_dense(ctx) + q
+        if self._output_hidden:
+            return mix
+        return self.proj(mix)                               # (B, Tt, V)
+
+
+def gnmt_large(src_vocab=32000, tgt_vocab=32000, **kwargs):
+    """Config-4 headline geometry: 4x1024 encoder (bi bottom), 4x1024
+    decoder, 1024 embeddings, 32k vocab (~175M params)."""
+    kwargs.setdefault("embed_dim", 1024)
+    kwargs.setdefault("hidden", 1024)
+    kwargs.setdefault("enc_layers", 4)
+    kwargs.setdefault("dec_layers", 4)
+    return GNMT(src_vocab, tgt_vocab, **kwargs)
 
 
 def gnmt_sym_gen(vocab, embed_dim=32, hidden=64, num_layers=1):

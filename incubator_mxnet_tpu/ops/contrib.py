@@ -13,6 +13,8 @@ masks (no host loop, jittable).
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as _np
@@ -322,60 +324,92 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
 # ---------------------------------------------------------------------------
 
 
+_ROI_CHUNK = 32
+
+
 @register("ROIAlign", ndarray_inputs=("data", "rois"), nograd_argnums=(1,),
           jit=True)
 def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
               sample_ratio=2, position_sensitive=False, aligned=False):
     """ref: contrib/roi_align.cc — bilinear-sampled ROI pooling.
-    data (B, C, H, W); rois (R, 5) [batch_idx, x1, y1, x2, y2]."""
+    data (B, C, H, W); rois (R, 5) [batch_idx, x1, y1, x2, y2].
+
+    TPU-first: bilinear sampling is SEPARABLE, so instead of 4-tap
+    gathers per sample point (the r4 implementation — 58 ms fwd for
+    256 rois on a (2, 1024, 38, 50) map, plus a scatter-heavy
+    backward), each roi builds two tiny interpolation matrices
+    Wy (PH·S, H) / Wx (PW·S, W) and the sampling becomes three
+    einsums (batch one-hot select, y-contract, x-contract) — all MXU
+    matmuls, gather/scatter-free in both directions.  Rois run in
+    chunks of 32 under `lax.scan` to bound the (chunk, C, PH·S, W)
+    intermediate.  A padded roi (batch_idx -1) one-hot-selects
+    nothing and pools to exact zeros."""
     PH, PW = pooled_size
     S = max(1, int(sample_ratio))
     offset = 0.5 if aligned else 0.0
+    B, C, H, W = data.shape
+    R = rois.shape[0]
 
-    def one_roi(roi):
-        b = roi[0].astype(jnp.int32)
+    def weights_1d(coords, n):
+        """(P,) sample coords → (P, n) bilinear row weights, with the
+        reference's edge semantics: taps floor/floor+1 clipped into
+        range, whole row zeroed outside [-1, n]."""
+        c0 = jnp.floor(coords)
+        w1 = coords - c0
+        w0 = 1.0 - w1
+        i0 = jnp.clip(c0.astype(jnp.int32), 0, n - 1)
+        i1 = jnp.clip(c0.astype(jnp.int32) + 1, 0, n - 1)
+        inb = (coords >= -1) & (coords <= n)
+        idx = jnp.arange(n)
+        wm = (w0[:, None] * (idx[None, :] == i0[:, None]) +
+              w1[:, None] * (idx[None, :] == i1[:, None]))
+        return jnp.where(inb[:, None], wm, 0.0)
+
+    def one_roi_mats(roi):
         x1 = roi[1] * spatial_scale - offset
         y1 = roi[2] * spatial_scale - offset
         x2 = roi[3] * spatial_scale - offset
         y2 = roi[4] * spatial_scale - offset
         rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
         rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
-        bin_w = rw / PW
-        bin_h = rh / PH
-        img = data[b]                      # (C, H, W)
-        # sample grid: (PH*S, PW*S)
-        ys = y1 + (jnp.arange(PH * S) + 0.5) * (bin_h / S)
-        xs = x1 + (jnp.arange(PW * S) + 0.5) * (bin_w / S)
-        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
-        sampled = _bilinear_sample(img, gy, gx)   # (C, PH*S, PW*S)
-        C = sampled.shape[0]
-        pooled = sampled.reshape(C, PH, S, PW, S).mean(axis=(2, 4))
-        return pooled
+        ys = y1 + (jnp.arange(PH * S) + 0.5) * (rh / PH / S)
+        xs = x1 + (jnp.arange(PW * S) + 0.5) * (rw / PW / S)
+        b = roi[0].astype(jnp.int32)
+        bh = (jnp.arange(B) == b).astype(jnp.float32)
+        return bh, weights_1d(ys, H), weights_1d(xs, W)
 
-    return jax.vmap(one_roi)(rois)
+    bh, wy, wx = jax.vmap(one_roi_mats)(rois)
+    # the S×S sample mean is linear — fold it into the matrices, so
+    # the contractions produce the POOLED (PH, PW) output directly
+    wy = wy.reshape(R, PH, S, H).mean(axis=2)
+    wx = wx.reshape(R, PW, S, W).mean(axis=2)
+    ch = min(_ROI_CHUNK, R)
+    rpad = ((R + ch - 1) // ch) * ch
+    bh = jnp.pad(bh, ((0, rpad - R), (0, 0)))
+    wy = jnp.pad(wy, ((0, rpad - R), (0, 0), (0, 0)))
+    wx = jnp.pad(wx, ((0, rpad - R), (0, 0), (0, 0)))
+    nc = rpad // ch
+    # bf16 features: bf16 operands + f32 MXU accumulation.  f32
+    # features need Precision.HIGHEST — the MXU's default truncates
+    # f32 operands to bf16 (preferred_element_type only widens the
+    # accumulator), which would silently cost ~3 decimal digits
+    odt = data.dtype if data.dtype != jnp.float64 else jnp.float32
+    prec = (lax.Precision.HIGHEST if odt == jnp.float32 else None)
+    ein = functools.partial(jnp.einsum, precision=prec,
+                            preferred_element_type=jnp.float32)
 
+    def chunk_fn(_, mats):
+        bhc, wyc, wxc = mats
+        img = ein("rb,bchw->rchw", bhc.astype(odt), data)
+        t = ein("rph,rchw->rcpw", wyc.astype(odt), img.astype(odt))
+        s = ein("rqw,rcpw->rcpq", wxc.astype(odt), t.astype(odt))
+        return None, s.astype(data.dtype)
 
-def _bilinear_sample(img, gy, gx):
-    """img (C, H, W); gy/gx sample coords → (C, *grid)."""
-    C, H, W = img.shape
-    y0 = jnp.floor(gy)
-    x0 = jnp.floor(gx)
-    y1 = y0 + 1
-    x1 = x0 + 1
-    wy1 = gy - y0
-    wx1 = gx - x0
-    wy0 = 1.0 - wy1
-    wx0 = 1.0 - wx1
-
-    def gather(yy, xx):
-        yi = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
-        xi = jnp.clip(xx.astype(jnp.int32), 0, W - 1)
-        return img[:, yi, xi]
-
-    out = (gather(y0, x0) * (wy0 * wx0) + gather(y0, x1) * (wy0 * wx1) +
-           gather(y1, x0) * (wy1 * wx0) + gather(y1, x1) * (wy1 * wx1))
-    inb = ((gy >= -1) & (gy <= H) & (gx >= -1) & (gx <= W))
-    return jnp.where(inb, out, 0.0)
+    _, out = lax.scan(chunk_fn, None,
+                      (bh.reshape(nc, ch, B),
+                       wy.reshape(nc, ch, PH, H),
+                       wx.reshape(nc, ch, PW, W)))
+    return out.reshape(rpad, C, PH, PW)[:R]
 
 
 @register("ROIPooling", ndarray_inputs=("data", "rois"), nograd_argnums=(1,),

@@ -52,33 +52,73 @@ def _bbox_transform_inv(boxes, deltas):
                      axis=-1)
 
 
+_NMS_BLOCK = 256
+
+
 def _nms_keep(boxes, scores, thresh, topk):
     """Greedy NMS over score-sorted boxes; returns indices into the
-    sorted order with -1 padding (fixed length topk)."""
+    sorted order with -1 padding (fixed length topk).
+
+    TPU-first: a per-box `fori_loop` is a serial chain of N tiny steps
+    (the r4 implementation — ~200 ms at N=2000, the whole Faster-RCNN
+    step budget).  This is the blocked-exact formulation (the same
+    move as TF's TPU non_max_suppression_padded): one (N, N) pairwise
+    IoU matrix up front (MXU work), then a sequential loop over
+    N/256 BLOCKS; earlier blocks' verdicts are final, so each block
+    only needs (a) suppression by decided-alive earlier boxes — one
+    masked reduction — and (b) the within-block greedy fixpoint
+    `a[j] = a0[j] & !any_i(sup[i, j] & a[i])`, which converges to the
+    exact greedy solution in at most chain-depth iterations (a
+    `while_loop`, typically 2-5).  Sequential depth falls from N to
+    ~N/256 × ~4; results are bit-identical to the per-box loop
+    (test_rcnn parity test)."""
     order = jnp.argsort(-scores)
     b = boxes[order]
     n = b.shape[0]
+    B = min(_NMS_BLOCK, n)
+    npad = ((n + B - 1) // B) * B
+    nb = npad // B
+    bp = jnp.pad(b, ((0, npad - n), (0, 0)))
 
-    area = jnp.maximum(b[:, 2] - b[:, 0] + 1, 0) * \
-        jnp.maximum(b[:, 3] - b[:, 1] + 1, 0)
+    area = jnp.maximum(bp[:, 2] - bp[:, 0] + 1, 0) * \
+        jnp.maximum(bp[:, 3] - bp[:, 1] + 1, 0)
+    tl = jnp.maximum(bp[:, None, :2], bp[None, :, :2])
+    br = jnp.minimum(bp[:, None, 2:4], bp[None, :, 2:4])
+    wh = jnp.maximum(br - tl + 1, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter,
+                              1e-12)
+    sup = iou > thresh                       # (npad, npad)
+    valid = jnp.arange(npad) < n
 
-    def iou_row(i):
-        tl = jnp.maximum(b[i, :2], b[:, :2])
-        br = jnp.minimum(b[i, 2:4], b[:, 2:4])
-        wh = jnp.maximum(br - tl + 1, 0)
-        inter = wh[:, 0] * wh[:, 1]
-        return inter / jnp.maximum(area[i] + area - inter, 1e-12)
+    def block_body(k, alive):
+        lo = k * B
+        blk0 = lax.dynamic_slice(alive, (lo,), (B,))
+        # (a) suppression by FINAL earlier-box verdicts: cols of this
+        # block vs every decided alive box before it
+        sup_cols = lax.dynamic_slice(sup, (0, lo), (npad, B))
+        decided = (jnp.arange(npad) < lo) & alive
+        blk0 = blk0 & ~jnp.any(sup_cols & decided[:, None], axis=0)
+        # (b) within-block greedy fixpoint (i < j suppression only)
+        m = lax.dynamic_slice(sup, (lo, lo), (B, B)) & \
+            (jnp.arange(B)[:, None] < jnp.arange(B)[None, :])
 
-    def body(i, keep):
-        alive = keep[i]
-        ious = iou_row(i)
-        suppress = (ious > thresh) & (jnp.arange(n) > i) & alive
-        return keep & ~suppress
+        def fix_cond(st):
+            a, prev, it = st
+            return jnp.any(a != prev) & (it < B)
 
-    keep0 = jnp.ones((n,), bool)
-    keep = lax.fori_loop(0, n, body, keep0)
+        def fix_body(st):
+            a, _, it = st
+            return (blk0 & ~jnp.any(m & a[:, None], axis=0), a, it + 1)
+
+        a, _, _ = lax.while_loop(
+            fix_cond, fix_body,
+            (blk0, jnp.zeros_like(blk0), jnp.int32(0)))
+        return lax.dynamic_update_slice(alive, a, (lo,))
+
+    keep = lax.fori_loop(0, nb, block_body, valid)
     # first topk kept indices (positions in sorted order), -1 padded
-    idx_sorted = jnp.nonzero(keep, size=topk, fill_value=-1)[0]
+    idx_sorted = jnp.nonzero(keep[:n], size=topk, fill_value=-1)[0]
     return order, idx_sorted
 
 

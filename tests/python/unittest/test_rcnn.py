@@ -206,3 +206,46 @@ def test_rcnn_train_loss_block_matches_eager():
     got = lb(cls_pred, box_pred, labels, targets, weights)
     onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(),
                                 rtol=1e-5, atol=1e-6)
+
+
+def test_blocked_nms_matches_sequential_greedy():
+    """The r5 blocked-exact NMS (TPU: sequential depth N/256 instead
+    of N) must be bit-identical to the per-box greedy loop it
+    replaced (ref: proposal.cc NMS semantics)."""
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops.rcnn import _nms_keep
+
+    def greedy_np(boxes, scores, thresh, topk):
+        order = onp.argsort(-scores)
+        b = boxes[order]
+        n = len(b)
+        area = onp.maximum(b[:, 2] - b[:, 0] + 1, 0) * \
+            onp.maximum(b[:, 3] - b[:, 1] + 1, 0)
+        keep = onp.ones(n, bool)
+        for i in range(n):
+            if not keep[i]:
+                continue
+            tl = onp.maximum(b[i, :2], b[:, :2])
+            br = onp.minimum(b[i, 2:4], b[:, 2:4])
+            wh = onp.maximum(br - tl + 1, 0)
+            inter = wh[:, 0] * wh[:, 1]
+            iou = inter / onp.maximum(area[i] + area - inter, 1e-12)
+            keep &= ~((iou > thresh) & (onp.arange(n) > i))
+        idx = onp.where(keep)[0][:topk]
+        return order, onp.pad(idx, (0, topk - len(idx)),
+                              constant_values=-1)
+
+    rs = onp.random.RandomState(7)
+    # n spans below/at/above the 256 block size (incl. non-multiples)
+    for n in (40, 256, 391, 700):
+        ctr = rs.rand(n, 2) * 200
+        wh = rs.rand(n, 2) * 80 + 5
+        boxes = onp.concatenate([ctr - wh / 2, ctr + wh / 2],
+                                axis=1).astype(onp.float32)
+        scores = rs.rand(n).astype(onp.float32)
+        for thresh in (0.3, 0.7):
+            o_ref, k_ref = greedy_np(boxes, scores, thresh, 64)
+            o_got, k_got = _nms_keep(jnp.asarray(boxes),
+                                     jnp.asarray(scores), thresh, 64)
+            onp.testing.assert_array_equal(onp.asarray(o_got), o_ref)
+            onp.testing.assert_array_equal(onp.asarray(k_got), k_ref)
