@@ -472,10 +472,12 @@ def run_wide_deep(batch=2048, fields=16, warmup=3, iters=40,
     Headline = the TPU-native path: dense-gather embedding gradients,
     hybridized → ONE fused train-step executable (the r4 profiler
     showed the old eager sparse-path bench spending its whole step on
-    per-op dispatch).  sparse=True measures the row_sparse gradient
-    path (parity with the reference's example/sparse/wide_deep CPU/PS
-    design — supported, exercised by test_sparse, but not how one
-    feeds a TPU: a 100k x 16 table's dense grad is 6 MB)."""
+    per-op dispatch).  sparse=True measures the row_sparse lazy-update
+    path (parity with the reference's example/sparse/wide_deep
+    FComputeEx design) via the r5 `BucketedSparseTrainer`: device-side
+    unique-row buckets + sentinel-row lazy updates, ONE executable per
+    bucket — the vocab-sized dense gradient never exists, which is the
+    path that scales to million-row vocabularies."""
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, gluon, autograd as ag
     from incubator_mxnet_tpu.models import wide_deep
@@ -485,17 +487,31 @@ def run_wide_deep(batch=2048, fields=16, warmup=3, iters=40,
     net = wide_deep(num_features=num_features, embed_dim=16,
                     sparse_grad=sparse)
     net.initialize(ctx=ctx)
-    if not sparse:
-        net.hybridize(static_alloc=True, static_shape=True)
-    trainer = gluon.Trainer(net.collect_params(), "adam",
-                            {"learning_rate": 1e-3})
-    sce = gluon.loss.SoftmaxCrossEntropyLoss()
-    sce.hybridize()
     rs = np.random.RandomState(0)
     idx = nd.array(rs.randint(0, num_features, (batch, fields)),
                    ctx=ctx, dtype="int32")
     vals = nd.array(rs.rand(batch, fields).astype(np.float32), ctx=ctx)
     y = nd.array(rs.randint(0, 2, batch).astype(np.float32), ctx=ctx)
+
+    if sparse:
+        from incubator_mxnet_tpu.contrib.sparse_jit import \
+            BucketedSparseTrainer
+        net(idx, vals)                  # materialize deferred shapes
+        jt = BucketedSparseTrainer(net, optimizer="adam", lr=1e-3)
+        for _ in range(warmup):
+            loss = jt.step(idx, vals, y)
+        float(loss.asnumpy())           # honest D2H sync
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = jt.step(idx, vals, y)
+        float(loss.asnumpy())
+        return batch * iters / (time.perf_counter() - t0)
+
+    net.hybridize(static_alloc=True, static_shape=True)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    sce.hybridize()
 
     def step():
         with ag.record():
@@ -724,14 +740,13 @@ def _cfg_wide_deep(b=None):
     val = run_wide_deep(batch=b)
     out = {"wide_deep_train_samples_per_sec": round(val, 2),
            "wide_deep_train_samples_per_sec_batch": b}
-    # secondary: the row_sparse gradient path (the r3 headline
+    # secondary: the row_sparse lazy-update path (the r3 headline
     # semantics — see PROFILE.md "config 5 re-baselined") at the
-    # r3-comparable b2048, few iters (eager dispatch is slow and
-    # batch-insensitive)
+    # r3-comparable b2048, now jitted via BucketedSparseTrainer (r5)
     try:
         _free_device_memory()
         out["wide_deep_sparse_path_samples_per_sec"] = round(
-            run_wide_deep(batch=2048, iters=5, sparse=True), 2)
+            run_wide_deep(batch=2048, iters=40, sparse=True), 2)
     except Exception as e:
         out["wide_deep_sparse_path_error"] = str(e)[:120]
     return out
@@ -835,10 +850,15 @@ def main():
     # driver-recorded headline + delta so a regression is visible next
     # to the in-run spread field
     try:
+        import re
         here = os.path.dirname(os.path.abspath(__file__))
-        prev_files = sorted(f for f in os.listdir(here)
-                            if f.startswith("BENCH_r") and
-                            f.endswith(".json"))
+        # numeric round sort (lexicographic breaks at r10 if a future
+        # driver drops the zero padding)
+        prev_files = sorted(
+            (f for f in os.listdir(here)
+             if re.fullmatch(r"BENCH_r(\d+)\.json", f)),
+            key=lambda f: int(re.fullmatch(r"BENCH_r(\d+)\.json",
+                                           f).group(1)))
         if prev_files and headline:
             with open(os.path.join(here, prev_files[-1])) as fh:
                 prev = json.load(fh).get("parsed", {})

@@ -378,6 +378,8 @@ def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
         bh = (jnp.arange(B) == b).astype(jnp.float32)
         return bh, weights_1d(ys, H), weights_1d(xs, W)
 
+    if R == 0:      # empty roi set: empty pooled output (vmap parity)
+        return jnp.zeros((0, C, PH, PW), data.dtype)
     bh, wy, wx = jax.vmap(one_roi_mats)(rois)
     # the S×S sample mean is linear — fold it into the matrices, so
     # the contractions produce the POOLED (PH, PW) output directly

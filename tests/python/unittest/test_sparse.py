@@ -275,3 +275,132 @@ def test_wide_deep_libsvm_convergence(tmp_path):
     # the sparse path must actually be in use
     g = net.deep_embed.weight.grad()
     assert isinstance(g, sparse.RowSparseNDArray)
+
+
+def test_bucketed_sparse_trainer_matches_eager_lazy_path():
+    """r5 jitted sparse path: BucketedSparseTrainer (device-side
+    unique buckets + sentinel-row lazy updates, one executable per
+    bucket) must track the eager row_sparse path (Trainer + lazy
+    sparse_adam_update) step for step on the same data."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, autograd as ag
+    from incubator_mxnet_tpu.models.wide_deep import WideDeep
+    from incubator_mxnet_tpu.contrib.sparse_jit import \
+        BucketedSparseTrainer
+
+    vocab, E, B, F = 600, 8, 16, 4
+    rs = np.random.RandomState(5)
+
+    net_e = WideDeep(vocab, embed_dim=E, hidden=(16,), classes=2,
+                     sparse_grad=True)
+    net_e.initialize()
+    net_j = WideDeep(vocab, embed_dim=E, hidden=(16,), classes=2,
+                     sparse_grad=True)
+    net_j.initialize()
+    # same init
+    pe, pj = net_e.collect_params(), net_j.collect_params()
+    touched = set()
+    # trigger deferred init with one forward each
+    i0 = nd.array(rs.randint(0, vocab, (B, F)), dtype="int32")
+    v0 = nd.array(rs.rand(B, F).astype(np.float32))
+    net_e(i0, v0)
+    net_j(i0, v0)
+    for (ke, p_e), (kj, p_j) in zip(sorted(pe.items()),
+                                    sorted(pj.items())):
+        p_j.set_data(nd.array(p_e.data().asnumpy()))
+
+    trainer = gluon.Trainer(pe, "adam", {"learning_rate": 1e-2})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    jt = BucketedSparseTrainer(net_j, optimizer="adam", lr=1e-2)
+
+    # batches with very different unique-row counts → several buckets
+    for nuniq in (5, 40, 300, 12):
+        pool = rs.choice(vocab, size=nuniq, replace=False)
+        idx = rs.choice(pool, size=(B, F)).astype(np.int32)
+        touched.update(idx.reshape(-1).tolist())
+        vals = rs.rand(B, F).astype(np.float32)
+        y = rs.randint(0, 2, B).astype(np.float32)
+
+        with ag.record():
+            out = net_e(nd.array(idx, dtype="int32"), nd.array(vals))
+            l = sce(out, nd.array(y))
+            l.backward()
+        trainer.step(B)
+        loss_j = jt.step(np.asarray(idx), vals, y)
+        # eager loss is per-sample; jit loss is the mean
+        np.testing.assert_allclose(float(loss_j.asnumpy()),
+                                    float(l.mean().asnumpy()),
+                                    rtol=1e-4, atol=1e-5)
+
+    jt.sync_to_net()
+    untouched = np.array(sorted(set(range(vocab)) - touched))
+    assert len(untouched) > 0
+    # the two nets carry different auto-prefixes; pair params by
+    # sorted order (same construction order on both sides)
+    for ke, kj in zip(sorted(pe), sorted(pj)):
+        a = pe[ke].data().asnumpy()
+        b = pj[kj].data().asnumpy()
+        # atol bounds Adam's eps-zone chaos (a row whose summed grad
+        # lands near eps has a summation-order-sensitive update in
+        # BOTH paths); a semantic bug (wrong rows, missing wd, wrong
+        # t) shows up at the ~3e-2 update scale
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=1e-3,
+                                   err_msg="%s vs %s" % (ke, kj))
+        if ke.startswith("embedding"):
+            # the lazy-semantics core: rows never touched by any batch
+            # must be BIT-IDENTICAL across the two paths
+            np.testing.assert_array_equal(a[untouched], b[untouched],
+                                          err_msg=ke + " untouched")
+
+
+def test_bucketed_sparse_trainer_bucket_rows_and_overflow():
+    """Explicit bucket_rows: small-unique batches fit the bucket and
+    update correctly; a batch whose unique count exceeds the bucket
+    increments the device-side overflow counter (surfaced lazily —
+    no per-step host sync)."""
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.models.wide_deep import WideDeep
+    from incubator_mxnet_tpu.contrib.sparse_jit import \
+        BucketedSparseTrainer
+
+    vocab, E, B, F = 300, 4, 8, 4
+    rs = np.random.RandomState(11)
+    net = WideDeep(vocab, embed_dim=E, hidden=(8,), classes=2,
+                   sparse_grad=True)
+    net.initialize()
+    net(nd.array(rs.randint(0, vocab, (B, F)), dtype="int32"),
+        nd.array(rs.rand(B, F).astype(np.float32)))
+    jt = BucketedSparseTrainer(net, optimizer="sgd", lr=1e-2,
+                               bucket_rows=8)
+    w0 = np.asarray(jt._state["tables"][jt._deep_name])[:-1].copy()
+
+    # 4 unique rows < bucket 8: fits
+    pool = rs.choice(vocab, size=4, replace=False)
+    idx = rs.choice(pool, size=(B, F)).astype(np.int32)
+    vals = rs.rand(B, F).astype(np.float32)
+    y = rs.randint(0, 2, B).astype(np.float32)
+    jt.step(idx, vals, y)
+    assert jt.overflow_steps == 0
+    w1 = np.asarray(jt._state["tables"][jt._deep_name])[:-1]
+    changed = np.where(np.any(w1 != w0, axis=1))[0]
+    assert set(changed) <= set(pool.tolist())
+    assert len(changed) > 0
+
+    # 20 unique rows > bucket 8: the step is SKIPPED — overflow
+    # counted, NaN loss signal, state bit-identical (no poisoning)
+    before = {k: np.asarray(v).copy()
+              for k, v in jt._state["tables"].items()}
+    t_before = int(np.asarray(jt._state["t"]))
+    idx2 = rs.choice(vocab, size=(B, F), replace=False).astype(np.int32)
+    assert len(np.unique(idx2)) > 8
+    l_ovf = jt.step(idx2, vals, y)
+    assert jt.overflow_steps == 1
+    assert np.isnan(float(l_ovf.asnumpy()))
+    for k, v in jt._state["tables"].items():
+        np.testing.assert_array_equal(np.asarray(v), before[k])
+    assert int(np.asarray(jt._state["t"])) == t_before
+
+    # training recovers: a following in-bucket step updates normally
+    l_ok = jt.step(idx, vals, y)
+    assert not np.isnan(float(l_ok.asnumpy()))
+    assert jt.overflow_steps == 1
