@@ -37,6 +37,12 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_pcache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
                       "0.5")
+# executable-level AOT cache (aot_cache.py): the axon remote-compile
+# path bypasses the JAX persistent cache entirely (the dir above stays
+# empty), so fused train-step executables — including the Mosaic flash
+# kernels — are serialized/deserialized whole.  r5 measured: second
+# bert-config process 151s -> <60s.
+os.environ.setdefault("MXNET_AOT_CACHE_DIR", "/tmp/mxtpu_aot")
 
 V100_IMAGES_PER_SEC = 1400.0   # BASELINE.md north-star denominator [L]
 
@@ -50,8 +56,15 @@ def _dependent_sync(net):
     dependent buffer itself (observed: a 15x-too-high BERT number).
     The only sync that cannot lie is a device->host READ, so this
     fetches ONE element of a param the step rebound: the slice chains
-    on the full update, the transfer is 2-4 bytes."""
-    p = next(iter(net.collect_params().values())).data()
+    on the full update, the transfer is 2-4 bytes.  The SMALLEST param
+    is used — reshaping a 23M-element embedding costs a whole-buffer
+    copy program (a 3-30s remote compile on this backend, r5)."""
+    # trainable params only: a grad_req='null' buffer (BatchNorm
+    # running stats, frozen params) is never rebound by the step, so
+    # reading it would NOT fence the update
+    params = [q for q in net.collect_params().values()
+              if q._grad_req != "null"]
+    p = min(params, key=lambda q: int(np.prod(q.shape))).data()
     float(p.reshape((-1,))[:1].asnumpy()[0])
 
 

@@ -307,21 +307,21 @@ _CONST_CACHE = {}
 
 
 def _zeros_const(shape, dtype):
-    import jax.numpy as jnp
+    from .engine import host_const
     key = ("z", tuple(shape), str(dtype))
     v = _CONST_CACHE.get(key)
     if v is None or v.is_deleted():
-        v = jnp.zeros(shape, dtype)
+        v = host_const(shape, dtype)
         _CONST_CACHE[key] = v
     return v
 
 
 def _ones_const(shape, dtype):
-    import jax.numpy as jnp
+    from .engine import host_const
     key = ("o", tuple(shape), str(dtype))
     v = _CONST_CACHE.get(key)
     if v is None or v.is_deleted():
-        v = jnp.ones(shape, dtype)
+        v = host_const(shape, dtype, fill=1.0)
         _CONST_CACHE[key] = v
     return v
 

@@ -161,6 +161,11 @@ register("MXNET_USE_PALLAS", str, "1",
          "bytes), 2=always", choices=("0", "1", "2"))
 register("MXNET_PALLAS_INTERPRET", bool, False,
          "Run Pallas kernels in interpret mode (CPU debugging)")
+register("MXNET_AOT_CACHE_DIR", str, "",
+         "Directory for serialized compiled executables (aot_cache."
+         "aot_jit): fresh processes deserialize instead of recompiling "
+         "— the workaround for backends whose remote compile path "
+         "bypasses the JAX persistent cache. Empty = off")
 register("MXNET_FLASH_BLOCK_Q", int, 0,
          "Flash-attention Q block size (0 = auto)")
 register("MXNET_FLASH_BLOCK_K", int, 0,

@@ -63,9 +63,12 @@ _TRACE_COLLECT = _threading.local()
 
 @contextlib.contextmanager
 def collect_op_names():
-    """Collect op names dispatched inside this scope (used while
-    tracing a hybridized block; the list is the fused program's op
-    composition for the profiler's aggregate table)."""
+    """Collect (op name, est_seconds) entries dispatched inside this
+    scope (used while tracing a hybridized block; the list is the fused
+    program's op composition for the profiler's aggregate table).
+    est_seconds is a roofline estimate (see `roofline_estimate`),
+    computed only when the profiler is listening at trace time — start
+    the profiler BEFORE the first forward for full attribution."""
     prev = getattr(_TRACE_COLLECT, "ops", None)
     _TRACE_COLLECT.ops = []
     try:
@@ -78,23 +81,66 @@ def has_listeners() -> bool:
     return bool(_LISTENERS)
 
 
-def emit_fused_ops(step_name: str, ctx, op_names):
+# Roofline constants for per-op attribution INSIDE a fused executable
+# (v5e datasheet-order: bf16 peak and HBM stream bandwidth).  Only the
+# PROPORTIONS between ops matter for the aggregate table; absolute
+# values are labelled estimates.  (ref: src/profiler/profiler.cc
+# measures real per-op stamps; one XLA program has no such stamps —
+# XPlane via profiler.start_jax_trace is the measured alternative.)
+_PEAK_FLOPS = 1.97e14
+_PEAK_BYTES = 8.19e11
+
+
+def roofline_estimate(flops: float, bytes_accessed: float) -> float:
+    """Estimated seconds an op contributes inside a fused program:
+    max of its MXU time and its HBM-stream time."""
+    return max(flops / _PEAK_FLOPS, bytes_accessed / _PEAK_BYTES)
+
+
+def host_const(shape, dtype, fill=0.0, device=None):
+    """Constant built on the HOST and device_put — the one idiom for
+    creating zeros/ones/hyper vectors: an eager `jnp.zeros`-style
+    creation op compiles one remote program per (shape, dtype) on this
+    backend, 1-30 s each over the tunnel (PROFILE.md r5).  Used by the
+    backward seed constants, optimizer state/hyper builds, and (via
+    numpy + NDArray) param init and attach_grad."""
+    import numpy as _nph
+    import jax
+    a = (_nph.zeros(shape, dtype) if not fill
+         else _nph.full(shape, fill, dtype=dtype))
+    return jax.device_put(a, device)
+
+
+def emit_fused_ops(step_name: str, ctx, op_entries):
     """Report the per-op composition of a fused executable that just
-    dispatched as one event.  Sub-ops carry zero duration — wall time
-    inside ONE XLA program is not attributable per op without XPlane
-    (profiler.start_jax_trace); the parent event carries the total.
+    dispatched as one event.  Entries are (name, est_seconds) pairs
+    from `collect_op_names` (bare names accepted — zero duration);
+    durations are ROOFLINE ESTIMATES from per-op HLO cost analysis at
+    trace time, not measurements — the parent event carries the real
+    total; XPlane (profiler.start_jax_trace) measures for real.
     Callers guard with `has_listeners()` so the hot path never builds
     the name lists for nobody."""
     for fn in _LISTENERS:
-        for op in op_names:
-            fn("%s[fused]" % op, ctx, 0.0)
+        for op in op_entries:
+            if isinstance(op, tuple):
+                fn("%s[fused]" % op[0], ctx, float(op[1]))
+            else:
+                fn("%s[fused]" % op, ctx, 0.0)
 
 
 @contextlib.contextmanager
-def _dispatch_hook(name: str, ctx):
+def _dispatch_hook(name: str, ctx, cost_fn=None):
     coll = getattr(_TRACE_COLLECT, "ops", None)
     if coll is not None:
-        coll.append(name)
+        # cost estimation (an HLO lowering per op) only when someone is
+        # listening — plain hybridize traces skip it entirely
+        cost = 0.0
+        if cost_fn is not None and _LISTENERS:
+            try:
+                cost = float(cost_fn())
+            except Exception:
+                cost = 0.0
+        coll.append((name, cost))
     if not _LISTENERS:
         yield
         return

@@ -165,6 +165,37 @@ def _param_symbol(param):
 # Block
 # ---------------------------------------------------------------------------
 
+def _batch_cast_params(pd, dtype):
+    """Convert every initialized parameter to `dtype` in ONE jitted
+    (and AOT-disk-cached) executable.  The per-param eager astype it
+    replaces costs one remote compile per distinct shape on this
+    backend — ~16 compiles x 3-30 s of BERT build wall (PROFILE.md
+    r5)."""
+    import jax.numpy as jnp
+    from ..aot_cache import aot_jit
+    tgt = jnp.dtype(dtype)
+    work = []
+    for p in pd.values():
+        if p._data is None:
+            continue
+        for ctx, arr in p._data.items():
+            if arr._data.dtype != tgt:
+                work.append((p, ctx))
+    if not work:
+        return
+    leaves = tuple(p._data[ctx]._data for p, ctx in work)
+
+    def convert(*ls):
+        return tuple(l.astype(tgt) for l in ls)
+
+    outs = aot_jit(convert)(*leaves)
+    for (p, ctx), o in zip(work, outs):
+        p._data[ctx] = NDArray(o, ctx=ctx)
+    for p, _ctx in work:
+        if p._grad_req != "null":
+            p._init_grad()
+
+
 class Block:
     """ref: gluon.Block — composable, imperative-first layer."""
 
@@ -250,10 +281,17 @@ class Block:
             child.hybridize(active, **kwargs)
 
     def cast(self, dtype):
+        # metadata/caches first (recursive), then ONE batched data
+        # conversion over the whole tree — public single-arg signature
+        # preserved for subclass overrides
+        self._cast_meta(dtype)
+        _batch_cast_params(self.collect_params(), dtype)
+
+    def _cast_meta(self, dtype):
         for child in self._children.values():
-            child.cast(dtype)
+            child._cast_meta(dtype)
         for param in self._params.values():
-            param.cast(dtype)
+            param.cast(dtype, _convert=False)
 
     def zero_grad(self):
         self.collect_params().zero_grad()
@@ -506,7 +544,8 @@ class _FusedProgram:
 
         def fwd(*leaves):
             return jax.vjp(raw, *leaves)
-        self.fwd_jit = jax.jit(fwd)
+        from ..aot_cache import aot_jit
+        self.fwd_jit = aot_jit(fwd)
         self.keep = keep
         self.n_net = n_net_leaves
         self.n_loss = n_loss
@@ -885,7 +924,8 @@ class _CachedGraph:
         def fwd(*leaves):
             outs, vjp_fn = jax.vjp(pure_flat, *leaves)
             return outs, vjp_fn
-        self._jit_fwdvjp[fkey] = jax.jit(fwd)
+        from ..aot_cache import aot_jit
+        self._jit_fwdvjp[fkey] = aot_jit(fwd)
         return self._jit_fwdvjp[fkey]
 
     def __call__(self, args):
@@ -1244,9 +1284,9 @@ class HybridBlock(Block):
                     continue
                 p._finish_deferred_init()
 
-    def cast(self, dtype):
+    def _cast_meta(self, dtype):
         self._cached_graph = None
-        super().cast(dtype)
+        super()._cast_meta(dtype)
 
     def __call__(self, *args, **kwargs):
         from ..symbol.symbol import Symbol as _Sym

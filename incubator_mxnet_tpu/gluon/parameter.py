@@ -144,10 +144,13 @@ class Parameter:
         # empty) dict that _check_initialized would accept
         new_data = OrderedDict()
         for ctx in ctx_list:
-            arr = NDArray(jnp.zeros(self._shape,
+            # HOST zeros: the device buffer is about to be overwritten
+            # by the initializer's device_put anyway — a jnp.zeros here
+            # costs one remote compile per distinct shape at startup
+            arr = NDArray(_np.zeros(self._shape,
                                     _np.dtype(self.dtype)
                                     if not isinstance(self.dtype, str)
-                                    else None), ctx=ctx,
+                                    else _np.float32), ctx=ctx,
                           dtype=self.dtype if isinstance(self.dtype, str)
                           else None)
             # fill via initializer chain (ref: Parameter._load_init order)
@@ -271,9 +274,13 @@ class Parameter:
             i, _, d = self._deferred_init
             self._deferred_init = (i, list(ctx), d)
 
-    def cast(self, dtype):
+    def cast(self, dtype, _convert=True):
+        """_convert=False defers the data conversion — Block.cast
+        batches every parameter's convert into ONE executable (a
+        per-shape eager astype costs a remote compile each on this
+        backend)."""
         self.dtype = dtype
-        if self._data is None:
+        if self._data is None or not _convert:
             return
         for ctx, arr in self._data.items():
             self._data[ctx] = arr.astype(dtype)

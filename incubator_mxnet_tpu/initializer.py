@@ -95,20 +95,47 @@ class Initializer:
             _np.asarray(value, dtype=arr.dtype), arr.context.jax_device)
 
     @staticmethod
-    def _rand_normal(arr, scale):
+    def _cpu_key(ctx):
+        """Derive a fresh init key ENTIRELY on the host + local cpu
+        backend: init-time randomness runs there (threefry is
+        backend-deterministic), so a fresh process pays zero remote
+        device compiles for its ~hundreds of per-shape init programs
+        (measured: 38-117 s of BERT startup on the tunnel-attached
+        chip was param-init compiles — including the device-side
+        threefry seed/fold/split chain `split_key` would run)."""
         from . import random as rnd
         import jax
-        key = rnd.split_key(arr.context)
-        Initializer._fill(arr, _np.asarray(
-            jax.random.normal(key, arr.shape)) * scale)
+        try:
+            cpu = jax.devices("cpu")[0]
+            bits = rnd.next_key_bits(ctx)      # host-only derivation
+            with jax.default_device(cpu):
+                return jax.random.wrap_key_data(bits), True
+        except Exception:
+            return rnd.split_key(ctx), False
+
+    @staticmethod
+    def _rand_normal(arr, scale):
+        import jax
+        key, on_cpu = Initializer._cpu_key(arr.context)
+        if on_cpu:
+            with jax.default_device(jax.devices("cpu")[0]):
+                vals = jax.random.normal(key, arr.shape)
+        else:
+            vals = jax.random.normal(key, arr.shape)
+        Initializer._fill(arr, _np.asarray(vals) * scale)
 
     @staticmethod
     def _rand_uniform(arr, low, high):
-        from . import random as rnd
         import jax
-        key = rnd.split_key(arr.context)
-        Initializer._fill(arr, _np.asarray(
-            jax.random.uniform(key, arr.shape, minval=low, maxval=high)))
+        key, on_cpu = Initializer._cpu_key(arr.context)
+        if on_cpu:
+            with jax.default_device(jax.devices("cpu")[0]):
+                vals = jax.random.uniform(key, arr.shape, minval=low,
+                                          maxval=high)
+        else:
+            vals = jax.random.uniform(key, arr.shape, minval=low,
+                                      maxval=high)
+        Initializer._fill(arr, _np.asarray(vals))
 
     def dumps(self):
         return json.dumps([self.__class__.__name__.lower(), self._kwargs])

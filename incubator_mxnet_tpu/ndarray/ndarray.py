@@ -69,8 +69,61 @@ def apply_fn(fn, nd_args, kwargs, *, name="", differentiable=True,
     record = (_ag.is_recording() and differentiable and
               any(_ag._requires_tracking(a) for a in arr_nds))
 
+    def _cost_fn():
+        # per-op roofline estimate for fused-program attribution
+        # (engine.collect_op_names); runs only at trace time with the
+        # profiler listening.  Lowered cost analysis when the backend
+        # provides it; else analytic FLOPs for the matmul family +
+        # in/out bytes (the axon plugin's cost_analysis returns None).
+        from .. import engine as _eng
+
+        def _n(shape):
+            out = 1
+            for s in shape:
+                out *= int(s)
+            return out
+
+        avals = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                 for a in arr_data]
+        try:
+            c = jax.jit(pure).lower(*avals).cost_analysis() or {}
+            est = _eng.roofline_estimate(
+                float(c.get("flops", 0.0) or 0.0),
+                float(c.get("bytes accessed", 0.0) or 0.0))
+            if est > 0.0:
+                return est
+        except Exception:
+            pass
+        try:
+            outs = jax.tree_util.tree_leaves(
+                jax.eval_shape(pure, *avals))
+            nbytes = float(sum(_n(a.shape) * a.dtype.itemsize
+                               for a in list(avals) + outs))
+            flops = 0.0
+            opn = name or ""
+            if opn == "Convolution" and len(arr_data) >= 2 and outs:
+                flops = 2.0 * _n(outs[0].shape) * \
+                    _n(arr_data[1].shape[1:])       # O,H',W' × I·kh·kw
+            elif opn == "FullyConnected" and len(arr_data) >= 2 \
+                    and outs:
+                # contraction size = weight in_units (the data input
+                # may arrive unflattened, e.g. (N, C, H, W))
+                k = int(arr_data[1].shape[-1])
+                flops = 2.0 * _n(outs[0].shape) * k
+            elif opn in ("dot", "batch_dot") and len(arr_data) >= 2 \
+                    and outs:
+                k = int(arr_data[0].shape[-1])
+                flops = 2.0 * _n(outs[0].shape) * k
+            return _eng.roofline_estimate(flops, nbytes)
+        except Exception:
+            nbytes = sum(getattr(a, "size", 0) *
+                         getattr(a.dtype, "itemsize", 4)
+                         for a in arr_data)
+            return _eng.roofline_estimate(0.0, float(nbytes))
+
     from ..engine import _dispatch_hook
-    with _dispatch_hook(name or getattr(fn, "__name__", "op"), ctx):
+    with _dispatch_hook(name or getattr(fn, "__name__", "op"), ctx,
+                        cost_fn=_cost_fn):
         if arr_data:
             if record:
                 out, vjp_fn = jax.vjp(pure, *arr_data)
@@ -330,7 +383,10 @@ class NDArray:
             self._grad = zeros_row_sparse(self.shape, self._data.dtype,
                                           ctx=self._ctx)
         else:
-            self._grad = NDArray(jnp.zeros(self.shape, self._data.dtype),
+            # host zeros + device_put: a jnp.zeros here is one remote
+            # compile per distinct shape at model-build time
+            self._grad = NDArray(_np.zeros(self.shape,
+                                           self._data.dtype),
                                  ctx=self._ctx)
         self._grad_req = grad_req
 

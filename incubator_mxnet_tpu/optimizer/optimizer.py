@@ -83,7 +83,9 @@ def _zeros_state(weight):
     updates donate their inputs, and donating one buffer through two
     arguments is an error on real TPU (CPU ignores donation, which hid
     this until hardware runs)."""
-    return NDArray(jnp.zeros(weight.shape, weight._data.dtype),
+    # host zeros + NDArray device_put (see engine.host_const rationale)
+    import numpy as _nph
+    return NDArray(_nph.zeros(weight.shape, weight._data.dtype),
                    ctx=weight.context)
 
 
@@ -198,7 +200,8 @@ def _build_train_step(raw, opname, static_kv, nparam, nstates, gidx,
     # donate the parameter leaves (updated in place) and the optimizer
     # states; NOT the input/cotangent leaves (reused across steps)
     donate = tuple(gidx) + (n_leaves + 1,)
-    return jax.jit(f, donate_argnums=donate)
+    from ..aot_cache import aot_jit
+    return aot_jit(f, donate_argnums=donate)
 
 
 def _train_step_dispatch(prod, pending, opname, static_kv, weights,
@@ -260,7 +263,11 @@ def _hyper_array(values):
             # bias-corrected lr vector) would otherwise leak one device
             # buffer per training step forever
             _HYPER_CACHE.clear()
-        v = jnp.asarray(key, jnp.float32)
+        # host build + device_put (see engine.host_const: a jnp.asarray
+        # of a host list is a remote compile per length on this backend)
+        import numpy as _nph
+        import jax as _jax
+        v = _jax.device_put(_nph.asarray(key, _nph.float32))
         _HYPER_CACHE[key] = v
     return v
 
