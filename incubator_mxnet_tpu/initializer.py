@@ -106,7 +106,9 @@ class Initializer:
         from . import random as rnd
         import jax
         try:
-            cpu = jax.devices("cpu")[0]
+            # process-LOCAL cpu device: jax.devices("cpu")[0] is rank
+            # 0's under multi-controller — non-addressable elsewhere
+            cpu = jax.local_devices(backend="cpu")[0]
             bits = rnd.next_key_bits(ctx)      # host-only derivation
             with jax.default_device(cpu):
                 return jax.random.wrap_key_data(bits), True
@@ -118,7 +120,8 @@ class Initializer:
         import jax
         key, on_cpu = Initializer._cpu_key(arr.context)
         if on_cpu:
-            with jax.default_device(jax.devices("cpu")[0]):
+            with jax.default_device(jax.local_devices(
+                    backend="cpu")[0]):
                 vals = jax.random.normal(key, arr.shape)
         else:
             vals = jax.random.normal(key, arr.shape)
@@ -129,7 +132,8 @@ class Initializer:
         import jax
         key, on_cpu = Initializer._cpu_key(arr.context)
         if on_cpu:
-            with jax.default_device(jax.devices("cpu")[0]):
+            with jax.default_device(jax.local_devices(
+                    backend="cpu")[0]):
                 vals = jax.random.uniform(key, arr.shape, minval=low,
                                           maxval=high)
         else:
