@@ -618,6 +618,78 @@ def run_int8_infer(batch=64, warmup=3, iters=20):
     return batch * iters / (time.perf_counter() - t0)
 
 
+def _quality_dataset(n=6144, classes=10, size=32, noise=1.0,
+                     amp=0.18, seed=7):
+    """Deterministic CIFAR-shaped synthetic set: class = weak fixed
+    random template (amp ≪ noise) + per-sample gaussian noise.  The
+    per-pixel SNR is ~amp/noise = 0.18, so single pixels carry almost
+    no signal and the net must integrate the whole template over
+    several epochs — the loss/accuracy CURVE (not just the endpoint)
+    is the regression baseline."""
+    rs = np.random.RandomState(seed)
+    templates = amp * rs.randn(classes, 3, size, size).astype(np.float32)
+    y = rs.randint(0, classes, n).astype(np.float32)
+    x = templates[y.astype(int)] + \
+        noise * rs.randn(n, 3, size, size).astype(np.float32)
+    return x, y
+
+
+def run_quality(epochs=8, batch=256, train_n=5120, eval_n=1024,
+                amp=0.18):
+    """Optional quality config (VERDICT r4 next #8): a budgeted ON-CHIP
+    convergence run — thumbnail ResNet-18 (the resnet20-class CIFAR
+    geometry) on a deterministic synthetic 10-class set — so "matches
+    reference model quality" has an internal regression baseline
+    (BASELINE.md's quality row; SURVEY §6).  Emits final eval accuracy
+    + a per-epoch loss curve; tests/assets/r5/quality_curve.json holds
+    the r5 reference curve."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, autograd as ag
+    from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+
+    ctx = mx.gpu()
+    mx.random.seed(42)
+    net = resnet18_v1(classes=10, thumbnail=True)
+    net.initialize(ctx=ctx, init=mx.init.Xavier())
+    net.hybridize(static_alloc=True, static_shape=True)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9,
+                             "wd": 1e-4})
+    x_np, y_np = _quality_dataset(train_n + eval_n, amp=amp)
+    xt, yt = x_np[:train_n], y_np[:train_n]
+    xe, ye = x_np[train_n:], y_np[train_n:]
+    def eval_acc():
+        # plain forward outside record: BN runs on running stats
+        correct = 0
+        for i in range(0, eval_n, batch):
+            out = net(nd.array(xe[i:i + batch], ctx=ctx))
+            pred = out.asnumpy().argmax(axis=1)
+            correct += int((pred == ye[i:i + batch]).sum())
+        return correct / eval_n
+
+    curve, acc_curve = [], []
+    for ep in range(epochs):
+        tot = 0.0
+        nb = 0
+        for i in range(0, train_n, batch):
+            xb = nd.array(xt[i:i + batch], ctx=ctx)
+            yb = nd.array(yt[i:i + batch], ctx=ctx)
+            with ag.record():
+                l = loss_fn(net(xb), yb)
+                l.backward()
+            trainer.step(batch)
+            tot += float(l.mean().asnumpy())
+            nb += 1
+        curve.append(round(tot / nb, 4))
+        acc_curve.append(round(eval_acc(), 4))
+    return {"quality_resnet18_synth_eval_acc": acc_curve[-1],
+            "quality_loss_curve": curve,
+            "quality_acc_curve": acc_curve,
+            "quality_epochs": epochs}
+
+
 def run_io(batch=128):
     """Input-pipeline-only throughput: native C++ RecordIO+JPEG pipeline
     (src/io/recordio_pipeline.cc), images/sec/host-core — SURVEY §2.4
@@ -715,6 +787,7 @@ _CONFIGS = {
         batch_key="sharded_trainer_batch"),
     "int8": lambda b=None: _cfg_simple(
         "resnet50_int8_infer_images_per_sec", run_int8_infer, (64, 32)),
+    "quality": lambda b=None: run_quality(),
 }
 
 # batch ladders main() walks one-subprocess-per-attempt (first success
@@ -819,12 +892,12 @@ def main():
     times = {}
     required = ("resnet", "bert", "ssd512", "rcnn", "gnmt",
                 "transformer_nmt", "wide_deep")
-    optional = ("io", "sharded", "int8")
+    optional = ("io", "sharded", "quality", "int8")
 
     # optional configs need this much budget left to be worth starting
     # (below it they'd time out AT the budget edge instead of skipping
     # cleanly — int8's quantization calibration alone needs ~4 min cold)
-    optional_min = {"io": 30, "sharded": 90, "int8": 250}
+    optional_min = {"io": 30, "sharded": 90, "quality": 120, "int8": 250}
 
     for name in required + optional:
         remaining = budget - (time.perf_counter() - t_start)

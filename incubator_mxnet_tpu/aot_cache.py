@@ -52,6 +52,11 @@ def _key_for(lowered):
         # version in the key turns a runtime rotation into clean misses
         str(getattr(getattr(dev, "client", None), "platform_version",
                     "")),
+        # device topology: an executable built on a 1-device process
+        # fails shard-count checks when loaded under a virtual 8-device
+        # mesh (same platform/kind, different assignment)
+        str(jax.device_count()),
+        str(jax.process_count()),
     ])
     return hashlib.sha256(raw.encode()).hexdigest()
 
@@ -71,6 +76,19 @@ class _AotJitted:
                        str(getattr(a, "dtype", type(a))))
                       for a in leaves))
 
+    @staticmethod
+    def _args_device(args):
+        """The device the program will execute on (= first argument
+        leaf's device; falls back to the default device)."""
+        for leaf in jax.tree_util.tree_leaves(args):
+            devs = getattr(leaf, "devices", None)
+            if callable(devs):
+                try:
+                    return next(iter(devs()))
+                except Exception:
+                    pass
+        return jax.devices()[0]
+
     def _get_compiled(self, args):
         from jax.experimental.serialize_executable import (
             serialize, deserialize_and_load)
@@ -80,7 +98,13 @@ class _AotJitted:
         t0 = _t.perf_counter()
         lowered = self._jit.lower(*args)
         t1 = _t.perf_counter()
-        path = os.path.join(cache_dir(), _key_for(lowered) + ".pjrtx")
+        dev = self._args_device(args)
+        # the execution device is part of the key: a blob loaded onto a
+        # different device than it was compiled for fails at CALL time,
+        # outside this method's fallback
+        path = os.path.join(
+            cache_dir(),
+            _key_for(lowered) + ".d%d.pjrtx" % getattr(dev, "id", 0))
         t2 = _t.perf_counter()
         if os.path.exists(path):
             try:
@@ -88,7 +112,13 @@ class _AotJitted:
                     blob = f.read()
                 in_tree = tu.tree_structure((tuple(args), {}))
                 out_tree = tu.tree_structure(lowered.out_info)
-                out = deserialize_and_load(blob, in_tree, out_tree)
+                # single-device programs only (plain jit): pin to the
+                # ARGUMENT device — the loader's default binds the
+                # blob to EVERY visible device, which fails shard
+                # checks under a virtual multi-device mesh
+                out = deserialize_and_load(
+                    blob, in_tree, out_tree,
+                    execution_devices=[dev])
                 if dbg:
                     print("[aot] HIT lower=%.1fs key=%.1fs load=%.1fs"
                           % (t1 - t0, t2 - t1, _t.perf_counter() - t2))
