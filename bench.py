@@ -793,7 +793,9 @@ _CONFIGS = {
 # batch ladders main() walks one-subprocess-per-attempt (first success
 # wins); configs not listed use their in-process ladders above
 _SUBPROC_BATCHES = {"bert": (32, 16, 8),
-                    "transformer_nmt": (256, 128, 64),
+                    # r5 seq 64: b256 wedges in compile (observed
+                    # >560s); b128 = 134k tok/s
+                    "transformer_nmt": (128, 64),
                     # r5: reference-geometry gnmt_large (179M params,
                     # seq 50) — tokens/s scales with batch (87k/104k/
                     # 118k at 128/256/512); b1024 OOMs

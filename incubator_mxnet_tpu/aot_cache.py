@@ -71,7 +71,12 @@ class _AotJitted:
 
     def _sig(self, args):
         leaves, treedef = jax.tree_util.tree_flatten(args)
-        return (treedef,
+        # device is part of the signature: the loaded executable is
+        # pinned to the argument device, so same-shaped calls on a
+        # different device must resolve their own executable (jax.jit
+        # keys on placement the same way)
+        dev = self._args_device(args)
+        return (treedef, getattr(dev, "id", 0),
                 tuple((tuple(getattr(a, "shape", ())),
                        str(getattr(a, "dtype", type(a))))
                       for a in leaves))

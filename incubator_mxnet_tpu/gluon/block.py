@@ -987,7 +987,8 @@ class _CachedGraph:
                     training, np_, ni_)(*leaf_data)
             else:
                 if fkey not in self._jitted:
-                    self._jitted[fkey] = jax.jit(
+                    from ..aot_cache import aot_jit
+                    self._jitted[fkey] = aot_jit(
                         self._get_flat(training, np_, ni_))
                 result = self._jitted[fkey](*leaf_data)
         if _engine.naive_mode():

@@ -63,10 +63,15 @@ def xla_ref(x, w):
 
 
 def bench(f, args, iters=20):
-    def looped(*a):
+    def looped(x, *rest):
         def body(i, c):
-            y, s1, s2 = f(*a)
-            return c + s1[0, 0] + y.astype(jnp.float32).reshape(-1)[0]
+            # carry feeds the input so the conv is loop-DEPENDENT —
+            # a loop-invariant body lets XLA hoist the (hoistable)
+            # einsum out of the while loop while the pallas custom
+            # call stays put, biasing the comparison
+            y, s1, s2 = f(x + c.astype(x.dtype), *rest)
+            return c + s1[0, 0] * jnp.float32(1e-20) + \
+                y.astype(jnp.float32).reshape(-1)[0] * jnp.float32(1e-20)
         return lax.fori_loop(0, iters, body, jnp.float32(0))
     g = jax.jit(looped)
     r = g(*args); float(np.asarray(r))
