@@ -4,11 +4,14 @@ embedding, utils): text vocabulary + token-embedding containers feeding
 from . import vocab
 from . import embedding
 from . import utils
+from . import decode
 from .vocab import Vocabulary
 from .embedding import (TokenEmbedding, CustomEmbedding,
                         CompositeEmbedding, register, create,
                         get_pretrained_file_names)
+from .decode import greedy_translate, beam_translate
 
-__all__ = ["vocab", "embedding", "utils", "Vocabulary", "TokenEmbedding",
-           "CustomEmbedding", "CompositeEmbedding", "register", "create",
-           "get_pretrained_file_names"]
+__all__ = ["vocab", "embedding", "utils", "decode", "Vocabulary",
+           "TokenEmbedding", "CustomEmbedding", "CompositeEmbedding",
+           "register", "create", "get_pretrained_file_names",
+           "greedy_translate", "beam_translate"]

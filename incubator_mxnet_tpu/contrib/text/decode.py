@@ -1,0 +1,133 @@
+"""Sequence decoding for the NMT model families (greedy + beam).
+
+Parity target: the reference NMT stack decodes with beam search (ref:
+Sockeye's beam_search over the fused RNN / transformer decoders; the
+reference ships the op layer, Sockeye the loop).  This module supplies
+the framework-level decoding loop for any encoder-decoder block with
+the `net(src, tgt_prefix) → logits (B, T, V)` training contract —
+`models.GNMT`, `models.Seq2Seq`, and `models.TransformerNMT` all
+qualify, so one implementation serves the whole family.
+
+TPU-first notes: the loop re-forwards the growing target prefix, so
+each prefix length hits ONE cached executable (the jit cache is the
+bucketing executor — SURVEY §7.0); scores and lanes are carried as
+device arrays and only the per-step argmax/top-k lands on host.  For
+production-scale serving, the incremental-state (KV-cache) decoder is
+the next step; this loop is the semantics reference the incremental
+path must match.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["greedy_translate", "beam_translate"]
+
+
+def _last_logits(net, src, prefix, ctx):
+    """logits of the NEXT token after `prefix` (B, V) as numpy.
+    The last position is sliced ON DEVICE so only (B, V) floats — not
+    the whole (B, T, V) tensor — cross the device→host link per step."""
+    from ... import nd
+    tgt = nd.array(prefix, ctx=ctx, dtype="int32")
+    out = net(src, tgt)                      # (B, T, V)
+    T = out.shape[1]
+    return out[:, T - 1, :].asnumpy()
+
+
+def greedy_translate(net, src, bos, eos, max_len=60):
+    """Greedy argmax decode.
+
+    net: encoder-decoder block, `net(src, tgt) → (B, T, V)` logits.
+    src: (B, Ts) int NDArray.  Returns (B, max_len) numpy int32 —
+    sequences start AFTER bos and are eos-padded once eos is emitted.
+    """
+    ctx = src.context
+    B = src.shape[0]
+    prefix = _np.full((B, 1), int(bos), _np.int32)
+    done = _np.zeros((B,), bool)
+    outs = []
+    for _ in range(max_len):
+        logits = _last_logits(net, src, prefix, ctx)
+        nxt = logits.argmax(axis=1).astype(_np.int32)
+        nxt = _np.where(done, int(eos), nxt)
+        outs.append(nxt)
+        done |= nxt == int(eos)
+        prefix = _np.concatenate([prefix, nxt[:, None]], axis=1)
+        if done.all():
+            break
+    out = _np.stack(outs, axis=1)
+    if out.shape[1] < max_len:
+        pad = _np.full((B, max_len - out.shape[1]), int(eos), _np.int32)
+        out = _np.concatenate([out, pad], axis=1)
+    return out
+
+
+def beam_translate(net, src, bos, eos, beam_size=4, max_len=60,
+                   alpha=0.6):
+    """Beam search with GNMT-style length normalization
+    ((5+len)^alpha / 6^alpha — ref: Sockeye/GNMT decoding).
+
+    Returns (best (B, max_len) int32, scores (B,) normalized
+    log-probs).  Beams ride the batch axis (B·K rows through the same
+    cached executable), the exact trick the reference uses to keep
+    beam decode on the accelerator's batched path.
+    """
+    from ... import nd
+    ctx = src.context
+    B, Ts = src.shape
+    K = int(beam_size)
+    V = None
+    src_np = src.asnumpy()
+    # replicate each source row K times: (B*K, Ts)
+    src_rep = nd.array(_np.repeat(src_np, K, axis=0), ctx=ctx,
+                       dtype="int32")
+    prefix = _np.full((B * K, 1), int(bos), _np.int32)
+    # log-prob per live beam; lanes 1..K-1 start dead so step 1 picks
+    # K distinct continuations of the single bos lane
+    scores = _np.full((B, K), -1e30, _np.float64)
+    scores[:, 0] = 0.0
+    done = _np.zeros((B, K), bool)
+    lengths = _np.zeros((B, K), _np.int64)
+
+    for step in range(max_len):
+        logits = _last_logits(net, src_rep, prefix, ctx)   # (B*K, V)
+        if V is None:
+            V = logits.shape[1]
+        # stable log-softmax in f64
+        logits = logits.astype(_np.float64)
+        m = logits.max(axis=1, keepdims=True)
+        logp = (logits - m) - _np.log(
+            _np.exp(logits - m).sum(axis=1, keepdims=True))
+        logp = logp.reshape(B, K, V)
+        # finished beams only extend with eos at zero cost
+        eos_only = _np.full((V,), -1e30)
+        eos_only[int(eos)] = 0.0
+        logp = _np.where(done[:, :, None], eos_only[None, None, :],
+                         logp)
+        cand = scores[:, :, None] + logp                   # (B, K, V)
+        flat = cand.reshape(B, K * V)
+        top = _np.argsort(-flat, axis=1)[:, :K]            # (B, K)
+        scores = _np.take_along_axis(flat, top, axis=1)
+        src_beam = top // V                                # which lane
+        tok = (top % V).astype(_np.int32)
+        # reorder prefixes to the winning lanes and append
+        idx = (_np.arange(B)[:, None] * K + src_beam).reshape(-1)
+        prefix = prefix[idx]
+        prefix = _np.concatenate([prefix, tok.reshape(-1, 1)], axis=1)
+        was_done = _np.take_along_axis(done, src_beam, axis=1)
+        lengths = _np.take_along_axis(lengths, src_beam, axis=1)
+        lengths = _np.where(was_done, lengths, lengths + 1)
+        done = was_done | (tok == int(eos))
+        if done.all():
+            break
+
+    # GNMT length penalty on final scores
+    lp = ((5.0 + _np.maximum(lengths, 1)) ** alpha) / (6.0 ** alpha)
+    norm = scores / lp
+    best_lane = norm.argmax(axis=1)                        # (B,)
+    seqs = prefix.reshape(B, K, -1)[_np.arange(B), best_lane, 1:]
+    T = seqs.shape[1]
+    if T < max_len:
+        pad = _np.full((B, max_len - T), int(eos), _np.int32)
+        seqs = _np.concatenate([seqs, pad], axis=1)
+    return seqs.astype(_np.int32), norm[_np.arange(B), best_lane]
