@@ -10,11 +10,12 @@ qualify, so one implementation serves the whole family.
 
 TPU-first notes: the loop re-forwards the growing target prefix, so
 each prefix length hits ONE cached executable (the jit cache is the
-bucketing executor — SURVEY §7.0); scores and lanes are carried as
-device arrays and only the per-step argmax/top-k lands on host.  For
-production-scale serving, the incremental-state (KV-cache) decoder is
-the next step; this loop is the semantics reference the incremental
-path must match.
+bucketing executor — SURVEY §7.0).  The model forward runs on device;
+the last-position logits (B·K, V) come to host each step and beam
+state (scores, lanes, prefixes) lives in host numpy — simple and
+exact.  For production-scale serving the next step is the
+incremental-state (KV-cache) decoder with device-resident beam state;
+this loop is the semantics reference that path must match.
 """
 from __future__ import annotations
 
@@ -72,15 +73,12 @@ def beam_translate(net, src, bos, eos, beam_size=4, max_len=60,
     cached executable), the exact trick the reference uses to keep
     beam decode on the accelerator's batched path.
     """
-    from ... import nd
     ctx = src.context
     B, Ts = src.shape
     K = int(beam_size)
     V = None
-    src_np = src.asnumpy()
-    # replicate each source row K times: (B*K, Ts)
-    src_rep = nd.array(_np.repeat(src_np, K, axis=0), ctx=ctx,
-                       dtype="int32")
+    # replicate each source row K times ON DEVICE: (B*K, Ts)
+    src_rep = src.repeat(K, axis=0)
     prefix = _np.full((B * K, 1), int(bos), _np.int32)
     # log-prob per live beam; lanes 1..K-1 start dead so step 1 picks
     # K distinct continuations of the single bos lane
@@ -106,7 +104,11 @@ def beam_translate(net, src, bos, eos, beam_size=4, max_len=60,
                          logp)
         cand = scores[:, :, None] + logp                   # (B, K, V)
         flat = cand.reshape(B, K * V)
-        top = _np.argsort(-flat, axis=1)[:, :K]            # (B, K)
+        # top-K via partition (O(KV)), then order just the K winners
+        part = _np.argpartition(-flat, K - 1, axis=1)[:, :K]
+        pscores = _np.take_along_axis(flat, part, axis=1)
+        order = _np.argsort(-pscores, axis=1)
+        top = _np.take_along_axis(part, order, axis=1)     # (B, K)
         scores = _np.take_along_axis(flat, top, axis=1)
         src_beam = top // V                                # which lane
         tok = (top % V).astype(_np.int32)
