@@ -57,6 +57,7 @@ from . import contrib
 from . import callback
 from . import monitor
 from .monitor import Monitor
+from . import fault
 from . import numpy as np              # mx.np — NumPy-semantics front-end
 from . import numpy_extension as npx   # mx.npx — NN extensions + set_np
 from .util import is_np_array, set_np, reset_np, use_np
@@ -66,4 +67,4 @@ __all__ = ["MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",
            "gluon", "optimizer", "Optimizer", "metric", "initializer",
            "kvstore", "kv", "io", "image", "profiler", "runtime",
            "test_utils", "symbol", "sym", "Symbol", "module", "mod",
-           "parallel", "np", "npx", "__version__"]
+           "parallel", "fault", "monitor", "np", "npx", "__version__"]

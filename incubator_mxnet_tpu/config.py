@@ -198,6 +198,34 @@ register("MXNET_ENFORCE_DETERMINISM", bool, False,
 register("MXNET_SAFE_ACCUMULATION", bool, True,
          "Accumulate norms/softmax in float32 when inputs are "
          "half-precision (always on in XLA lowerings here)")
+register("MXNET_FAULT_PLAN", str, "",
+         "Deterministic fault-injection plan (fault.py): ';'-separated "
+         "'site@step' / 'site#call' entries with optional xN repeat and "
+         "~S stall seconds, e.g. 'grad_nan@3;preempt@7;io.read#2'. "
+         "Empty = no faults. Armed via fault.reset_from_config()")
+register("MXNET_CKPT_INTERVAL", int, 100,
+         "ResilientTrainer: steps between periodic atomic checkpoints")
+register("MXNET_CKPT_KEEP", int, 3,
+         "ResilientTrainer: checkpoints retained (keep-last-K garbage "
+         "collection; older step_* directories are removed after a "
+         "successful write)")
+register("MXNET_BAD_STEP_ROLLBACK", int, 3,
+         "ResilientTrainer: consecutive skipped (non-finite/spiking) "
+         "steps before rolling back to the last checkpoint; 0 disables "
+         "rollback (skip-only)")
+register("MXNET_LOSS_SPIKE_FACTOR", float, 0.0,
+         "ResilientTrainer: skip the update when loss exceeds this "
+         "multiple of its running mean (0 = non-finite detection only)")
+register("MXNET_RETRY_MAX", int, 3,
+         "Resilience retry budget for transient collective/I-O failures "
+         "(exponential backoff between attempts)")
+register("MXNET_RETRY_BACKOFF", float, 0.05,
+         "Initial backoff seconds for resilience retries (doubles per "
+         "attempt)")
+register("MXNET_KVSTORE_BARRIER_TIMEOUT", float, 300.0,
+         "DistKVStore barrier timeout in seconds: a worker stuck at a "
+         "barrier raises a clear rank-tagged error instead of hanging "
+         "the job forever (0 = wait indefinitely)")
 register("MXNET_INT64_TENSOR_SIZE", bool, False,
          "Large-tensor support: enable 64-bit index arithmetic so "
          "arrays past 2**31 elements index correctly (ref: the "

@@ -1,0 +1,240 @@
+"""Deterministic fault injection (resilience testing on CPU).
+
+Pod-scale training dies in ways a unit test never sees naturally:
+preemption mid-checkpoint, a NaN gradient from one bad batch, a flaky
+DCN collective, a storage blip during a RecordIO read.  This registry
+lets every one of those be INJECTED at a chosen step number (or call
+ordinal) so the recovery paths in `parallel.resilience`, `kvstore` and
+`io` are exercised deterministically under `JAX_PLATFORMS=cpu`.
+
+Sites are plain strings; the built-in ones:
+
+    grad_nan            ResilientTrainer: gradients/loss become NaN
+    loss_spike          ResilientTrainer: loss is scaled by 1e4
+    collective          ResilientTrainer step / DistKVStore aggregate:
+                        raises TransientFault (retryable)
+    preempt             ResilientTrainer: SIGTERM is raised in-process
+    io.read             RecordIO/reader paths: raises InjectedIOError
+    io.slow             reader paths: sleeps `seconds`
+    kvstore.barrier_hang  DistKVStore._barrier body stalls (timeout test)
+    checkpoint.save     ResilientTrainer checkpoint I/O: TransientFault
+
+Faults install programmatically::
+
+    from incubator_mxnet_tpu import fault
+    fault.install("grad_nan", steps=[3])          # step-triggered
+    fault.install("io.read", at_calls=[2], times=1)  # 2nd call fails
+
+or from the environment / `config.py` via ``MXNET_FAULT_PLAN``, a
+semicolon-separated spec — ``site@step`` for step-triggered faults and
+``site#call`` for call-ordinal faults, with an optional ``xN`` repeat::
+
+    MXNET_FAULT_PLAN="grad_nan@3;preempt@7;io.read#2x3"
+
+The registry is process-local, thread-safe, and OFF unless something was
+installed — `should_fire` on an empty registry is a dict lookup miss.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["InjectedFault", "TransientFault", "InjectedIOError",
+           "Preempted", "install", "clear", "reset_from_config",
+           "should_fire", "maybe_raise", "maybe_slow", "fired_count",
+           "active_sites"]
+
+
+class InjectedFault(Exception):
+    """Base class for every injected failure."""
+
+
+class TransientFault(InjectedFault):
+    """A failure the caller is expected to survive by retrying
+    (flaky collective, storage blip)."""
+
+
+class InjectedIOError(TransientFault, IOError):
+    """Injected I/O failure — an IOError subclass so existing
+    `except (IOError, OSError)` handlers treat it as the real thing."""
+
+
+class Preempted(Exception):
+    """Raised by the resilient train loop after a (real or injected)
+    preemption signal was handled.  When a checkpoint directory is
+    configured, state was checkpointed and a resumable marker is on
+    disk when this propagates; `ckpt_dir` is None otherwise — nothing
+    was saved, supervisors must restart from scratch."""
+
+    def __init__(self, step, ckpt_dir):
+        if ckpt_dir:
+            msg = ("training preempted at step %d; resumable checkpoint "
+                   "in %s" % (step, ckpt_dir))
+        else:
+            msg = ("training preempted at step %d; NO checkpoint "
+                   "directory configured — state was not saved" % step)
+        super().__init__(msg)
+        self.step = step
+        self.ckpt_dir = ckpt_dir
+
+
+class _Fault:
+    __slots__ = ("site", "steps", "at_calls", "times", "seconds",
+                 "fired", "calls")
+
+    def __init__(self, site, steps=None, at_calls=None, times=None,
+                 seconds=0.0):
+        self.site = site
+        self.steps = set(int(s) for s in steps) if steps else None
+        self.at_calls = set(int(c) for c in at_calls) if at_calls else None
+        # default: step-triggered faults fire at every listed step;
+        # call-triggered default to the listed ordinals only
+        self.times = times
+        self.seconds = float(seconds)
+        self.fired = 0
+        self.calls = 0
+
+
+_LOCK = threading.Lock()
+_FAULTS: Dict[str, List[_Fault]] = {}
+_FIRED: Dict[str, int] = {}
+# lock-free fast path: hot I/O loops call should_fire per record, and
+# the disarmed case must be a plain attribute read, not a lock acquire
+_ARMED = False
+
+
+def install(site: str, steps=None, at_calls=None, times: Optional[int] = None,
+            seconds: float = 0.0):
+    """Arm a fault at `site`.
+
+    steps:    step numbers at which the fault fires (the caller passes
+              its current step to `should_fire`)
+    at_calls: 1-based call ordinals at which the fault fires (for sites
+              with no step context, e.g. io.read)
+    times:    max total firings (None = unlimited within steps/at_calls)
+    seconds:  stall duration for slow-I/O style sites
+    """
+    if steps is None and at_calls is None:
+        at_calls = [1]
+    f = _Fault(site, steps, at_calls, times, seconds)
+    global _ARMED
+    with _LOCK:
+        _FAULTS.setdefault(site, []).append(f)
+        _ARMED = True
+    return f
+
+
+def clear(site: Optional[str] = None):
+    """Disarm one site, or everything (also zeroes firing counters)."""
+    global _ARMED
+    with _LOCK:
+        if site is None:
+            _FAULTS.clear()
+            _FIRED.clear()
+        else:
+            _FAULTS.pop(site, None)
+            _FIRED.pop(site, None)
+        _ARMED = bool(_FAULTS)
+
+
+def active_sites():
+    with _LOCK:
+        return sorted(_FAULTS)
+
+
+def fired_count(site: str) -> int:
+    with _LOCK:
+        return _FIRED.get(site, 0)
+
+
+def _parse_spec(spec: str):
+    """``site@step`` / ``site#call`` entries, ``;``-separated, optional
+    ``xN`` repeat and ``~S`` stall seconds: ``io.slow#1~0.2``."""
+    out = []
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        idx = max(entry.rfind("@"), entry.rfind("#"))
+        if idx < 1:
+            raise ValueError(
+                "bad MXNET_FAULT_PLAN entry %r: need site@step or "
+                "site#call" % entry)
+        site, sep, trig = entry[:idx], entry[idx], entry[idx + 1:]
+        times, seconds = None, 0.0
+        if "~" in trig:
+            trig, sec = trig.rsplit("~", 1)
+            seconds = float(sec)
+        if "x" in trig:
+            trig, n = trig.rsplit("x", 1)
+            times = int(n)
+        kw = dict(site=site, times=times, seconds=seconds)
+        kw["steps" if sep == "@" else "at_calls"] = [int(trig)]
+        out.append(kw)
+    return out
+
+
+def reset_from_config():
+    """Clear the registry and re-arm from ``MXNET_FAULT_PLAN``.
+    Returns the list of armed sites (empty plan = clean registry)."""
+    from . import config
+    clear()
+    spec = config.get("MXNET_FAULT_PLAN", "") or ""
+    for kw in _parse_spec(spec):
+        install(**kw)
+    return active_sites()
+
+
+def should_fire(site: str, step: Optional[int] = None) -> bool:
+    """True exactly when an armed fault at `site` matches this step /
+    this call ordinal (and has firings left).  Consumes one firing and
+    bumps the monitor's injected-fault counter when it does.
+
+    A call-ordinal fault with a `times` budget fires on CONSECUTIVE
+    calls starting at the ordinal (``io.read#2x3`` → calls 2, 3, 4
+    fail) — the shape retry-budget tests need."""
+    if not _ARMED:
+        return False
+    with _LOCK:
+        faults = _FAULTS.get(site)
+        if not faults:
+            return False
+        hit = None
+        for f in faults:
+            f.calls += 1
+            if hit is not None or \
+                    (f.times is not None and f.fired >= f.times):
+                continue
+            if f.steps is not None and step is not None and \
+                    int(step) in f.steps:
+                hit = f
+            elif f.at_calls is not None and \
+                    (f.calls in f.at_calls or
+                     (f.times is not None and f.fired > 0)):
+                hit = f
+            if hit is not None:
+                hit.fired += 1
+                _FIRED[site] = _FIRED.get(site, 0) + 1
+        if hit is None:
+            return False
+        seconds = hit.seconds
+    from .monitor import events
+    events.incr("fault.injected")
+    if seconds:
+        time.sleep(seconds)
+    return True
+
+
+def maybe_raise(site: str, step: Optional[int] = None,
+                exc_type=TransientFault, msg: Optional[str] = None):
+    """Raise `exc_type` if a fault at `site` fires (no-op otherwise)."""
+    if should_fire(site, step):
+        raise exc_type(msg or "injected fault at site %r (step %s)"
+                       % (site, step))
+
+
+def maybe_slow(site: str, step: Optional[int] = None):
+    """Stall if a slow-I/O fault at `site` fires (its `seconds` already
+    elapsed inside should_fire)."""
+    should_fire(site, step)

@@ -5,8 +5,10 @@ from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,
 from . import recordio
 from .recordio import (MXRecordIO, MXIndexedRecordIO, IRHeader, pack,
                        unpack, pack_img, unpack_img)
+from .resilient import RetryingReader, retry_io
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "LibSVMIter", "ImageRecordIter", "MNISTIter", "ResizeIter",
            "PrefetchingIter", "recordio", "MXRecordIO", "MXIndexedRecordIO",
-           "IRHeader", "pack", "unpack", "pack_img", "unpack_img"]
+           "IRHeader", "pack", "unpack", "pack_img", "unpack_img",
+           "RetryingReader", "retry_io"]

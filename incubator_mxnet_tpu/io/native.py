@@ -150,6 +150,9 @@ class NativeImageRecordReader:
     def next_batch(self):
         """Returns (data, label) with the actual sample count, or None at
         epoch end. Fresh buffers per batch — safe to hand to device_put."""
+        from .. import fault
+        fault.maybe_slow("io.slow")
+        fault.maybe_raise("io.read", exc_type=fault.InjectedIOError)
         shape = ((self._batch, 3, self._h, self._w) if self._nchw
                  else (self._batch, self._h, self._w, 3))
         label = _np.empty((self._batch, self._label_width), _np.float32)

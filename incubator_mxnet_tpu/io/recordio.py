@@ -90,6 +90,9 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
+        from .. import fault
+        fault.maybe_slow("io.slow")
+        fault.maybe_raise("io.read", exc_type=fault.InjectedIOError)
         header = self.handle.read(8)
         if len(header) < 8:
             return None

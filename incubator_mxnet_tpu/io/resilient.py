@@ -1,0 +1,97 @@
+"""Retrying I/O wrappers — storage blips must not kill a pod-scale run.
+
+`RetryingReader` wraps any reader object (MXRecordIO,
+NativeImageRecordReader, a DataIter, or anything with read-ish
+methods) and retries transient failures — IOError/OSError and injected
+`fault.TransientFault` — with exponential backoff.  Non-transient
+errors (corrupt framing raising ValueError, StopIteration) pass
+through untouched.
+
+    reader = RetryingReader(MXRecordIO(path, "r"))
+    buf = reader.read()          # survives a flaky NFS mount
+
+Retries are counted on `monitor.events` (``io.retry``); budgets come
+from MXNET_RETRY_MAX / MXNET_RETRY_BACKOFF unless overridden.
+"""
+from __future__ import annotations
+
+from .. import fault
+from ..monitor import events
+
+__all__ = ["RetryingReader", "retry_io"]
+
+#: method names proxied WITH retry; everything else proxies straight
+#: through (reset/seek mutate position — retrying those is the
+#: caller's decision, not a blanket policy)
+_RETRIED = ("read", "read_idx", "next_batch", "next", "__next__")
+
+
+def retry_io(fn, retries=None, backoff=None, what="io operation"):
+    """Run `fn()` under the transient-I/O retry policy.  Injected
+    faults fire INSIDE the reader (fault sites io.read / io.slow at the
+    actual I/O boundary), so what is retried here is exactly what a
+    real storage blip would raise."""
+    from ..parallel.resilience import retry_transient
+    return retry_transient(fn, retries=retries, backoff=backoff,
+                           what=what,
+                           retryable=(fault.TransientFault, OSError),
+                           event="io.retry")
+
+
+class RetryingReader:
+    """Transparent retry proxy around a reader object.
+
+    Retried methods re-invoke the underlying call after a transient
+    failure; if the wrapped reader exposes `reset()` and a retried
+    sequential `read` keeps failing, the caller still owns recovery
+    semantics — this wrapper never silently skips records."""
+
+    def __init__(self, reader, retries=None, backoff=None):
+        self._reader = reader
+        self._retries = retries
+        self._backoff = backoff
+
+    def __getattr__(self, name):
+        attr = getattr(self._reader, name)
+        if name in _RETRIED and callable(attr):
+            def wrapped(*args, **kw):
+                # sequential file readers: remember the position and
+                # rewind before every attempt, so a blip AFTER partial
+                # consumption (header read, payload failed) retries the
+                # whole record instead of resuming mid-stream
+                handle = getattr(self._reader, "handle", None)
+                pos = None
+                if handle is not None and hasattr(handle, "seek"):
+                    try:
+                        pos = handle.tell()
+                    except (OSError, ValueError):
+                        pos = None
+
+                def attempt():
+                    if pos is not None:
+                        handle.seek(pos)
+                    return attr(*args, **kw)
+                return retry_io(attempt,
+                                retries=self._retries,
+                                backoff=self._backoff,
+                                what="%s.%s" % (
+                                    type(self._reader).__name__, name))
+            return wrapped
+        return attr
+
+    def __iter__(self):
+        it = iter(self._reader)
+        while True:
+            try:
+                yield retry_io(lambda: next(it),
+                               retries=self._retries,
+                               backoff=self._backoff,
+                               what="%s iteration" % (
+                                   type(self._reader).__name__,))
+            except StopIteration:
+                return
+
+    def __next__(self):
+        return retry_io(lambda: next(self._reader),
+                        retries=self._retries, backoff=self._backoff,
+                        what="%s next" % (type(self._reader).__name__,))

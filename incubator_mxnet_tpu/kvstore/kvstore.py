@@ -190,10 +190,22 @@ class KVStore:
             "nothing to compress" % self.type)
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
+        """Atomic write (temp file + os.replace): a crash mid-write can
+        never leave a truncated states file where the old one was."""
         if self._updater is None:
             raise MXNetError("optimizer not set on kvstore")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+        import os
+        payload = self._updater.get_states(dump_optimizer)
+        tmp = "%s.tmp.%d" % (fname, os.getpid())
+        try:
+            with open(tmp, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, fname)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
 
     def load_optimizer_states(self, fname):
         if self._updater is None:
@@ -280,27 +292,100 @@ class DistKVStore(KVStore):
         self._residuals: Dict = {}
 
     # -- cross-process primitives --------------------------------------
+    def _retry(self, fn, what):
+        """Retry around the wire aggregate.  Scoped to INJECTED
+        transients only: a real partial collective failure must not be
+        retried per-rank — peers that succeeded have moved on, and an
+        uncoordinated re-entry would mismatch collectives across the
+        job (deadlock or wrong sums).  Real failures propagate so the
+        worker fails fast and the scheduler restarts it."""
+        from ..parallel.resilience import retry_transient
+        from .. import fault as _fault
+
+        def attempt():
+            _fault.maybe_raise("kvstore.collective")
+            return fn()
+        return retry_transient(attempt, what=what,
+                               retryable=(_fault.TransientFault,))
+
     def _allreduce_sum(self, data):
         if self.num_workers == 1:
             return data
         from jax.experimental import multihost_utils
         import numpy as _np
-        gathered = multihost_utils.process_allgather(_np.asarray(data))
-        return jnp.asarray(_np.sum(gathered, axis=0, dtype=_np.float64)
-                           .astype(_np.asarray(data).dtype))
+
+        def run():
+            gathered = multihost_utils.process_allgather(_np.asarray(data))
+            return jnp.asarray(
+                _np.sum(gathered, axis=0, dtype=_np.float64)
+                .astype(_np.asarray(data).dtype))
+        return self._retry(run, "kvstore allreduce (rank %d)" % self.rank)
 
     def _bcast_from_root(self, data):
         if self.num_workers == 1:
             return data
         from jax.experimental import multihost_utils
         import numpy as _np
-        return jnp.asarray(multihost_utils.broadcast_one_to_all(
-            _np.asarray(data)))
 
-    def _barrier(self):
-        if self.num_workers > 1:
+        def run():
+            return jnp.asarray(multihost_utils.broadcast_one_to_all(
+                _np.asarray(data)))
+        return self._retry(run, "kvstore broadcast (rank %d)" % self.rank)
+
+    def _barrier(self, timeout=None):
+        """Barrier with a deadline: a worker that never arrives (hung
+        host, dead process) turns into a clear rank-tagged error on the
+        waiting workers instead of an indefinite hang.  `timeout` in
+        seconds (default MXNET_KVSTORE_BARRIER_TIMEOUT; 0 = wait
+        forever, the reference behaviour).
+
+        On timeout the waiter thread is abandoned mid-collective, so
+        the process must be treated as wedged: the error is terminal —
+        exit and let the scheduler restart the worker; do not issue
+        further kvstore ops from this process."""
+        from .. import config, fault as _fault
+        if timeout is None:
+            timeout = float(config.get("MXNET_KVSTORE_BARRIER_TIMEOUT"))
+        hang = _fault.should_fire("kvstore.barrier_hang")
+        if self.num_workers <= 1 and not hang:
+            return
+
+        def wait():
+            if hang:
+                # injected stuck-peer: stall just long enough to trip
+                # the deadline (bounded, so the abandoned daemon thread
+                # doesn't linger for hours in long test processes)
+                import time
+                time.sleep(max(timeout, 0.1) + 5)
+                return
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("kvstore_barrier")
+
+        if timeout <= 0:
+            return wait()
+        import threading
+        err = []
+
+        def body():
+            try:
+                wait()
+            except Exception as e:        # surfaced after join
+                err.append(e)
+        t = threading.Thread(target=body, daemon=True,
+                             name="kvstore_barrier")
+        t.start()
+        t.join(timeout)
+        if t.is_alive():
+            from ..monitor import events
+            events.incr("kvstore.barrier_timeout")
+            raise MXNetError(
+                "kvstore barrier timed out after %.1fs on worker rank "
+                "%d/%d — a peer is hung or dead; exit and let the "
+                "scheduler restart this worker (raise "
+                "MXNET_KVSTORE_BARRIER_TIMEOUT if the pod is just slow)"
+                % (timeout, self.rank, self.num_workers))
+        if err:
+            raise err[0]
 
     # -- overridden API -------------------------------------------------
     def init(self, key, value):

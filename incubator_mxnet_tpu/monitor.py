@@ -13,8 +13,52 @@ from __future__ import annotations
 
 import logging
 import re
+import threading
 
-__all__ = ["Monitor"]
+__all__ = ["Monitor", "EventCounters", "events"]
+
+
+class EventCounters:
+    """Named monotonic counters for recovery/fault observability.
+
+    The resilience layer (`parallel.resilience`, `fault`, `kvstore`,
+    `io`) reports every recovery action here so a run's survival story
+    is inspectable: checkpoints written, steps skipped on non-finite
+    loss, rollbacks, transient-failure retries, injected faults.
+    Thread-safe; process-local (each worker reports its own counts,
+    matching per-worker ps-lite server stats in the reference).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}
+
+    def incr(self, name: str, n: int = 1) -> int:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + int(n)
+            return self._counts[name]
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+    def log_nonzero(self, logger=None) -> None:
+        logger = logger or logging.getLogger(__name__)
+        for name, v in sorted(self.snapshot().items()):
+            if v:
+                logger.info("event %-36s %d", name, v)
+
+
+#: process-wide event counters (the resilience layer's shared ledger)
+events = EventCounters()
 
 
 class Monitor:

@@ -8,6 +8,7 @@ from .mesh import make_mesh, Mesh, NamedSharding, P, replicated, \
 from .functional import functionalize, extract_params, load_params
 from .trainer import (ShardedTrainer, softmax_ce_loss, sgd_momentum_tree,
                       adam_tree)
+from .resilience import ResilientTrainer, retry_transient
 from .pipeline import (pipeline_apply, split_microbatches,
                        stack_stage_params)
 from .moe import switch_route, moe_apply, moe_ffn
@@ -19,5 +20,6 @@ __all__ = ["make_mesh", "Mesh", "NamedSharding", "P", "replicated",
            "switch_route", "moe_apply", "moe_ffn",
            "batch_sharded", "default_dp_mesh", "functionalize",
            "extract_params", "load_params", "ShardedTrainer",
+           "ResilientTrainer", "retry_transient",
            "softmax_ce_loss", "sgd_momentum_tree", "adam_tree",
            "ring_attention", "ulysses_attention", "local_attention"]

@@ -1,0 +1,480 @@
+"""Fault-tolerant training — the layer that survives pod-scale reality.
+
+`ResilientTrainer` wraps a `ShardedTrainer` and keeps a run alive
+through the three failure families that kill long jobs:
+
+1. **Numeric blow-ups** — the train step is re-jitted as a GUARDED
+   step: loss finiteness, gradient finiteness and a loss-spike
+   threshold are evaluated INSIDE the executable, and the parameter /
+   optimizer-state update is applied only when the step is good
+   (``jnp.where`` select — the old state passes through, donation and
+   sharding intact).  Bad steps also drive an AMP ``LossScaler``-style
+   backoff; after N consecutive bad steps the trainer rolls back to
+   the last checkpoint.
+2. **Preemption** — a SIGTERM (real, or injected via `fault`) sets a
+   flag; the loop finishes the in-flight step, writes an atomic
+   checkpoint plus a ``PREEMPTED`` resumable marker, and raises
+   `fault.Preempted`.  `resume()` restores params, optimizer state,
+   step counter AND the per-step RNG derivation, so the resumed run is
+   bit-identical to an uninterrupted one on the same topology.
+3. **Transient I/O / collective failures** — step dispatch and
+   checkpoint writes retry with exponential backoff on
+   `fault.TransientFault` / OSError.
+
+Checkpoints are atomic by construction: orbax writes into a hidden
+temp directory, run metadata (step, RNG seed, loss EMA, loss scale)
+is added, and one ``os.replace`` publishes the complete directory as
+``step_<n>``; a ``LATEST`` pointer file is replaced the same way.
+Keep-last-K garbage collection runs after each successful publish, and
+`resume()` falls back through older checkpoints when the newest is
+corrupt or partial.
+
+Every recovery action is counted on `monitor.events`
+(``resilience.*`` counters) so survival is observable, not silent.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import logging
+import os
+import shutil
+import signal
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import fault
+from ..monitor import events
+from ..contrib.amp.loss_scaler import LossScaler
+
+__all__ = ["ResilientTrainer", "retry_transient"]
+
+log = logging.getLogger(__name__)
+
+_LATEST = "LATEST"
+_PREEMPT_MARKER = "PREEMPTED"
+_META = "resilience_meta.json"
+_STEP_PREFIX = "step_"
+_TMP_PREFIX = ".tmp_"
+
+
+def retry_transient(fn, retries=None, backoff=None, what="operation",
+                    retryable=(fault.TransientFault, OSError),
+                    event="resilience.retry"):
+    """Call `fn()`, retrying `retries` times with exponential backoff on
+    transient failures.  Each retry increments `event` on
+    monitor.events (callers pick their own counter so concurrent
+    retries in different subsystems don't pollute each other)."""
+    from .. import config
+    if retries is None:
+        retries = int(config.get("MXNET_RETRY_MAX"))
+    if backoff is None:
+        backoff = float(config.get("MXNET_RETRY_BACKOFF"))
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retryable as e:
+            attempt += 1
+            if attempt > retries:
+                raise
+            events.incr(event)
+            log.warning("%s failed (%s); retry %d/%d in %.3fs",
+                        what, e, attempt, retries, backoff)
+            time.sleep(backoff)
+            backoff *= 2.0
+
+
+class ResilientTrainer:
+    """Resilient wrapper around a `ShardedTrainer`.
+
+    trainer:        the ShardedTrainer whose params/opt_state this
+                    wrapper owns and protects
+    ckpt_dir:       checkpoint directory (created; None disables
+                    checkpointing, rollback and preemption saves)
+    ckpt_interval:  steps between periodic checkpoints
+                    (default: MXNET_CKPT_INTERVAL)
+    keep:           checkpoints retained (default: MXNET_CKPT_KEEP)
+    spike_factor:   skip the update when loss > factor × running mean
+                    (default: MXNET_LOSS_SPIKE_FACTOR; 0 = off)
+    rollback_after: consecutive bad steps before rolling back to the
+                    last checkpoint (default: MXNET_BAD_STEP_ROLLBACK;
+                    0 = skip-only)
+    seed:           base seed for the per-step RNG stream —
+                    ``fold_in(key(seed), step)`` — which makes resume
+                    bit-deterministic with no key state to carry
+    loss_scaler:    optional amp.LossScaler driving loss scaling with
+                    backoff on bad steps (default: scale 1.0)
+    handle_sigterm: install a SIGTERM handler that converts preemption
+                    into checkpoint-and-clean-exit (main thread only)
+
+    Cost model: unlike ShardedTrainer.step (async dispatch, loss left
+    on device), every guarded step materialises `loss`/`ok` on the
+    host — the guard decisions (skip accounting, spike EMA, scaler
+    backoff, rollback trigger) are host control flow.  That forfeits
+    dispatch/compute overlap; runs that want raw throughput keep using
+    ShardedTrainer directly and accept blow-ups, or checkpoint
+    externally.  Amortising the sync (check every K steps) is a
+    follow-up.
+    """
+
+    def __init__(self, trainer, ckpt_dir: Optional[str] = None,
+                 ckpt_interval: Optional[int] = None,
+                 keep: Optional[int] = None,
+                 spike_factor: Optional[float] = None,
+                 rollback_after: Optional[int] = None,
+                 seed: int = 0, ema_decay: float = 0.9,
+                 loss_scaler: Optional[LossScaler] = None,
+                 handle_sigterm: bool = True):
+        from .. import config
+        self.trainer = trainer
+        self.ckpt_dir = os.path.abspath(ckpt_dir) if ckpt_dir else None
+        self.ckpt_interval = int(ckpt_interval if ckpt_interval is not None
+                                 else config.get("MXNET_CKPT_INTERVAL"))
+        self.keep = int(keep if keep is not None
+                        else config.get("MXNET_CKPT_KEEP"))
+        self.spike_factor = float(
+            spike_factor if spike_factor is not None
+            else config.get("MXNET_LOSS_SPIKE_FACTOR"))
+        self.rollback_after = int(
+            rollback_after if rollback_after is not None
+            else config.get("MXNET_BAD_STEP_ROLLBACK"))
+        self.seed = int(seed)
+        self.ema_decay = float(ema_decay)
+        self.loss_ema = None               # running mean of good losses
+        self.scaler = loss_scaler or LossScaler(init_scale=1.0)
+        self.bad_steps = 0                 # consecutive skipped steps
+        self._gstep = None
+        self._preempted = False
+        self._prev_sigterm = None
+        if self.ckpt_dir:
+            os.makedirs(self.ckpt_dir, exist_ok=True)
+        # cached so the per-step path never lists ckpt_dir (which can be
+        # a remote mount); maintained by checkpoint()/resume()
+        self._have_ckpt = bool(self._list_checkpoints())
+        if handle_sigterm:
+            self._install_sigterm()
+
+    # -- signal / preemption -------------------------------------------
+    def _install_sigterm(self):
+        def _on_sigterm(signum, frame):
+            # flag only: the in-flight step finishes, then the loop
+            # checkpoints from a consistent state (signal-safe)
+            self._preempted = True
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            # not the main thread: preemption can still be requested
+            # programmatically via request_preemption()
+            self._prev_sigterm = None
+
+    def uninstall_sigterm(self):
+        if self._prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except ValueError:
+                pass
+            self._prev_sigterm = None
+
+    def request_preemption(self):
+        """Programmatic SIGTERM equivalent (tests, cluster agents)."""
+        self._preempted = True
+
+    @property
+    def step_number(self) -> int:
+        return self.trainer._n_step
+
+    # -- the guarded step ----------------------------------------------
+    def _build_guarded_step(self):
+        t = self.trainer
+        fwd = t._fwd
+        loss_fn = t.loss_fn
+        opt_update = t._opt_update
+        constrain = functools.partial(
+            t._place_opt_tree, place=jax.lax.with_sharding_constraint) \
+            if t.zero else (lambda tree, **_: tree)
+
+        def gstep(params, opt_state, batch, labels, rng_bits,
+                  poison, spike_thresh, loss_scale):
+            def lf(p):
+                out, states = fwd(p, batch, rng_bits=rng_bits)
+                return loss_fn(out, labels) * loss_scale, states
+            (scaled_loss, states), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
+            # fault injection rides in as a traced scalar (1.0 or NaN /
+            # spike multiplier): no recompile on the poisoned step
+            scaled_loss = scaled_loss * poison
+            grads = jax.tree_util.tree_map(lambda g: g * poison, grads)
+            loss = scaled_loss / loss_scale
+            # overflow check on the SCALED grads (the AMP contract),
+            # spike check on the unscaled loss
+            ok = jnp.isfinite(loss) & (loss <= spike_thresh)
+            for g in jax.tree_util.tree_leaves(grads):
+                ok &= jnp.all(jnp.isfinite(g))
+            # unscale explicitly — custom (init, update) optimizer pairs
+            # need not accept a scale kwarg
+            grads = jax.tree_util.tree_map(
+                lambda g: g / loss_scale, grads)
+            new_params, new_opt = opt_update(params, grads, opt_state)
+            new_opt = constrain(new_opt)
+            for k, v in states.items():
+                if k in new_params:
+                    new_params[k] = v.astype(new_params[k].dtype)
+            # guarded commit: bad step → the old state passes through
+            sel = lambda new, old: jax.tree_util.tree_map(
+                lambda n, o: jnp.where(ok, n, o), new, old)
+            new_params = sel(new_params, params)
+            new_opt = sel(new_opt, opt_state)
+            new_params = {
+                n: jax.lax.with_sharding_constraint(
+                    v, t._param_shardings[n])
+                for n, v in new_params.items()}
+            return new_params, new_opt, loss, ok
+
+        return jax.jit(gstep, donate_argnums=(0, 1))
+
+    def _rng_bits(self, step: int):
+        """Per-step RNG stream: a pure function of (seed, step), so the
+        checkpoint only needs the step counter for bit-exact resume."""
+        return jax.random.key_data(
+            jax.random.fold_in(jax.random.key(self.seed), step))
+
+    # -- training ------------------------------------------------------
+    def step(self, batch, labels):
+        """One guarded train step.  Returns (loss, ok): `loss` as a
+        float (NaN on a skipped step), `ok` whether the update was
+        applied.  Raises `fault.Preempted` after a preemption was
+        handled (state is checkpointed and resumable)."""
+        t = self.trainer
+        stepno = t._n_step
+        if self._gstep is None:
+            self._gstep = self._build_guarded_step()
+        if self.ckpt_dir and not self._have_ckpt:
+            # rollback target before the first update
+            self.checkpoint()
+
+        if fault.should_fire("preempt", stepno):
+            # injected preemption goes through the REAL signal path
+            signal.raise_signal(signal.SIGTERM)
+
+        poison = 1.0
+        if fault.should_fire("grad_nan", stepno):
+            poison = float("nan")
+        elif fault.should_fire("loss_spike", stepno):
+            poison = 1e4
+        spike_thresh = float("inf")
+        if self.spike_factor > 0 and self.loss_ema is not None:
+            spike_thresh = self.spike_factor * self.loss_ema
+
+        batch_g = t._place_batch(batch, t._batch_sharding)
+        labels_g = t._place_batch(
+            labels, NamedSharding(t.mesh, P(t.batch_axis)))
+
+        def dispatch():
+            # transient collective failures surface at dispatch time
+            fault.maybe_raise("collective", stepno)
+            return self._gstep(t.params, t.opt_state, batch_g, labels_g,
+                               self._rng_bits(stepno), poison,
+                               spike_thresh, self.scaler.loss_scale)
+        new_params, new_opt, loss, ok = retry_transient(
+            dispatch, what="train step %d" % stepno,
+            retryable=(fault.TransientFault,))
+        t.params, t.opt_state = new_params, new_opt
+        t._n_step = stepno + 1
+
+        ok = bool(ok)
+        loss = float(loss)
+        self.scaler.update(overflow=not ok)
+        if ok:
+            self.bad_steps = 0
+            self.loss_ema = loss if self.loss_ema is None else \
+                self.ema_decay * self.loss_ema + \
+                (1.0 - self.ema_decay) * loss
+        else:
+            self.bad_steps += 1
+            events.incr("resilience.step_skipped")
+            log.warning("step %d skipped (non-finite or spiking loss); "
+                        "%d consecutive bad steps", stepno, self.bad_steps)
+            if self.ckpt_dir and self.rollback_after and \
+                    self.bad_steps >= self.rollback_after:
+                self.rollback()
+
+        if self._preempted:
+            self._handle_preemption()
+        elif self.ckpt_dir and self.ckpt_interval > 0 and \
+                t._n_step % self.ckpt_interval == 0:
+            # interval <= 0: no periodic checkpoints (preemption and
+            # rollback saves still work off the initial one)
+            self.checkpoint()
+        return loss, ok
+
+    # -- checkpointing -------------------------------------------------
+    def _ckpt_name(self, step):
+        return "%s%08d" % (_STEP_PREFIX, step)
+
+    def _list_checkpoints(self):
+        """[(step, dirname)] ascending; only completed (published)
+        checkpoints — temp dirs are invisible by construction."""
+        if not self.ckpt_dir or not os.path.isdir(self.ckpt_dir):
+            return []
+        out = []
+        for name in os.listdir(self.ckpt_dir):
+            if name.startswith(_STEP_PREFIX):
+                try:
+                    out.append((int(name[len(_STEP_PREFIX):]), name))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def checkpoint(self):
+        """Atomic checkpoint of params + optimizer state + step + run
+        metadata: orbax-write into a temp dir, publish with one rename,
+        update LATEST, garbage-collect beyond keep-K."""
+        if not self.ckpt_dir:
+            raise ValueError("ResilientTrainer built without ckpt_dir")
+        t = self.trainer
+        step = t._n_step
+        final = os.path.join(self.ckpt_dir, self._ckpt_name(step))
+        if os.path.isdir(final):
+            # a checkpoint for this exact step already exists (typical
+            # right after rollback: the restored step is the one the
+            # periodic schedule fires on).  The params/opt state for a
+            # step are deterministic within a run, so rewriting would
+            # only re-serialize identical data — and deleting the
+            # published dir to make room would break the no-window
+            # atomicity guarantee.  Point LATEST at it and move on.
+            self._publish_latest(self._ckpt_name(step))
+            self._have_ckpt = True
+            return final
+        tmp = os.path.join(self.ckpt_dir,
+                           _TMP_PREFIX + self._ckpt_name(step))
+
+        def write():
+            fault.maybe_raise("checkpoint.save", step,
+                              exc_type=fault.InjectedIOError)
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            t.save_checkpoint(tmp)
+            meta = {"step": step, "seed": self.seed,
+                    "loss_ema": self.loss_ema,
+                    "loss_scale": self.scaler.loss_scale,
+                    "scaler_unskipped": self.scaler._unskipped,
+                    "bad_steps": self.bad_steps}
+            with open(os.path.join(tmp, _META), "w") as f:
+                json.dump(meta, f)
+            os.replace(tmp, final)
+
+        retry_transient(write, what="checkpoint step %d" % step)
+        self._publish_latest(self._ckpt_name(step))
+        self._have_ckpt = True
+        events.incr("resilience.checkpoint_written")
+        self._gc()
+        return final
+
+    def _publish_latest(self, name):
+        latest_tmp = os.path.join(self.ckpt_dir, _LATEST + ".tmp")
+        with open(latest_tmp, "w") as f:
+            f.write(name)
+        os.replace(latest_tmp, os.path.join(self.ckpt_dir, _LATEST))
+
+    def _gc(self):
+        if self.keep <= 0:
+            return
+        for _, name in self._list_checkpoints()[:-self.keep]:
+            shutil.rmtree(os.path.join(self.ckpt_dir, name),
+                          ignore_errors=True)
+
+    def rollback(self):
+        """Roll back to the last good checkpoint after rollback_after
+        consecutive bad steps: params, optimizer state, step counter
+        and RNG derivation all rewind; the backed-off loss scale is
+        KEPT so the replayed steps run at the reduced scale."""
+        scale = self.scaler.loss_scale
+        if not self.resume():
+            raise RuntimeError(
+                "rollback requested but no usable checkpoint in %s"
+                % self.ckpt_dir)
+        self.scaler.loss_scale = scale
+        self.bad_steps = 0
+        events.incr("resilience.rollback")
+        log.warning("rolled back to step %d after repeated bad steps",
+                    self.trainer._n_step)
+
+    def _handle_preemption(self):
+        self._preempted = False
+        step = self.trainer._n_step
+        if self.ckpt_dir:
+            self.checkpoint()
+            marker_tmp = os.path.join(self.ckpt_dir,
+                                      _PREEMPT_MARKER + ".tmp")
+            with open(marker_tmp, "w") as f:
+                json.dump({"step": step}, f)
+            os.replace(marker_tmp,
+                       os.path.join(self.ckpt_dir, _PREEMPT_MARKER))
+        events.incr("resilience.preemption")
+        log.warning("preemption handled at step %d; checkpoint saved",
+                    step)
+        raise fault.Preempted(step, self.ckpt_dir)
+
+    @staticmethod
+    def was_preempted(ckpt_dir) -> bool:
+        return os.path.exists(os.path.join(ckpt_dir, _PREEMPT_MARKER))
+
+    # -- restore -------------------------------------------------------
+    def _restore_from(self, name) -> bool:
+        path = os.path.join(self.ckpt_dir, name)
+        meta_path = os.path.join(path, _META)
+        with open(meta_path) as f:
+            meta = json.load(f)
+        self.trainer.load_checkpoint(path)
+        if int(meta["step"]) != self.trainer._n_step:
+            raise ValueError(
+                "checkpoint %s metadata step %s != restored step %d"
+                % (name, meta["step"], self.trainer._n_step))
+        if int(meta.get("seed", self.seed)) != self.seed:
+            raise ValueError(
+                "checkpoint %s was written with RNG seed %s but this "
+                "trainer uses seed %d — resume would not be "
+                "deterministic" % (name, meta.get("seed"), self.seed))
+        self.loss_ema = meta.get("loss_ema")
+        self.scaler.loss_scale = float(meta.get("loss_scale", 1.0))
+        self.scaler._unskipped = int(meta.get("scaler_unskipped", 0))
+        self.bad_steps = int(meta.get("bad_steps", 0))
+        return True
+
+    def resume(self) -> bool:
+        """Restore the newest valid checkpoint, falling back through
+        older keep-K checkpoints when the newest is corrupt/partial.
+        Returns True when a checkpoint was restored (and clears any
+        PREEMPTED marker), False for a fresh start."""
+        if not self.ckpt_dir:
+            return False
+        candidates = [name for _, name in reversed(self._list_checkpoints())]
+        latest_path = os.path.join(self.ckpt_dir, _LATEST)
+        if os.path.exists(latest_path):
+            with open(latest_path) as f:
+                latest = f.read().strip()
+            if latest in candidates:
+                candidates.remove(latest)
+                candidates.insert(0, latest)
+        for name in candidates:
+            try:
+                self._restore_from(name)
+            except (OSError, ValueError, KeyError) as e:
+                events.incr("resilience.restore_fallback")
+                log.warning("checkpoint %s unusable (%s); falling back "
+                            "to the previous one", name, e)
+                continue
+            marker = os.path.join(self.ckpt_dir, _PREEMPT_MARKER)
+            if os.path.exists(marker):
+                os.remove(marker)
+            self._have_ckpt = True
+            events.incr("resilience.restored")
+            log.info("resumed from %s at step %d", name,
+                     self.trainer._n_step)
+            return True
+        return False

@@ -329,6 +329,12 @@ class ShardedTrainer:
                     "checkpoint param %s has shape %s but trainer "
                     "expects %s" % (n, tuple(v.shape),
                                     tuple(self.params[n].shape)))
+            if jnp.dtype(v.dtype) != jnp.dtype(self.params[n].dtype):
+                raise ValueError(
+                    "checkpoint param %s has dtype %s but trainer "
+                    "expects %s (mixed-precision config drift?)"
+                    % (n, jnp.dtype(v.dtype).name,
+                       jnp.dtype(self.params[n].dtype).name))
         self.params = {
             n: self._place_value(v, self._param_shardings[n])
             for n, v in params.items()}

@@ -31,3 +31,24 @@ def _seed_everything(request):
     import incubator_mxnet_tpu as mx
     mx.random.seed(seed)
     yield
+
+
+def pytest_configure(config):
+    # the resilience suite is CPU-fast and runs in tier-1 by default;
+    # the marker exists so fault-injection tests can be selected or
+    # excluded explicitly (pytest -m fault / -m 'not fault')
+    config.addinivalue_line(
+        "markers", "fault: fault-injection resilience tests (CPU-fast, "
+        "run in tier-1 by default)")
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1")
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_registry():
+    """No armed fault may leak across tests (determinism of the whole
+    corpus); cheap no-op when the registry is empty."""
+    import incubator_mxnet_tpu.fault as fault
+    fault.clear()
+    yield
+    fault.clear()

@@ -1,0 +1,411 @@
+"""Fault-tolerant training: every recovery path exercised on CPU via
+deterministic fault injection (fault.py).  The scenarios mirror what
+kills real pod-scale runs: NaN gradients, loss spikes, preemption
+mid-run, corrupt/partial checkpoints, flaky storage, hung barriers."""
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import fault, gluon, nd, parallel
+from incubator_mxnet_tpu.monitor import events
+
+import jax
+
+pytestmark = pytest.mark.fault
+
+
+def _build_trainer(seed=7, optimizer="adam"):
+    """Fresh net + ShardedTrainer with stable param names (checkpoint
+    portability needs fixed prefixes, as in test_parallel)."""
+    mx.random.seed(seed)
+    net = gluon.nn.HybridSequential(prefix="rz_")
+    net.add(gluon.nn.Dense(16, in_units=8, activation="relu",
+                           prefix="rz_d1_"),
+            gluon.nn.Dense(4, in_units=16, prefix="rz_d2_"))
+    net.initialize(force_reinit=True)
+    net(nd.ones((2, 8)))
+    return parallel.ShardedTrainer(net, optimizer=optimizer, lr=1e-2)
+
+
+def _data(n_steps, batch=8, seed=0):
+    rs = np.random.RandomState(seed)
+    return ([rs.randn(batch, 8).astype(np.float32) for _ in range(n_steps)],
+            [rs.randint(0, 4, batch) for _ in range(n_steps)])
+
+
+# ---------------------------------------------------------------------------
+# fault registry
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_spec_parsing():
+    from incubator_mxnet_tpu import config
+    config.set("MXNET_FAULT_PLAN", "grad_nan@3;preempt@7;io.read#2x3")
+    try:
+        sites = fault.reset_from_config()
+        assert sites == ["grad_nan", "io.read", "preempt"]
+        assert not fault.should_fire("grad_nan", 2)
+        assert fault.should_fire("grad_nan", 3)
+        assert fault.fired_count("grad_nan") == 1
+    finally:
+        config.unset("MXNET_FAULT_PLAN")
+        fault.clear()
+
+
+def test_fault_call_ordinal_and_times():
+    fault.install("io.read", at_calls=[2], times=1)
+    assert not fault.should_fire("io.read")      # call 1
+    assert fault.should_fire("io.read")          # call 2 fires
+    assert not fault.should_fire("io.read")      # budget spent
+    fault.clear()
+    with pytest.raises(fault.TransientFault):
+        fault.install("io.read", at_calls=[1])
+        fault.maybe_raise("io.read")
+
+
+# ---------------------------------------------------------------------------
+# guarded step: NaN / spike skip, loss-scale backoff, rollback
+# ---------------------------------------------------------------------------
+
+def test_nan_step_is_skipped_with_counter(tmp_path):
+    xs, ys = _data(5)
+    rt = parallel.ResilientTrainer(_build_trainer(),
+                                   ckpt_dir=str(tmp_path / "ck"),
+                                   seed=123, handle_sigterm=False)
+    fault.install("grad_nan", steps=[2], times=1)
+    skipped0 = events.get("resilience.step_skipped")
+    results = []
+    for i in range(5):
+        if i == 2:
+            params_before_bad = {k: np.asarray(v)
+                                 for k, v in rt.trainer.params.items()}
+        results.append(rt.step(xs[i], ys[i]))
+        if i == 2:
+            # the poisoned update was NOT applied: params identical
+            # across the skipped step
+            for k, v in rt.trainer.params.items():
+                assert np.array_equal(np.asarray(v),
+                                      params_before_bad[k]), k
+    losses, oks = zip(*results)
+    assert oks == (True, True, False, True, True)
+    assert np.isnan(losses[2])
+    assert all(np.isfinite(l) for i, l in enumerate(losses) if i != 2)
+    assert events.get("resilience.step_skipped") == skipped0 + 1
+    # ...but the step counter advanced (the batch was consumed)
+    assert rt.step_number == 5
+
+
+def test_loss_scaler_backoff_on_bad_step(tmp_path):
+    from incubator_mxnet_tpu.contrib.amp.loss_scaler import LossScaler
+    xs, ys = _data(3)
+    rt = parallel.ResilientTrainer(
+        _build_trainer(), ckpt_dir=str(tmp_path / "ck"), seed=123,
+        loss_scaler=LossScaler(init_scale=256.0), handle_sigterm=False)
+    fault.install("grad_nan", steps=[1], times=1)
+    rt.step(xs[0], ys[0])
+    assert rt.scaler.loss_scale == 256.0
+    _, ok = rt.step(xs[1], ys[1])
+    assert not ok and rt.scaler.loss_scale == 128.0
+
+
+def test_loss_spike_is_skipped(tmp_path):
+    xs, ys = _data(6)
+    rt = parallel.ResilientTrainer(_build_trainer(),
+                                   ckpt_dir=str(tmp_path / "ck"),
+                                   spike_factor=5.0, seed=123,
+                                   handle_sigterm=False)
+    for i in range(3):                     # build the loss EMA
+        _, ok = rt.step(xs[i], ys[i])
+        assert ok
+    fault.install("loss_spike", steps=[3], times=1)
+    _, ok = rt.step(xs[3], ys[3])
+    assert not ok                          # 1e4x loss > 5x running mean
+    _, ok = rt.step(xs[4], ys[4])
+    assert ok
+
+
+def test_rollback_after_consecutive_bad_steps(tmp_path):
+    xs, ys = _data(8)
+    rt = parallel.ResilientTrainer(_build_trainer(),
+                                   ckpt_dir=str(tmp_path / "ck"),
+                                   ckpt_interval=100, rollback_after=2,
+                                   seed=123, handle_sigterm=False)
+    rollbacks0 = events.get("resilience.rollback")
+    rt.step(xs[0], ys[0])
+    rt.step(xs[1], ys[1])
+    fault.install("grad_nan", steps=[2], times=1)
+    fault.install("grad_nan", steps=[3], times=1)
+    _, ok = rt.step(xs[2], ys[2])
+    assert not ok and rt.step_number == 3
+    _, ok = rt.step(xs[3], ys[3])          # 2nd consecutive bad → rollback
+    assert not ok
+    assert events.get("resilience.rollback") == rollbacks0 + 1
+    # rewound to the initial (step 0) checkpoint; faults are spent, so
+    # the replayed steps are clean
+    assert rt.step_number == 0 and rt.bad_steps == 0
+    for i in range(4):
+        _, ok = rt.step(xs[i], ys[i])
+        assert ok
+
+
+# ---------------------------------------------------------------------------
+# transient collective failure: retry with backoff
+# ---------------------------------------------------------------------------
+
+def test_step_retries_transient_collective_failure(tmp_path):
+    xs, ys = _data(2)
+    rt = parallel.ResilientTrainer(_build_trainer(),
+                                   ckpt_dir=str(tmp_path / "ck"),
+                                   seed=123, handle_sigterm=False)
+    fault.install("collective", at_calls=[1], times=1)
+    retries0 = events.get("resilience.retry")
+    loss, ok = rt.step(xs[0], ys[0])       # first dispatch fails, retried
+    assert ok and np.isfinite(loss)
+    assert events.get("resilience.retry") == retries0 + 1
+
+
+# ---------------------------------------------------------------------------
+# preemption: checkpoint + clean exit + bit-deterministic resume
+# ---------------------------------------------------------------------------
+
+def test_preemption_resume_matches_uninterrupted(tmp_path):
+    """The acceptance scenario: injected preemption at step k; the
+    resumed run must reproduce the uninterrupted run's losses AND
+    params bit-exactly at step k+m (CPU)."""
+    n = 10
+    xs, ys = _data(n)
+
+    # run A: uninterrupted
+    rt_a = parallel.ResilientTrainer(_build_trainer(),
+                                     ckpt_dir=str(tmp_path / "a"),
+                                     seed=123, handle_sigterm=False)
+    losses_a = [rt_a.step(xs[i], ys[i])[0] for i in range(n)]
+    params_a = {k: np.asarray(v) for k, v in rt_a.trainer.params.items()}
+
+    # run B: preempted at step 5 through the real SIGTERM path
+    dir_b = str(tmp_path / "b")
+    rt_b = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=dir_b,
+                                     seed=123)
+    try:
+        fault.install("preempt", steps=[5], times=1)
+        preempted_at = None
+        try:
+            for i in range(n):
+                rt_b.step(xs[i], ys[i])
+        except fault.Preempted as e:
+            preempted_at = e.step
+        assert preempted_at == 6           # step 5 finished, then saved
+        assert parallel.ResilientTrainer.was_preempted(dir_b)
+    finally:
+        rt_b.uninstall_sigterm()
+
+    # run C: fresh process state, resume from B's checkpoint
+    rt_c = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=dir_b,
+                                     seed=123, handle_sigterm=False)
+    assert rt_c.resume()
+    assert rt_c.step_number == 6
+    assert not parallel.ResilientTrainer.was_preempted(dir_b)
+    losses_c = [rt_c.step(xs[i], ys[i])[0] for i in range(6, n)]
+    assert losses_c == losses_a[6:], (losses_c, losses_a[6:])
+    for k, v in rt_c.trainer.params.items():
+        assert np.array_equal(np.asarray(v), params_a[k]), k
+
+
+# ---------------------------------------------------------------------------
+# atomic checkpoints: keep-K GC + corrupt-checkpoint fallback
+# ---------------------------------------------------------------------------
+
+def test_keep_k_garbage_collection(tmp_path):
+    xs, ys = _data(7)
+    ck = str(tmp_path / "ck")
+    rt = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                   ckpt_interval=2, keep=2, seed=123,
+                                   handle_sigterm=False)
+    for i in range(7):
+        rt.step(xs[i], ys[i])
+    names = sorted(d for d in os.listdir(ck) if d.startswith("step_"))
+    assert names == ["step_00000004", "step_00000006"]
+    assert not any(d.startswith(".tmp_") for d in os.listdir(ck))
+
+
+def test_corrupt_checkpoint_falls_back_to_previous(tmp_path):
+    xs, ys = _data(6)
+    ck = str(tmp_path / "ck")
+    rt = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                   ckpt_interval=2, keep=3, seed=123,
+                                   handle_sigterm=False)
+    for i in range(6):
+        rt.step(xs[i], ys[i])
+    # newest checkpoint (step 6) becomes a partial write: directory
+    # exists but contents are gone — the pre-atomic-rename failure mode
+    newest = os.path.join(ck, "step_00000006")
+    shutil.rmtree(newest)
+    os.makedirs(newest)
+    fallback0 = events.get("resilience.restore_fallback")
+
+    rt2 = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                    seed=123, handle_sigterm=False)
+    assert rt2.resume()
+    assert rt2.step_number == 4            # previous keep-K checkpoint
+    assert events.get("resilience.restore_fallback") == fallback0 + 1
+    # training continues from the fallback state
+    _, ok = rt2.step(xs[4], ys[4])
+    assert ok
+
+
+def test_resume_on_empty_dir_is_fresh_start(tmp_path):
+    rt = parallel.ResilientTrainer(_build_trainer(),
+                                   ckpt_dir=str(tmp_path / "empty"),
+                                   seed=123, handle_sigterm=False)
+    assert not rt.resume()
+    assert rt.step_number == 0
+
+
+def test_resume_rejects_wrong_seed(tmp_path):
+    """Resuming with a different RNG seed would silently break
+    determinism — it must be refused (falls through to no checkpoint)."""
+    xs, ys = _data(1)
+    ck = str(tmp_path / "ck")
+    rt = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                   seed=123, handle_sigterm=False)
+    rt.step(xs[0], ys[0])
+    rt.checkpoint()
+    rt2 = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                    seed=999, handle_sigterm=False)
+    assert not rt2.resume()
+
+
+# ---------------------------------------------------------------------------
+# satellite: dtype validation on ShardedTrainer.load_checkpoint
+# ---------------------------------------------------------------------------
+
+def test_load_checkpoint_rejects_dtype_mismatch(tmp_path):
+    import jax.numpy as jnp
+    t = _build_trainer()
+    ck = str(tmp_path / "ck")
+    t.save_checkpoint(ck)
+    t2 = _build_trainer()
+    t2.params = {k: v.astype(jnp.bfloat16) for k, v in t2.params.items()}
+    with pytest.raises(ValueError, match="dtype"):
+        t2.load_checkpoint(ck)
+
+
+# ---------------------------------------------------------------------------
+# satellite: atomic kvstore optimizer-state save
+# ---------------------------------------------------------------------------
+
+def test_kvstore_save_optimizer_states_atomic(tmp_path, monkeypatch):
+    kv = mx.kvstore.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.init("w", nd.ones((2, 2)))
+    kv.push("w", nd.ones((2, 2)))
+    fname = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(fname)
+    original = open(fname, "rb").read()
+    assert original                        # loadable round-trip
+    kv.load_optimizer_states(fname)
+
+    # a crash mid-write (fsync explodes) must leave the old file intact
+    # and no temp residue
+    def boom(fd):
+        raise OSError("disk gone")
+    monkeypatch.setattr(os, "fsync", boom)
+    with pytest.raises(OSError):
+        kv.save_optimizer_states(fname)
+    assert open(fname, "rb").read() == original
+    assert os.listdir(str(tmp_path)) == ["opt.states"]
+
+
+# ---------------------------------------------------------------------------
+# barrier timeout raises instead of hanging
+# ---------------------------------------------------------------------------
+
+def test_barrier_timeout_raises_with_rank(tmp_path):
+    from incubator_mxnet_tpu.base import MXNetError
+    kv = mx.kvstore.create("dist_sync")    # single process: honest 1-worker
+    fault.install("kvstore.barrier_hang", at_calls=[1], times=1)
+    t0 = events.get("kvstore.barrier_timeout")
+    with pytest.raises(MXNetError, match="rank 0"):
+        kv._barrier(timeout=0.2)
+    assert events.get("kvstore.barrier_timeout") == t0 + 1
+    kv._barrier()                          # unarmed: returns immediately
+
+
+# ---------------------------------------------------------------------------
+# retrying reader
+# ---------------------------------------------------------------------------
+
+def _write_rec(path, payloads):
+    from incubator_mxnet_tpu.io import MXRecordIO
+    w = MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+
+def test_retrying_reader_survives_transient_read(tmp_path):
+    from incubator_mxnet_tpu.io import MXRecordIO, RetryingReader
+    rec = str(tmp_path / "a.rec")
+    _write_rec(rec, [b"one", b"two", b"three"])
+    fault.install("io.read", at_calls=[2], times=1)
+    r = RetryingReader(MXRecordIO(rec, "r"), backoff=0.01)
+    retries0 = events.get("io.retry")
+    assert r.read() == b"one"
+    assert r.read() == b"two"              # injected blip, retried
+    assert r.read() == b"three"
+    assert events.get("io.retry") == retries0 + 1
+    r.close()
+
+
+def test_unwrapped_reader_raises_and_retry_budget_exhausts(tmp_path):
+    from incubator_mxnet_tpu.io import MXRecordIO, RetryingReader
+    rec = str(tmp_path / "b.rec")
+    _write_rec(rec, [b"x"])
+    fault.install("io.read", at_calls=[1], times=1)
+    raw = MXRecordIO(rec, "r")
+    with pytest.raises(IOError):
+        raw.read()
+    raw.close()
+    # persistent failure: every attempt fails → budget exhausts cleanly
+    fault.clear()
+    fault.install("io.read", at_calls=list(range(1, 20)))
+    r = RetryingReader(MXRecordIO(rec, "r"), retries=2, backoff=0.01)
+    with pytest.raises(IOError):
+        r.read()
+    r.close()
+
+
+def test_slow_io_fault_stalls_but_succeeds(tmp_path):
+    import time
+    from incubator_mxnet_tpu.io import MXRecordIO
+    rec = str(tmp_path / "c.rec")
+    _write_rec(rec, [b"x"])
+    fault.install("io.slow", at_calls=[1], times=1, seconds=0.1)
+    r = MXRecordIO(rec, "r")
+    t0 = time.monotonic()
+    assert r.read() == b"x"
+    assert time.monotonic() - t0 >= 0.1
+    r.close()
+
+
+# ---------------------------------------------------------------------------
+# observability: the survival story is on the counters
+# ---------------------------------------------------------------------------
+
+def test_event_counters_snapshot(tmp_path):
+    xs, ys = _data(3)
+    events.reset()
+    rt = parallel.ResilientTrainer(_build_trainer(),
+                                   ckpt_dir=str(tmp_path / "ck"),
+                                   ckpt_interval=2, seed=123,
+                                   handle_sigterm=False)
+    fault.install("grad_nan", steps=[1], times=1)
+    for i in range(3):
+        rt.step(xs[i], ys[i])
+    snap = events.snapshot()
+    assert snap["resilience.checkpoint_written"] >= 2   # initial + step 2
+    assert snap["resilience.step_skipped"] == 1
+    assert snap["fault.injected"] == 1
