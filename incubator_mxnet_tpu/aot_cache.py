@@ -40,8 +40,10 @@ def cache_dir():
     return _cfg.get("MXNET_AOT_CACHE_DIR") or ""
 
 
-def _key_for(lowered):
-    dev = jax.devices()[0]
+def _key_for(lowered, dev):
+    # dev is the device the executable is compiled for and pinned to
+    # (_args_device) — NOT jax.devices()[0], which can be a different
+    # kind/platform in a heterogeneous process (stale-key risk)
     raw = "|".join([
         lowered.as_text(),
         jax.__version__,
@@ -76,9 +78,14 @@ class _AotJitted:
         # different device must resolve their own executable (jax.jit
         # keys on placement the same way)
         dev = self._args_device(args)
+        # weak_type is part of the signature: jax.jit recompiles on a
+        # weak-type-only difference (python-scalar promotion vs a
+        # committed array), so sharing one executable across it would
+        # let dtype promotion diverge from the fallback path
         return (treedef, getattr(dev, "id", 0),
                 tuple((tuple(getattr(a, "shape", ())),
-                       str(getattr(a, "dtype", type(a))))
+                       str(getattr(a, "dtype", type(a))),
+                       bool(getattr(a, "weak_type", False)))
                       for a in leaves))
 
     @staticmethod
@@ -109,7 +116,7 @@ class _AotJitted:
         # outside this method's fallback
         path = os.path.join(
             cache_dir(),
-            _key_for(lowered) + ".d%d.pjrtx" % getattr(dev, "id", 0))
+            _key_for(lowered, dev) + ".d%d.pjrtx" % getattr(dev, "id", 0))
         t2 = _t.perf_counter()
         if os.path.exists(path):
             try:

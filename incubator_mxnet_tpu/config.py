@@ -226,6 +226,18 @@ register("MXNET_KVSTORE_BARRIER_TIMEOUT", float, 300.0,
          "DistKVStore barrier timeout in seconds: a worker stuck at a "
          "barrier raises a clear rank-tagged error instead of hanging "
          "the job forever (0 = wait indefinitely)")
+register("MXNET_FEED_DEPTH", int, 2,
+         "DeviceFeed (io.device_feed) prefetch depth: batches in flight "
+         "between the background transfer thread and the consumer "
+         "(2 = double buffer)")
+register("MXNET_FEED_ASYNC", bool, True,
+         "DeviceFeed background transfer thread; 0 = synchronous "
+         "read+device_put in the consumer (debugging; same counters)")
+register("MXNET_FEED_WIRE_DTYPE", str, "uint8",
+         "Wire dtype for the image e2e feed path (bench.py): 'uint8' "
+         "ships raw augmented pixels (4x fewer H2D bytes, mean/std "
+         "fused on device), 'float32' the host-normalized tensor",
+         choices=("uint8", "float32"))
 register("MXNET_INT64_TENSOR_SIZE", bool, False,
          "Large-tensor support: enable 64-bit index arithmetic so "
          "arrays past 2**31 elements index correctly (ref: the "

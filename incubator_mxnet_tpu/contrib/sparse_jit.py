@@ -16,8 +16,9 @@ executable per unique-row bucket.
   host syncs (one executable per batch shape).  For skewed workloads
   (few hot features) the caller passes `bucket_rows` to shrink K;
   a step whose true unique count exceeds it is SKIPPED on device
-  (state preserved, NaN loss returned as the signal) and counted in
-  `overflow_steps`, read lazily — no step ever blocks on the host.
+  (state preserved, the previous finite loss returned — see step()'s
+  NaN-free contract) and counted in `overflow_steps`, read lazily —
+  no step ever blocks on the host.
 - Both embedding tables are padded with ONE sentinel row (row `vocab`);
   padded bucket slots gather from and scatter into that garbage row, so
   no masking is needed anywhere and real rows keep exact lazy_update
@@ -114,6 +115,9 @@ class BucketedSparseTrainer:
         # `overflow_steps` — no step ever blocks on the host.
         self._bucket = int(bucket_rows) if bucket_rows else None
         self._state["overflow"] = jnp.zeros((), jnp.int32)
+        # last finite loss, carried in-state: an overflowed (skipped)
+        # step returns THIS instead of NaN (see step()'s contract)
+        self._state["loss"] = jnp.zeros((), jnp.float32)
         self._steps = {}
 
     # ------------------------------------------------------------------
@@ -212,23 +216,33 @@ class BucketedSparseTrainer:
                 else:
                     nw, _, _ = self._upd(dense[name], g, None, None, lr)
                 new["dense"][name] = nw
+            new["loss"] = loss.astype(jnp.float32)
             if ovf_now is not None:
                 # overflowed step: keep the old state (the overflow
-                # counter above is the only field that advances) and
-                # surface NaN as the skipped-step loss signal
+                # counter above is the only field that advances) —
+                # including "loss", so the step returns the PREVIOUS
+                # finite loss instead of NaN (the NaN-free contract on
+                # step(); overflow_steps is the skip signal)
                 keep = jax.tree_util.tree_map(
                     lambda old, nw_: jnp.where(ovf_now, old, nw_),
                     {k: state[k] for k in new if k != "overflow"},
                     {k: new[k] for k in new if k != "overflow"})
                 keep["overflow"] = overflow
                 new = keep
-                loss = jnp.where(ovf_now, jnp.nan, loss)
-            return new, loss
+            return new, new["loss"]
 
         return jax.jit(step, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
     def step(self, indices, values, labels):
+        """One jitted lazy-update step; returns the loss (device scalar).
+
+        Loss contract (NaN-free): a step whose unique-row count
+        overflows `bucket_rows` is SKIPPED on device — state untouched
+        — and returns the PREVIOUS finite loss (0.0 if no step has
+        succeeded yet), so naive per-step loss accumulation/averaging
+        stays finite.  `overflow_steps` is the sole skip signal; check
+        it at epoch boundaries (reading it is a device sync)."""
         idx = indices._data if isinstance(indices, NDArray) \
             else jnp.asarray(indices)
         vals = values._data if isinstance(values, NDArray) \
@@ -241,7 +255,10 @@ class BucketedSparseTrainer:
         if key not in self._steps:
             self._steps[key] = self._make_step(K, B, F)
         self._state, loss = self._steps[key](self._state, idx, vals, y)
-        return NDArray(loss)
+        # the loss value is ALSO carried inside the (donated) state —
+        # hand the caller a detached copy so the next step's state
+        # donation can never invalidate a held loss array
+        return NDArray(jnp.copy(loss))
 
     @property
     def bucket_keys(self):
@@ -250,9 +267,9 @@ class BucketedSparseTrainer:
     @property
     def overflow_steps(self):
         """Steps whose true unique-row count exceeded `bucket_rows`.
-        Those steps were SKIPPED (state untouched, NaN loss returned)
-        — raise the bucket if this is nonzero.  Reading this is a
-        device sync; check at epoch boundaries."""
+        Those steps were SKIPPED (state untouched, previous finite
+        loss returned) — raise the bucket if this is nonzero.  Reading
+        this is a device sync; check at epoch boundaries."""
         return int(_np.asarray(self._state["overflow"]))
 
     def sync_to_net(self):

@@ -172,26 +172,35 @@ def _batch_cast_params(pd, dtype):
     backend — ~16 compiles x 3-30 s of BERT build wall (PROFILE.md
     r5)."""
     import jax.numpy as jnp
+    from collections import OrderedDict
     from ..aot_cache import aot_jit
     tgt = jnp.dtype(dtype)
-    work = []
+    # grouped by context: one batched convert EXECUTABLE PER DEVICE —
+    # mixing leaves committed to different devices in one jit call is a
+    # committed-devices conflict (split_and_load-style nets initialize
+    # params on several contexts); the per-shape compile saving is
+    # preserved per device
+    groups = OrderedDict()
     for p in pd.values():
         if p._data is None:
             continue
         for ctx, arr in p._data.items():
             if arr._data.dtype != tgt:
-                work.append((p, ctx))
-    if not work:
+                groups.setdefault(ctx, []).append(p)
+    if not groups:
         return
-    leaves = tuple(p._data[ctx]._data for p, ctx in work)
 
     def convert(*ls):
         return tuple(l.astype(tgt) for l in ls)
 
-    outs = aot_jit(convert)(*leaves)
-    for (p, ctx), o in zip(work, outs):
-        p._data[ctx] = NDArray(o, ctx=ctx)
-    for p, _ctx in work:
+    touched = []
+    for ctx, ps in groups.items():
+        leaves = tuple(p._data[ctx]._data for p in ps)
+        outs = aot_jit(convert)(*leaves)
+        for p, o in zip(ps, outs):
+            p._data[ctx] = NDArray(o, ctx=ctx)
+        touched.extend(ps)
+    for p in touched:
         if p._grad_req != "null":
             p._init_grad()
 
@@ -865,6 +874,10 @@ class _CachedGraph:
             try:
                 nd_in = [NDArray(v) for v in ivals]
                 with _engine.collect_op_names() as traced_ops:
+                    # input transform (uint8→normalized-dtype etc.)
+                    # traced here: it becomes part of THIS fused
+                    # executable, not a separate dispatch
+                    nd_in = list(block._apply_input_transform(nd_in))
                     out = block.forward(*nd_in)
                 # op composition of the (fused) executable, for the
                 # profiler's aggregate table (per-op times inside ONE
@@ -1214,6 +1227,26 @@ class HybridBlock(Block):
         self._active = False
         self._cached_graph = None
         self._flags = {}
+        self._input_transform = None
+
+    def set_input_transform(self, fn):
+        """Install a pure on-device preprocessing function applied to
+        the FIRST positional input (e.g. uint8 pixels → normalized
+        compute dtype, `io.device_feed.normalize_transform`).  On a
+        hybridized block it is traced INTO the cached forward
+        executable, so the cast+normalize fuses with the train step:
+        uint8 stays the wire format and the float tensor only ever
+        exists on device.  Eager calls apply it before forward (same
+        numerics); the Symbol/export path ignores it.  Pass None to
+        remove."""
+        self._input_transform = fn
+        self._cached_graph = None
+
+    def _apply_input_transform(self, args):
+        tr = getattr(self, "_input_transform", None)
+        if tr is not None and args and isinstance(args[0], NDArray):
+            return (tr(args[0]),) + tuple(args[1:])
+        return args
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
                   inline_limit=2, forward_bulk_size=None,
@@ -1309,17 +1342,21 @@ class HybridBlock(Block):
                 except Exception:
                     deferred = False
                 if deferred:
+                    # shape/init pre-passes see POST-transform inputs
+                    # (the dtype the traced forward will compute in)
+                    pre = self._apply_input_transform(args)
                     try:
-                        self.infer_shape(*args)
+                        self.infer_shape(*pre)
                         for p in pd.values():
                             if p._deferred_init:
                                 p._finish_deferred_init()
                     except Exception:
                         with _ag.pause():
-                            Block.__call__(self, *args)
+                            Block.__call__(self, *pre)
                 self._cached_graph = _CachedGraph(self, self._flags)
             return _np_mode_out(self._cached_graph(list(args)))
-        return Block.__call__(self, *args, **kwargs)
+        return Block.__call__(self, *self._apply_input_transform(args),
+                              **kwargs)
 
     def forward(self, x, *args):
         """Gathers this block's params and calls hybrid_forward with the

@@ -75,15 +75,23 @@ def _np_to_nd(out):
 
 
 class DataLoader:
-    """ref: gluon.data.DataLoader — batching + shuffling + prefetching."""
+    """ref: gluon.data.DataLoader — batching + shuffling + prefetching.
+
+    `ctx=` replaces the synchronous device upload with an async
+    `io.device_feed.DeviceFeed`: batches come back as NDArrays already
+    ON `ctx`, the next batch's H2D transfer overlapped with the
+    consumer's step (`feed_depth` buffers, default MXNET_FEED_DEPTH;
+    per-stage counters on `monitor.events` under `feed.*`)."""
 
     def __init__(self, dataset, batch_size=None, shuffle=False,
                  sampler=None, last_batch=None, batch_sampler=None,
                  batchify_fn=None, num_workers=0, pin_memory=False,
                  pin_device_id=0, prefetch=None, thread_pool=False,
-                 timeout=120):
+                 timeout=120, ctx=None, feed_depth=None):
         self._dataset = dataset
         self._pin_memory = pin_memory
+        self._ctx = ctx
+        self._feed_depth = feed_depth
         self._num_workers = max(0, num_workers)
         self._timeout = timeout
         if batch_sampler is None:
@@ -116,14 +124,14 @@ class DataLoader:
                 # serialization pass (a multi-GB in-memory dataset would
                 # pay a full extra pickle walk just to pre-check).
                 import pickle as _pickle
-                ctx = _mp.get_context("spawn")
+                mp_ctx = _mp.get_context("spawn")   # NOT the device ctx
                 # serialize pool construction: the failure cleanup below
                 # diffs active_children(), which must not see another
                 # loader's workers appearing concurrently
                 with _POOL_CTOR_LOCK:
                     before = set(_mp.active_children())
                     try:
-                        self._pool = ctx.Pool(
+                        self._pool = mp_ctx.Pool(
                             self._num_workers,
                             initializer=_worker_init,
                             initargs=(self._dataset,))
@@ -158,15 +166,30 @@ class DataLoader:
                 self._pool = _ThreadPool(self._num_workers)
 
     def __iter__(self):
-        if self._pool is not None:
-            return self._mp_iter()
-        return self._serial_iter()
+        raw = self._ctx is not None
+        base = self._mp_iter(raw=raw) if self._pool is not None \
+            else self._serial_iter(raw=raw)
+        if not raw:
+            return base
+        # async device feed: ONE batched device_put per batch pytree on
+        # a background thread, overlapped with the consumer's compute.
+        # A fresh feed per epoch — its worker exits at epoch end.
+        from ...io.device_feed import DeviceFeed
+        return iter(DeviceFeed(base, ctx=self._ctx,
+                               depth=self._feed_depth))
 
-    def _serial_iter(self):
+    def _serial_iter(self, raw=False):
         for batch_idx in self._batch_sampler:
-            yield self._batchify_fn([self._dataset[i] for i in batch_idx])
+            if raw and self._batchify_fn is default_batchify_fn:
+                # numpy straight to the DeviceFeed: skip the default-ctx
+                # hop (a custom batchify may pad/reorder — run it and
+                # let the feed unwrap its NDArrays instead)
+                yield _fetch_batch(self._dataset, batch_idx)
+            else:
+                yield self._batchify_fn(
+                    [self._dataset[i] for i in batch_idx])
 
-    def _mp_iter(self):
+    def _mp_iter(self, raw=False):
         # sliding window of async results (double-buffer prefetch, the
         # dmlc::ThreadedIter analogue)
         import collections
@@ -192,7 +215,9 @@ class DataLoader:
             res = queue.popleft()
             out = res.get(self._timeout)
             enqueue()
-            yield _np_to_nd(out)
+            # raw: numpy straight to the DeviceFeed (one device_put to
+            # the target ctx, no intermediate default-ctx hop)
+            yield out if raw else _np_to_nd(out)
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -6,9 +6,12 @@ from . import recordio
 from .recordio import (MXRecordIO, MXIndexedRecordIO, IRHeader, pack,
                        unpack, pack_img, unpack_img)
 from .resilient import RetryingReader, retry_io
+from .device_feed import (DeviceFeed, feed_counters, make_normalizer,
+                          normalize_transform)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "LibSVMIter", "ImageRecordIter", "MNISTIter", "ResizeIter",
            "PrefetchingIter", "recordio", "MXRecordIO", "MXIndexedRecordIO",
            "IRHeader", "pack", "unpack", "pack_img", "unpack_img",
-           "RetryingReader", "retry_io"]
+           "RetryingReader", "retry_io", "DeviceFeed", "feed_counters",
+           "make_normalizer", "normalize_transform"]

@@ -256,6 +256,12 @@ class ImageRecordIter(DataIter):
     Threaded pipeline: reader (recordio) → pool of decode+augment workers
     → batcher → double-buffered prefetch, mirroring the reference's
     structure; decode via PIL/RAWI (see recordio._decode_img).
+
+    `dtype="uint8"` ships raw augmented pixels (no mean/std — normalize
+    on device, 4x fewer H2D bytes).  `ctx=` replaces the synchronous
+    upload with an async `io.device_feed.DeviceFeed`: batches arrive
+    as device NDArrays, the NEXT batch's transfer overlapped with the
+    consumer's step (`feed_depth` buffers, default MXNET_FEED_DEPTH).
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
@@ -263,8 +269,10 @@ class ImageRecordIter(DataIter):
                  std_r=1.0, std_g=1.0, std_b=1.0, rand_crop=False,
                  rand_mirror=False, preprocess_threads=4, prefetch_buffer=2,
                  round_batch=True, seed=0, resize=-1, data_name="data",
-                 label_name="softmax_label", dtype="float32", **kwargs):
+                 label_name="softmax_label", dtype="float32", ctx=None,
+                 feed_depth=None, **kwargs):
         super().__init__(batch_size)
+        import collections
         from .recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
         self._unpack_img = unpack_img
         self.data_shape = tuple(data_shape)           # (C, H, W)
@@ -274,18 +282,26 @@ class ImageRecordIter(DataIter):
         self._rand_mirror = rand_mirror
         self._resize = resize
         self._dtype = dtype
+        if dtype == "uint8" and (mean_r or mean_g or mean_b or
+                                 std_r != 1.0 or std_g != 1.0 or
+                                 std_b != 1.0):
+            raise ValueError("dtype='uint8' ships raw pixels; apply "
+                             "mean/std on device (io.device_feed."
+                             "normalize_transform)")
         self._mean = _np.array([mean_r, mean_g, mean_b],
                                dtype=_np.float32).reshape(3, 1, 1)
         self._std = _np.array([std_r, std_g, std_b],
                               dtype=_np.float32).reshape(3, 1, 1)
         self._rng = _np.random.RandomState(seed)
+        self._ctx_feed = None
+        self._pads = collections.deque()   # FIFO, parallel to the feed
 
         # native C++ pipeline (src/io/recordio_pipeline.cc — the
         # ImageRecordIOParser2 equivalent): GIL-free decode+augment.
         # PIL threadpool below is the always-available fallback.
         self._native = None
         self._nat_fut = None
-        if dtype == "float32" and self.data_shape[0] == 3:
+        if dtype in ("float32", "uint8") and self.data_shape[0] == 3:
             from . import native as _native
             if _native.available():
                 try:
@@ -294,12 +310,17 @@ class ImageRecordIter(DataIter):
                         resize=max(resize, 0), rand_crop=rand_crop,
                         rand_mirror=rand_mirror, shuffle=shuffle,
                         label_width=label_width,
-                        mean=(mean_r, mean_g, mean_b),
-                        std=(std_r, std_g, std_b), seed=seed,
-                        num_threads=preprocess_threads)
+                        mean=None if dtype == "uint8"
+                        else (mean_r, mean_g, mean_b),
+                        std=None if dtype == "uint8"
+                        else (std_r, std_g, std_b), seed=seed,
+                        num_threads=preprocess_threads, dtype=dtype)
                 except (IOError, RuntimeError):
                     self._native = None
         if self._native is not None:
+            if ctx is not None:
+                self._make_feed(ctx, feed_depth)
+                return
             self._pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1)          # prefetch thread (double buffer)
             self._nat_fut = None
@@ -317,9 +338,77 @@ class ImageRecordIter(DataIter):
             max_workers=preprocess_threads)
         self._prefetch = max(1, prefetch_buffer)
         self._lock = threading.Lock()
+        if ctx is not None:
+            self._make_feed(ctx, feed_depth)
+            return
         self.reset()
 
+    # -- async device feed (ctx= mode) ---------------------------------
+    def _make_feed(self, ctx, feed_depth):
+        from .device_feed import DeviceFeed
+        # callable source: each epoch gets a fresh generator (the feed's
+        # reset discards in-flight batches; the generator re-arms the
+        # underlying reader and the pad FIFO itself)
+        self._ctx_feed = DeviceFeed(self._host_batches, ctx=ctx,
+                                    depth=feed_depth)
+
+    def _pad_batch(self, data, label):
+        if self.label_width == 1 and label.ndim == 2:
+            label = label[:, 0]
+        pad = self.batch_size - data.shape[0]
+        if pad:
+            data = _np.concatenate([data, _np.repeat(
+                data[-1:], pad, axis=0)])
+            label = _np.concatenate([label, _np.repeat(
+                label[-1:], pad, axis=0)])
+        return data, label, pad
+
+    def _host_batches(self):
+        """One epoch of padded host (data, label) batches — the feed's
+        source.  Runs on the feed worker thread; pads are queued on a
+        FIFO the consumer pops in the same order."""
+        self._pads.clear()
+        if self._native is not None:
+            self._native.reset()
+            while True:
+                b = self._native.next_batch()
+                if b is None:
+                    return
+                data, label, pad = self._pad_batch(*b)
+                self._pads.append(pad)
+                yield data, label
+            return
+        # python decode path: same epoch bookkeeping as reset()
+        if self._keys is not None:
+            self._order = list(self._keys)
+            if self._shuffle:
+                self._rng.shuffle(self._order)
+            self._pos = 0
+        else:
+            self._rec.reset()
+        while True:
+            raws = []
+            with self._lock:
+                for _ in range(self.batch_size):
+                    r = self._read_record()
+                    if r is None:
+                        break
+                    raws.append(r)
+            if not raws:
+                return
+            results = [f.result() for f in
+                       [self._pool.submit(self._process, r)
+                        for r in raws]]
+            data = _np.stack([r[0] for r in results])
+            label = _np.stack([r[1] for r in results])
+            data, label, pad = self._pad_batch(data, label)
+            self._pads.append(pad)
+            yield data, label
+
     def reset(self):
+        if self._ctx_feed is not None:
+            self._ctx_feed.reset()
+            return
         if self._native is not None:
             # drain the in-flight prefetch first: Pipeline::Reset must
             # not race mxio_next, and an orphaned future would consume
@@ -373,9 +462,11 @@ class ImageRecordIter(DataIter):
             img = img[:, ::-1]
         chw = _np.ascontiguousarray(
             _np.asarray(img, dtype=_np.float32).transpose(2, 0, 1))
-        chw = (chw - self._mean) / self._std
         label = header.label if hasattr(header.label, "__len__") else \
             _np.float32(header.label)
+        if self._dtype == "uint8":      # raw pixels on the wire;
+            return chw.astype(_np.uint8), label     # normalize on device
+        chw = (chw - self._mean) / self._std
         return chw.astype(self._dtype), label
 
     def _fill(self):
@@ -393,34 +484,25 @@ class ImageRecordIter(DataIter):
             self._pending.append(futs)
 
     def next(self):
+        if self._ctx_feed is not None:
+            data, label = next(self._ctx_feed)      # device NDArrays;
+            pad = self._pads.popleft() if self._pads else 0
+            return DataBatch([data], [label], pad=pad)
         if self._native is not None:
             batch = self._nat_fut.result()
             if batch is None:
                 raise StopIteration
             self._nat_fut = self._pool.submit(self._native.next_batch)
-            data, label = batch
-            if self.label_width == 1:
-                label = label[:, 0]
-            pad = self.batch_size - data.shape[0]
-            if pad:
-                data = _np.concatenate([data, _np.repeat(
-                    data[-1:], pad, axis=0)])
-                label = _np.concatenate([label, _np.repeat(
-                    label[-1:], pad, axis=0)])
+            data, label, pad = self._pad_batch(*batch)
             return DataBatch([nd.array(data)], [nd.array(label)], pad=pad)
         if not self._pending:
             raise StopIteration
         futs = self._pending.pop(0)
         self._fill()
         results = [f.result() for f in futs]
-        pad = self.batch_size - len(results)
-        data = _np.stack([r[0] for r in results])
-        label = _np.stack([r[1] for r in results])
-        if pad:
-            data = _np.concatenate([data, _np.repeat(
-                data[-1:], pad, axis=0)])
-            label = _np.concatenate([label, _np.repeat(
-                label[-1:], pad, axis=0)])
+        data, label, pad = self._pad_batch(
+            _np.stack([r[0] for r in results]),
+            _np.stack([r[1] for r in results]))
         return DataBatch([nd.array(data)], [nd.array(label)], pad=pad)
 
 

@@ -24,7 +24,10 @@ class EventCounters:
     The resilience layer (`parallel.resilience`, `fault`, `kvstore`,
     `io`) reports every recovery action here so a run's survival story
     is inspectable: checkpoints written, steps skipped on non-finite
-    loss, rollbacks, transient-failure retries, injected faults.
+    loss, rollbacks, transient-failure retries, injected faults.  The
+    device-feed pipeline (`io.device_feed`) reports its per-stage
+    wall/bytes counters (`feed.*`) the same way, so feed/compute
+    balance is observable without a profiler.
     Thread-safe; process-local (each worker reports its own counts,
     matching per-worker ps-lite server stats in the reference).
     """
@@ -38,13 +41,21 @@ class EventCounters:
             self._counts[name] = self._counts.get(name, 0) + int(n)
             return self._counts[name]
 
+    def add_time(self, name: str, seconds: float) -> int:
+        """Accumulate a wall-clock interval on an integer-microsecond
+        counter (convention: the name ends in `_us`)."""
+        return self.incr(name, int(seconds * 1e6))
+
     def get(self, name: str) -> int:
         with self._lock:
             return self._counts.get(name, 0)
 
-    def snapshot(self) -> dict:
+    def snapshot(self, prefix: str = None) -> dict:
         with self._lock:
-            return dict(self._counts)
+            if prefix is None:
+                return dict(self._counts)
+            return {k: v for k, v in self._counts.items()
+                    if k.startswith(prefix)}
 
     def reset(self) -> None:
         with self._lock:

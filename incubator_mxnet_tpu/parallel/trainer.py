@@ -106,17 +106,21 @@ class ShardedTrainer:
     zero: 0 (off) or 1 — ZeRO stage-1: per-param optimizer state is
         sharded along the data axis (memory /= data-parallel degree;
         the reference's server-side-optimizer semantic, SURVEY §5.8)
+    preprocess: pure jnp fn applied to the batch INSIDE the jitted
+        step (e.g. `io.device_feed.make_normalizer` — uint8 wire
+        batches are normalized/cast on device, fused with the step)
     """
 
     def __init__(self, block, loss_fn=softmax_ce_loss, optimizer="sgd",
                  lr=0.01, momentum=0.9, wd=0.0, mesh: Optional[Mesh] = None,
                  batch_axis="data", param_spec_fn=None, donate=True,
-                 zero=0):
+                 zero=0, preprocess=None):
         self.block = block
         self.mesh = mesh or make_mesh()
         self.batch_axis = batch_axis
         self.loss_fn = loss_fn
         self.zero = int(zero)
+        self._preprocess = preprocess
         if optimizer == "sgd":
             self._opt_init, self._opt_update = sgd_momentum_tree(
                 lr, momentum, wd)
@@ -205,11 +209,17 @@ class ShardedTrainer:
         fwd = self._fwd
         loss_fn = self.loss_fn
         opt_update = self._opt_update
+        preprocess = self._preprocess
         constrain = functools.partial(self._place_opt_tree,
                                       place=jax.lax.with_sharding_constraint) \
             if self.zero else (lambda tree, **_: tree)
 
         def step(params, opt_state, batch, labels, rng_bits):
+            if preprocess is not None:
+                # on-device normalize/cast fused into this executable
+                # (uint8 stays the wire format — device_feed contract)
+                batch = preprocess(batch)
+
             def lf(p):
                 out, states = fwd(p, batch, rng_bits=rng_bits)
                 return loss_fn(out, labels), states
@@ -242,6 +252,11 @@ class ShardedTrainer:
         each feed their slice, as reference workers read disjoint
         RecordIO partitions)."""
         import numpy as _np
+        if isinstance(arr, jax.Array) and \
+                getattr(arr, "sharding", None) == sharding:
+            # already feed-placed on this mesh (device_feed()): no
+            # re-upload, the background transfer was the upload
+            return arr
         if jax.process_count() > 1:
             return jax.make_array_from_process_local_data(
                 sharding, _np.asarray(arr))
@@ -263,6 +278,23 @@ class ShardedTrainer:
             self.params, self.opt_state, batch, labels, rng_bits)
         self._n_step += 1
         return loss
+
+    def device_feed(self, source, depth=None, transform=None):
+        """Async feed onto this trainer's mesh: a background thread
+        `device_put`s the NEXT (batch, labels) pair — batch sharded on
+        the data axis, ONE batched transfer per pytree — while the
+        current step executes.  `step()` recognizes the placed arrays
+        and skips its own upload.  Pair with `preprocess=` for
+        uint8-on-wire feeding (normalize/cast runs inside the step).
+
+        source yields host (batch, labels) pairs (numpy); returns an
+        `io.device_feed.DeviceFeed` (per-stage counters on
+        `monitor.events` under `feed.*`)."""
+        from ..io.device_feed import DeviceFeed
+        # one batch-axis sharding, broadcast over every leaf of the
+        # batch pytree by DeviceFeed._place_sharded
+        return DeviceFeed(source, sharding=self._batch_sharding,
+                          depth=depth, transform=transform)
 
     def sync_to_block(self):
         """Write trained params back into the Gluon block."""

@@ -68,6 +68,38 @@ def test_corrupt_blob_falls_back_to_compile(cache_dir):
         assert f.read(16) != b"not an executabl"
 
 
+def test_weak_type_resolves_own_executable(cache_dir):
+    """weak-type-only signature differences must NOT share one compiled
+    executable (jax.jit recompiles on them; sharing would let dtype
+    promotion diverge from the fallback path — ADVICE r5)."""
+    from incubator_mxnet_tpu.aot_cache import aot_jit
+
+    j = aot_jit(lambda a: a * 2)
+    committed = jnp.asarray(np.float32(3.0))      # strong f32
+    weak = jnp.asarray(3.0)                       # weak-typed f32 scalar
+    assert not committed.weak_type and weak.weak_type
+    assert float(j(committed)) == float(j(weak)) == 6.0
+    sigs = set(j._compiled)
+    assert len(sigs) == 2, "weak_type missing from the signature"
+
+
+def test_key_for_uses_argument_device(cache_dir):
+    """The cache key's device kind/platform must come from the device
+    the executable is pinned to (_args_device), not jax.devices()[0]
+    (heterogeneous-process stale-key risk — ADVICE r5)."""
+    import inspect
+    from incubator_mxnet_tpu import aot_cache
+
+    sig = inspect.signature(aot_cache._key_for)
+    assert "dev" in sig.parameters     # caller passes _args_device(args)
+    # same device → stable key
+    j = aot_cache.aot_jit(lambda a: a + 1)
+    x = jax.device_put(jnp.ones(4), jax.devices()[0])
+    lowered = j.lower(x)
+    k0 = aot_cache._key_for(lowered, jax.devices()[0])
+    assert k0 == aot_cache._key_for(lowered, jax.devices()[0])
+
+
 def test_disabled_without_cache_dir():
     from incubator_mxnet_tpu import config as _cfg
     prev = _cfg.get("MXNET_AOT_CACHE_DIR")

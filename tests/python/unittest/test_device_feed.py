@@ -1,0 +1,402 @@
+"""Device-feed pipeline tests (io.device_feed — ISSUE 2 tentpole):
+uint8-on-wire numerics, double-buffer overlap/ordering, epoch reset
+mid-flight, sharded feeding into ShardedTrainer.  CPU-only, fast."""
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon, autograd as ag
+from incubator_mxnet_tpu import config as cfg
+from incubator_mxnet_tpu.io.device_feed import (DeviceFeed, feed_counters,
+                                                make_normalizer,
+                                                normalize_transform)
+from incubator_mxnet_tpu.monitor import events
+
+
+def _batches(n, batch=4, feat=3, seed=0):
+    rs = onp.random.RandomState(seed)
+    return [(rs.rand(batch, feat).astype(onp.float32) + i,
+             onp.full((batch,), i, onp.float32)) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# core iterator semantics
+# ---------------------------------------------------------------------------
+
+def test_feed_order_values_and_counters():
+    src = _batches(6)
+    before = feed_counters()
+    feed = DeviceFeed(src, ctx=mx.cpu())
+    got = list(feed)
+    assert len(got) == 6
+    for i, (d, l) in enumerate(got):
+        assert d.context == mx.cpu()
+        onp.testing.assert_array_equal(d.asnumpy(), src[i][0])
+        onp.testing.assert_array_equal(l.asnumpy(), src[i][1])
+    after = feed_counters()
+    assert after.get("feed.batches", 0) - before.get("feed.batches", 0) == 6
+    shipped = after.get("feed.bytes", 0) - before.get("feed.bytes", 0)
+    assert shipped == sum(a.nbytes + b.nbytes for a, b in src)
+    for stage in ("feed.read_us", "feed.transfer_us", "feed.stall_us"):
+        assert after.get(stage, 0) >= before.get(stage, 0)
+
+
+def test_feed_sync_mode_matches():
+    src = _batches(4, seed=3)
+    cfg.set("MXNET_FEED_ASYNC", "0")
+    try:
+        feed = DeviceFeed(src, ctx=mx.cpu())
+        assert feed._thread is None or not feed._thread.is_alive()
+        got = list(feed)
+    finally:
+        cfg.unset("MXNET_FEED_ASYNC")
+    assert len(got) == 4
+    onp.testing.assert_array_equal(got[2][0].asnumpy(), src[2][0])
+
+
+def test_feed_double_buffer_overlap():
+    """While the consumer sits on batch 0, the worker must have read
+    AHEAD (depth=2 double buffer) — and never unboundedly far."""
+    pulled = []
+    done = threading.Event()
+
+    def source():
+        for i in range(8):
+            pulled.append(i)
+            if len(pulled) >= 3:
+                done.set()
+            yield (onp.full((2, 2), i, onp.float32),)
+
+    feed = DeviceFeed(source, depth=2, ctx=mx.cpu())
+    it = iter(feed)
+    first = next(it)
+    # worker prefetches ahead of the (stalled) consumer
+    assert done.wait(timeout=5.0), "no read-ahead happened"
+    time.sleep(0.2)                   # let the prefetch fill the queue
+    assert 3 <= len(pulled) <= 5      # depth+in-flight bound, not all 8
+    rest = list(it)
+    assert float(first[0].asnumpy()[0, 0]) == 0
+    assert [float(b[0].asnumpy()[0, 0]) for b in rest] == \
+        [float(i) for i in range(1, 8)]
+
+
+def test_feed_reset_mid_flight():
+    """reset() with transfers in flight discards them and restarts the
+    epoch from batch 0 (in order, nothing dropped or duplicated)."""
+    src = _batches(5, seed=5)
+    feed = DeviceFeed(src, ctx=mx.cpu())
+    it = iter(feed)
+    next(it)
+    next(it)
+    feed.reset()
+    vals = [float(l.asnumpy()[0]) for _, l in feed]
+    assert vals == [0.0, 1.0, 2.0, 3.0, 4.0]
+    # and again: re-entering iter() after exhaustion re-arms the epoch
+    assert len(list(feed)) == 5
+
+
+def test_feed_source_error_propagates():
+    def source():
+        yield (onp.zeros((2, 2), onp.float32),)
+        raise IOError("boom")
+
+    feed = DeviceFeed(source, ctx=mx.cpu())
+    it = iter(feed)
+    next(it)
+    with pytest.raises(IOError):
+        next(it)
+
+
+def test_feed_transform_error_propagates_not_hangs():
+    """A raising transform in the async worker must surface on the
+    consumer's next(), never kill the thread silently (q.get() hang)."""
+    def bad(_b):
+        raise ValueError("bad transform")
+
+    feed = DeviceFeed([(onp.zeros((2, 2), onp.float32),)] * 3,
+                      ctx=mx.cpu(), transform=bad)
+    with pytest.raises(ValueError):
+        next(iter(feed))
+
+
+def test_feed_abandoned_mid_epoch_worker_retires():
+    """A feed dropped mid-epoch (consumer broke out) must be collected
+    and its worker thread retire — the worker holds the feed only via
+    weakref, so no thread/device-buffer leak per abandoned epoch."""
+    import gc
+
+    def workers():
+        return sum(1 for t in threading.enumerate()
+                   if t.name == "DeviceFeed" and t.is_alive())
+
+    base = workers()
+    feed = DeviceFeed(_batches(50), ctx=mx.cpu(), depth=2)
+    it = iter(feed)
+    next(it)                        # queue fills; worker parks in put
+    del it, feed                    # abandoned
+    for _ in range(100):
+        gc.collect()
+        if workers() <= base:
+            break
+        time.sleep(0.05)
+    assert workers() <= base
+
+
+def test_feed_close_stops_iteration():
+    src = _batches(3)
+    feed = DeviceFeed(src, ctx=mx.cpu())
+    it = iter(feed)
+    next(it)
+    feed.close()
+    with pytest.raises(StopIteration):
+        next(it)
+    # iter()/reset() is the intentional-restart path
+    assert len(list(feed)) == 3
+
+
+def test_feed_host_transform_runs_on_worker():
+    src = [(onp.arange(4, dtype=onp.float32),
+            onp.arange(4, dtype=onp.float32).reshape(4, 1))]
+    feed = DeviceFeed(src, ctx=mx.cpu(),
+                      transform=lambda b: (b[0], b[1][:, 0] * 2))
+    d, l = next(iter(feed))
+    onp.testing.assert_array_equal(l.asnumpy(), [0, 2, 4, 6])
+
+
+# ---------------------------------------------------------------------------
+# uint8-on-wire numerics
+# ---------------------------------------------------------------------------
+
+def _small_net(seed):
+    mx.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(4, 3, padding=1),
+            gluon.nn.Activation("relu"),
+            gluon.nn.Flatten(), gluon.nn.Dense(3))
+    net.initialize()
+    return net
+
+
+def test_uint8_wire_matches_float_path():
+    """uint8 batch + on-device normalize fused via set_input_transform
+    must reproduce the host-normalized float32 path within atol — the
+    forward AND a full train step."""
+    rs = onp.random.RandomState(0)
+    x8 = rs.randint(0, 256, (2, 3, 8, 8), onp.uint8)
+    xf = (x8.astype(onp.float32) - 127.5) / 64.0
+    y = nd.array(onp.array([0, 2], onp.float32))
+
+    # deferred param init draws RNG at FIRST FORWARD: seed + forward
+    # each net before building the next so both draw identical values
+    net_u = _small_net(7)
+    net_u.hybridize()
+    net_u.set_input_transform(normalize_transform(127.5, 64.0, "float32"))
+    feed = DeviceFeed([(x8,)], ctx=mx.cpu())
+    (xb,) = next(iter(feed))
+    assert xb.dtype == onp.uint8          # uint8 stayed the wire format
+    out_u = net_u(xb).asnumpy()
+
+    net_f = _small_net(7)
+    net_f.hybridize()
+    onp.testing.assert_allclose(out_u, net_f(nd.array(xf)).asnumpy(),
+                                atol=1e-5)
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    losses = []
+    for net, xin in ((net_u, xb), (net_f, nd.array(xf))):
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        with ag.record():
+            l = loss_fn(net(xin), y)
+            l.backward()
+        tr.step(2)
+        losses.append(float(l.mean().asnumpy()))
+    assert abs(losses[0] - losses[1]) < 1e-5
+    # params after the step agree too (grads flowed through the fused
+    # normalize identically)
+    for (ku, pu), (kf, pf) in zip(net_u.collect_params().items(),
+                                  net_f.collect_params().items()):
+        onp.testing.assert_allclose(pu.data().asnumpy(),
+                                    pf.data().asnumpy(), atol=1e-5)
+
+
+def test_make_normalizer_channels_and_dtype():
+    import jax.numpy as jnp
+    x8 = onp.random.RandomState(1).randint(0, 256, (2, 3, 4, 4), onp.uint8)
+    norm = make_normalizer((1.0, 2.0, 3.0), (2.0, 4.0, 8.0), "float32")
+    ref = (x8.astype(onp.float32) -
+           onp.array([1, 2, 3], onp.float32).reshape(1, 3, 1, 1)) / \
+        onp.array([2, 4, 8], onp.float32).reshape(1, 3, 1, 1)
+    onp.testing.assert_allclose(onp.asarray(norm(jnp.asarray(x8))), ref,
+                                atol=1e-6)
+    bf = make_normalizer(127.5, 64.0, "bfloat16")(jnp.asarray(x8))
+    assert str(bf.dtype) == "bfloat16"
+
+
+# ---------------------------------------------------------------------------
+# sharded feed into ShardedTrainer
+# ---------------------------------------------------------------------------
+
+def test_sharded_trainer_device_feed_and_preprocess():
+    import jax
+    from incubator_mxnet_tpu import parallel
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16), gluon.nn.Activation("relu"),
+            gluon.nn.Dense(4))
+    net.initialize()
+    net(nd.array(onp.zeros((2, 12), onp.float32)))
+    trainer = parallel.ShardedTrainer(
+        net, optimizer="sgd", lr=0.1,
+        preprocess=make_normalizer(2.0, 4.0, "float32", axis=-1))
+
+    B = 16
+    rs = onp.random.RandomState(0)
+    data = [(rs.randint(0, 256, (B, 12)).astype(onp.uint8),
+             rs.randint(0, 4, B).astype(onp.int32)) for _ in range(3)]
+    feed = trainer.device_feed(data)
+    n = 0
+    for xb, yb in feed:
+        assert isinstance(xb, jax.Array) and xb.dtype == onp.uint8
+        # batch arrives ON the mesh sharding: step() skips re-upload
+        assert xb.sharding == trainer._batch_sharding
+        assert trainer._place_batch(xb, trainer._batch_sharding) is xb
+        loss = trainer.step(xb, yb)
+        n += 1
+    assert n == 3
+    assert onp.isfinite(float(onp.asarray(loss)))
+    # second epoch works (source is a plain list)
+    assert sum(1 for _ in feed) == 3
+
+
+def test_sharded_trainer_preprocess_matches_host_normalize():
+    """uint8 wire + in-step preprocess == host-normalized float32 feed."""
+    from incubator_mxnet_tpu import parallel
+
+    def build():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(8), gluon.nn.Dense(3))
+        net.initialize()
+        net(nd.array(onp.zeros((2, 6), onp.float32)))
+        return net
+
+    rs = onp.random.RandomState(2)
+    x8 = rs.randint(0, 256, (8, 6)).astype(onp.uint8)
+    y = rs.randint(0, 3, 8).astype(onp.int32)
+    xf = (x8.astype(onp.float32) - 10.0) / 3.0
+
+    mx.random.seed(11)
+    t_u = parallel.ShardedTrainer(
+        build(), optimizer="sgd", lr=0.1,
+        preprocess=make_normalizer(10.0, 3.0, "float32", axis=-1))
+    mx.random.seed(11)
+    t_f = parallel.ShardedTrainer(build(), optimizer="sgd", lr=0.1)
+    l_u = float(onp.asarray(t_u.step(x8, y)))
+    l_f = float(onp.asarray(t_f.step(xf, y)))
+    assert abs(l_u - l_f) < 1e-5
+
+
+# ---------------------------------------------------------------------------
+# DataLoader / ImageRecordIter hooks
+# ---------------------------------------------------------------------------
+
+def test_dataloader_ctx_feed_matches_plain():
+    ds = mx.gluon.data.ArrayDataset(
+        onp.arange(40).reshape(10, 4).astype(onp.float32),
+        onp.arange(10).astype(onp.float32))
+    plain = mx.gluon.data.DataLoader(ds, batch_size=4)
+    fed = mx.gluon.data.DataLoader(ds, batch_size=4, ctx=mx.cpu())
+    n = 0
+    for bp, bf in zip(plain, fed):
+        onp.testing.assert_array_equal(bp[0].asnumpy(), bf[0].asnumpy())
+        onp.testing.assert_array_equal(bp[1].asnumpy(), bf[1].asnumpy())
+        assert bf[0].context == mx.cpu()
+        n += 1
+    assert n == 3
+    assert sum(1 for _ in fed) == 3       # fresh feed per epoch
+
+
+def test_dataloader_ctx_feed_thread_workers():
+    ds = mx.gluon.data.ArrayDataset(
+        onp.arange(48).reshape(12, 4).astype(onp.float32))
+    fed = mx.gluon.data.DataLoader(ds, batch_size=4, num_workers=2,
+                                   thread_pool=True, ctx=mx.cpu())
+    got = [b for b in fed]
+    assert len(got) == 3
+    onp.testing.assert_array_equal(
+        got[0].asnumpy(), onp.arange(16).reshape(4, 4).astype(onp.float32))
+
+
+@pytest.fixture(scope="module")
+def rec_file(tmp_path_factory):
+    from incubator_mxnet_tpu.io import recordio
+    path = str(tmp_path_factory.mktemp("feedrec") / "data.rec")
+    rs = onp.random.RandomState(42)
+    rec = recordio.MXRecordIO(path, "w")
+    for i in range(40):
+        img = rs.randint(0, 255, (40, 50, 3), dtype=onp.uint8)
+        rec.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i % 7), i, 0), img, quality=92))
+    rec.close()
+    return path
+
+
+def test_image_record_iter_ctx_feed(rec_file):
+    """ctx= mode: batches arrive as device NDArrays (uint8 wire), pads
+    line up with the feed's FIFO, reset() re-arms the epoch."""
+    it = mx.io.ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 32, 32),
+                               batch_size=16, dtype="uint8", ctx=mx.cpu())
+    n = 0
+    labels = []
+    for b in it:
+        assert b.data[0].dtype == onp.uint8
+        assert b.data[0].context == mx.cpu()
+        k = b.data[0].shape[0] - b.pad
+        labels.extend(b.label[0].asnumpy()[:k].tolist())
+        n += k
+    assert n == 40
+    assert labels == [float(i % 7) for i in range(40)]
+    it.reset()
+    assert it.next().data[0].shape == (16, 3, 32, 32)
+
+
+def test_image_record_iter_ctx_feed_matches_sync(rec_file):
+    """Deterministic order (no shuffle/augment): ctx-fed float32 batches
+    must equal the synchronous path bit-for-bit."""
+    a = mx.io.ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 32, 32),
+                              batch_size=8)
+    b = mx.io.ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 32, 32),
+                              batch_size=8, ctx=mx.cpu())
+    ba, bb = a.next(), b.next()
+    onp.testing.assert_array_equal(ba.data[0].asnumpy(),
+                                   bb.data[0].asnumpy())
+    onp.testing.assert_array_equal(ba.label[0].asnumpy(),
+                                   bb.label[0].asnumpy())
+
+
+def test_image_record_iter_uint8_rejects_mean_std(rec_file):
+    with pytest.raises(ValueError):
+        mx.io.ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 16, 16),
+                              batch_size=4, dtype="uint8", mean_r=1.0)
+
+
+def test_image_record_iter_uint8_python_path(rec_file):
+    """dtype='uint8' on the python decode path (native forced off):
+    raw pixels, no normalization."""
+    it = mx.io.ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 24, 24),
+                               batch_size=8, dtype="uint8")
+    it_f = mx.io.ImageRecordIter(path_imgrec=rec_file,
+                                 data_shape=(3, 24, 24), batch_size=8)
+    if it._native is None:
+        bu, bf = it.next(), it_f.next()
+        onp.testing.assert_allclose(
+            bu.data[0].asnumpy().astype(onp.float32),
+            bf.data[0].asnumpy(), atol=1.0)
+    else:
+        # native path active: covered by test_native_io's uint8 tests;
+        # here just check the wire dtype contract
+        assert it.next().data[0].dtype == onp.uint8

@@ -324,6 +324,57 @@ def test_batchnorm_state_updates_all_contexts():
     assert_almost_equal(rm0, rm1)
 
 
+def test_cast_multi_context():
+    """Regression (ADVICE r5): Block.cast() on a net initialized on
+    MULTIPLE contexts must convert every per-context copy — the batched
+    convert runs one executable PER DEVICE (mixing committed devices in
+    one jit call raises)."""
+    import jax
+    try:
+        n_cpu = len(jax.devices("cpu"))
+    except RuntimeError:
+        n_cpu = 0
+    if n_cpu < 2:
+        pytest.skip("needs >= 2 CPU devices for multi-context copies")
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    net = nn.Dense(4, in_units=6)
+    net.initialize(ctx=ctxs)
+    refs = {ctx: net.weight.data(ctx).asnumpy() for ctx in ctxs}
+    net.cast("float16")
+    for ctx in ctxs:
+        arr = net.weight.data(ctx)
+        assert arr.dtype == np.float16
+        assert arr.context == ctx
+        assert_almost_equal(arr.asnumpy().astype(np.float32), refs[ctx],
+                            rtol=1e-2, atol=1e-3)
+    # grads re-initialized in the new dtype on every context
+    for ctx in ctxs:
+        assert net.weight.grad(ctx).dtype == np.float16
+
+
+def test_hybrid_input_transform_fuses_and_matches_eager():
+    """set_input_transform: uint8 wire input is normalized/cast inside
+    the traced executable; hybridized and eager paths agree."""
+    from incubator_mxnet_tpu.io.device_feed import normalize_transform
+    x8 = np.random.RandomState(3).randint(0, 256, (2, 6), np.uint8)
+    xf = (x8.astype(np.float32) - 5.0) / 2.0
+
+    mx.random.seed(13)
+    net = nn.Dense(3, in_units=6)
+    net.initialize()
+    ref = net(nd.array(xf)).asnumpy()
+    net.set_input_transform(normalize_transform(5.0, 2.0, "float32"))
+    eager = net(nd.array(x8, dtype="uint8")).asnumpy()
+    net.hybridize()
+    fused = net(nd.array(x8, dtype="uint8")).asnumpy()
+    assert_almost_equal(eager, ref, rtol=1e-5, atol=1e-5)
+    assert_almost_equal(fused, ref, rtol=1e-5, atol=1e-5)
+    # removal restores the raw-input contract
+    net.set_input_transform(None)
+    raw = net(nd.array(xf)).asnumpy()
+    assert_almost_equal(raw, ref, rtol=1e-5, atol=1e-5)
+
+
 def test_export_import_roundtrip(tmp_path):
     """Regression: export() must actually WRITE the symbol json (it used
     to return a filename it never wrote), and SymbolBlock.imports must
