@@ -571,6 +571,115 @@ def run_wide_deep(batch=2048, fields=16, warmup=3, iters=40,
     return batch * iters / (time.perf_counter() - t0)
 
 
+def run_serve(n_images=512, max_batch=32, seed=0, extra=None):
+    """Serving config (ISSUE 3): the bucketed dynamic-batching
+    InferenceEngine vs the sequential batch-1 baseline on the SAME
+    model — a model_zoo thumbnail ResNet-18 under a mixed-size request
+    stream (the organic-traffic shape that recompiles an eager server
+    to death).  CPU ok.  Reports throughput, p50/p99 latency, the
+    batch-fill/pad-waste economics, and the zero-recompile check:
+    `serve_traces_after_warmup_delta` MUST be 0 — every request size
+    landed on a warmed bucket executable."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon
+    from incubator_mxnet_tpu.monitor import events
+    from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+
+    ctx = mx.gpu()
+    net = resnet18_v1(classes=10, thumbnail=True)
+    net.initialize(ctx=ctx)
+    net.hybridize(static_alloc=True, static_shape=True)
+    rs = np.random.RandomState(seed)
+    imgs = rs.rand(n_images, 3, 32, 32).astype(np.float32)
+
+    # ---- sequential batch-1 baseline: ONE warmed executable, one
+    # image per call, per-call sync (what an eager `block(x)` server
+    # does once its single compiled shape is warm — the best case for
+    # the unbatched path, since organic traffic would also recompile)
+    x1 = nd.array(imgs[:1], ctx=ctx)
+    net(x1).asnumpy()                   # warm the batch-1 executable
+    t0 = time.perf_counter()
+    for i in range(n_images):
+        out = net(nd.array(imgs[i:i + 1], ctx=ctx))
+        # a server RETURNS each result: one-element D2H per request
+        # (async dispatch without it would only measure enqueue)
+        float(out.reshape((-1,))[:1].asnumpy()[0])
+    base_rate = n_images / (time.perf_counter() - t0)
+
+    # ---- engine: warm every bucket, then a mixed-size request stream
+    eng = net.inference_engine(ctx=ctx, max_batch=max_batch,
+                               queue_cap=max(64, n_images))
+    warm = eng.warmup(example_shape=(3, 32, 32), wire_dtype="float32")
+    traces0 = events.get("serve.traces")
+    c0 = events.snapshot("serve.")
+    futs = []
+    t0 = time.perf_counter()
+    i = 0
+    while i < n_images:
+        k = int(rs.choice((1, 1, 2, 3, 5, 8)))      # organic size mix
+        k = min(k, n_images - i)
+        if k == 1:
+            futs.append((1, eng.submit(imgs[i])))
+        else:
+            futs.append((k, eng.submit_batch(imgs[i:i + k])))
+        i += k
+    for _, f in futs:
+        # same per-request one-element D2H the baseline pays — a
+        # server RETURNS results on both paths (symmetric comparison)
+        r = f.result(timeout=120)
+        float(r.reshape((-1,))[:1].asnumpy()[0])
+    eng_rate = n_images / (time.perf_counter() - t0)
+    delta = {k: v - c0.get(k, 0)
+             for k, v in events.snapshot("serve.").items()}
+    e2e = events.percentiles("serve.e2e_us", (50, 99))
+    inf = events.percentiles("serve.infer_us", (50, 99))
+    eng.close()
+    out = {
+        "serve_engine_images_per_sec": round(eng_rate, 2),
+        "serve_baseline_batch1_images_per_sec": round(base_rate, 2),
+        "serve_speedup_vs_batch1": round(eng_rate / base_rate, 2),
+        "serve_model": "resnet18_v1_thumbnail_32x32",
+        "serve_n_images": n_images,
+        "serve_requests": delta.get("serve.requests", 0),
+        "serve_batches": delta.get("serve.batches", 0),
+        "serve_batch_fill": delta.get("serve.batch_fill", 0),
+        "serve_pad_waste": delta.get("serve.pad_waste", 0),
+        "serve_rejected": delta.get("serve.rejected", 0),
+        "serve_p50_e2e_ms": round(e2e.get("p50", 0) / 1e3, 3),
+        "serve_p99_e2e_ms": round(e2e.get("p99", 0) / 1e3, 3),
+        "serve_p50_infer_us": int(inf.get("p50", 0)),
+        "serve_p99_infer_us": int(inf.get("p99", 0)),
+        "serve_buckets": warm["buckets"],
+        "serve_warmup_wall_s": warm["wall_s"],
+        # the zero-recompile contract: 0 new traces after warmup under
+        # the mixed-size stream
+        "serve_traces_after_warmup_delta":
+            events.get("serve.traces") - traces0,
+    }
+    if extra is not None:
+        extra.update(out)
+    return out
+
+
+def _write_bench_serve(parsed, rc=0):
+    """BENCH_serve.json in the BENCH_r* schema ({n, cmd, rc, tail,
+    parsed}) so the perf-trajectory tooling picks the serving numbers
+    up alongside the training rounds."""
+    import re
+    here = os.path.dirname(os.path.abspath(__file__))
+    n = 0
+    for f in os.listdir(here):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", f)
+        if m:
+            n = max(n, int(m.group(1)))
+    line = json.dumps(parsed)
+    blob = {"n": n, "cmd": "python bench.py serve", "rc": rc,
+            "tail": line + "\n", "parsed": parsed}
+    with open(os.path.join(here, "BENCH_serve.json"), "w") as fh:
+        json.dump(blob, fh, indent=2)
+    return line
+
+
 def build_sharded_trainer(batch):
     import jax
     import jax.numpy as jnp
@@ -817,6 +926,7 @@ _CONFIGS = {
     "int8": lambda b=None: _cfg_simple(
         "resnet50_int8_infer_images_per_sec", run_int8_infer, (64, 32)),
     "quality": lambda b=None: run_quality(),
+    "serve": lambda b=None: _cfg_serve(),
 }
 
 # batch ladders main() walks one-subprocess-per-attempt (first success
@@ -888,6 +998,15 @@ def _cfg_io():
             "io_host_cores": os.cpu_count()}
 
 
+def _cfg_serve():
+    parsed = run_serve()
+    try:
+        _write_bench_serve(parsed)      # trajectory file rides along
+    except Exception:
+        pass
+    return parsed
+
+
 def _run_config_subprocess(name, timeout_s, batch=None):
     import subprocess
     cmd = [sys.executable, os.path.abspath(__file__), "--config", name]
@@ -923,12 +1042,13 @@ def main():
     times = {}
     required = ("resnet", "bert", "ssd512", "rcnn", "gnmt",
                 "transformer_nmt", "wide_deep")
-    optional = ("io", "sharded", "quality", "int8")
+    optional = ("io", "serve", "sharded", "quality", "int8")
 
     # optional configs need this much budget left to be worth starting
     # (below it they'd time out AT the budget edge instead of skipping
     # cleanly — int8's quantization calibration alone needs ~4 min cold)
-    optional_min = {"io": 30, "sharded": 90, "quality": 120, "int8": 250}
+    optional_min = {"io": 30, "serve": 90, "sharded": 90,
+                    "quality": 120, "int8": 250}
 
     for name in required + optional:
         remaining = budget - (time.perf_counter() - t_start)
@@ -999,6 +1119,18 @@ def main():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "serve":
+        # standalone serving bench: ONE JSON line + BENCH_serve.json
+        # (same {n, cmd, rc, tail, parsed} schema as BENCH_r*)
+        try:
+            parsed = run_serve()
+            rc = 0 if parsed.get("serve_speedup_vs_batch1", 0) and \
+                parsed.get("serve_traces_after_warmup_delta", 1) == 0 \
+                else 1
+        except Exception as e:
+            parsed, rc = {"serve_error": str(e)[:160]}, 1
+        print(_write_bench_serve(parsed, rc=rc))
+        sys.exit(rc)
     if len(sys.argv) >= 3 and sys.argv[1] == "--config":
         name = sys.argv[2]
         batch = sys.argv[3] if len(sys.argv) >= 4 else None

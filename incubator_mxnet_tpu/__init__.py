@@ -58,6 +58,7 @@ from . import callback
 from . import monitor
 from .monitor import Monitor
 from . import fault
+from . import serving
 from . import numpy as np              # mx.np — NumPy-semantics front-end
 from . import numpy_extension as npx   # mx.npx — NN extensions + set_np
 from .util import is_np_array, set_np, reset_np, use_np

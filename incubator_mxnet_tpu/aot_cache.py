@@ -33,11 +33,47 @@ import jax
 
 from . import config as _cfg
 
-__all__ = ["aot_jit", "cache_dir"]
+__all__ = ["aot_jit", "cache_dir", "trim_cache"]
 
 
 def cache_dir():
     return _cfg.get("MXNET_AOT_CACHE_DIR") or ""
+
+
+def trim_cache(max_entries=None):
+    """Keep-K LRU over the on-disk blobs: evict oldest-mtime `.pjrtx`
+    entries beyond `max_entries` (default `MXNET_AOT_CACHE_MAX`; 0 =
+    unbounded).  Cache hits refresh mtime, so recently-served
+    executables survive — the bound long-lived serving hosts need
+    (every new model/bucket/shape otherwise grows the dir forever).
+    Best-effort and race-tolerant (concurrent processes may evict the
+    same entry); returns the number of entries removed."""
+    if max_entries is None:
+        max_entries = int(_cfg.get("MXNET_AOT_CACHE_MAX"))
+    d = cache_dir()
+    if not d or max_entries <= 0:
+        return 0
+    try:
+        entries = []
+        for name in os.listdir(d):
+            if not name.endswith(".pjrtx"):
+                continue
+            try:
+                entries.append((os.path.getmtime(os.path.join(d, name)),
+                                name))
+            except OSError:
+                continue        # concurrently evicted/renamed
+        entries.sort()          # oldest mtime first
+        removed = 0
+        for _, name in entries[:max(0, len(entries) - max_entries)]:
+            try:
+                os.remove(os.path.join(d, name))
+                removed += 1
+            except OSError:
+                pass
+        return removed
+    except OSError:
+        return 0
 
 
 def _key_for(lowered, dev):
@@ -131,6 +167,10 @@ class _AotJitted:
                 out = deserialize_and_load(
                     blob, in_tree, out_tree,
                     execution_devices=[dev])
+                try:            # LRU: a hit refreshes eviction order
+                    os.utime(path)
+                except OSError:
+                    pass
                 if dbg:
                     print("[aot] HIT lower=%.1fs key=%.1fs load=%.1fs"
                           % (t1 - t0, t2 - t1, _t.perf_counter() - t2))
@@ -151,6 +191,7 @@ class _AotJitted:
             with open(tmp, "wb") as f:
                 f.write(blob)
             os.replace(tmp, path)       # atomic: concurrent procs race safely
+            trim_cache()                # keep-K bound (MXNET_AOT_CACHE_MAX)
         except Exception:
             pass                        # cache write is best-effort
         return compiled

@@ -238,6 +238,36 @@ register("MXNET_FEED_WIRE_DTYPE", str, "uint8",
          "ships raw augmented pixels (4x fewer H2D bytes, mean/std "
          "fused on device), 'float32' the host-normalized tensor",
          choices=("uint8", "float32"))
+register("MXNET_SERVE_MAX_BATCH", int, 32,
+         "InferenceEngine (serving.engine): largest batch bucket — the "
+         "dispatcher coalesces queued requests up to this many examples "
+         "per executable call")
+register("MXNET_SERVE_MAX_WAIT_US", int, 2000,
+         "InferenceEngine: microseconds the dispatcher waits for more "
+         "requests to fill a bucket before dispatching a partial batch "
+         "(the latency/throughput coalescing knob)")
+register("MXNET_SERVE_QUEUE_CAP", int, 256,
+         "InferenceEngine: bounded request-queue capacity; submits "
+         "beyond it are rejected with QueueFull (backpressure instead "
+         "of unbounded memory growth)")
+register("MXNET_SERVE_BUCKETS", str, "",
+         "InferenceEngine: comma-separated batch bucket sizes (e.g. "
+         "'1,2,4,8'). Empty = powers of two up to "
+         "MXNET_SERVE_MAX_BATCH. The bucket set is CLOSED: every "
+         "request batch is padded up to a bucket, so the compiled "
+         "executable set is fixed after warmup()")
+register("MXNET_AOT_CACHE_MAX", int, 0,
+         "aot_cache: max on-disk serialized executables; older entries "
+         "(by mtime; cache hits refresh it, so this is keep-K LRU) are "
+         "evicted after each store. 0 = unbounded (training default; "
+         "long-lived serving hosts should bound it)")
+register("MXNET_BN_STABLE_VAR", bool, False,
+         "BatchNorm batch statistics: 1 = shifted two-pass variance "
+         "E[(x-mean)^2] (numerically safe when |mean| >> std, e.g. f32 "
+         "nets on unnormalized inputs — ADVICE.md round 5), 0 = fused "
+         "one-pass E[x^2]-E[x]^2 (single read of x; the bf16 default "
+         "where activations are normalized and HBM reads are the step "
+         "time)")
 register("MXNET_INT64_TENSOR_SIZE", bool, False,
          "Large-tensor support: enable 64-bit index arithmetic so "
          "arrays past 2**31 elements index correctly (ref: the "

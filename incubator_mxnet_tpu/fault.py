@@ -18,6 +18,15 @@ Sites are plain strings; the built-in ones:
     io.slow             reader paths: sleeps `seconds`
     kvstore.barrier_hang  DistKVStore._barrier body stalls (timeout test)
     checkpoint.save     ResilientTrainer checkpoint I/O: TransientFault
+    serve.enqueue       InferenceEngine.submit: the request is rejected
+                        (QueueFull) at enqueue time — the backpressure
+                        path without filling a real queue
+    serve.infer         InferenceEngine dispatch (per executable call,
+                        call-ordinal = batch number): TransientFault,
+                        retried via the standard retry budget; with
+                        `seconds` the dispatch also stalls first, which
+                        is how queue-full / deadline-expiry tests hold
+                        the dispatcher busy deterministically
 
 Faults install programmatically::
 

@@ -1248,6 +1248,18 @@ class HybridBlock(Block):
             return (tr(args[0]),) + tuple(args[1:])
         return args
 
+    def inference_engine(self, **kwargs):
+        """Build a `serving.InferenceEngine` over this block: concurrent
+        request API, shape-bucketed dynamic batching, AOT-warmed
+        executables (ISSUE 3).  Any installed `set_input_transform`
+        (e.g. `io.device_feed.normalize_transform`) is traced into every
+        bucket executable, so uint8-on-wire inference matches the
+        training feed path byte-for-byte.  Keyword args are forwarded to
+        `InferenceEngine` (ctx/devices, buckets, max_batch, queue_cap,
+        example_shape, wire_dtype, handle_sigterm, ...)."""
+        from ..serving import InferenceEngine
+        return InferenceEngine(self, **kwargs)
+
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
                   inline_limit=2, forward_bulk_size=None,
                   backward_bulk_size=None, remat=False, remat_policy=None):

@@ -27,14 +27,24 @@ class EventCounters:
     loss, rollbacks, transient-failure retries, injected faults.  The
     device-feed pipeline (`io.device_feed`) reports its per-stage
     wall/bytes counters (`feed.*`) the same way, so feed/compute
-    balance is observable without a profiler.
+    balance is observable without a profiler.  The serving engine
+    (`serving.engine`) reports its queue/infer/fill counters (`serve.*`)
+    and additionally `observe()`s per-request latency samples so p50/p99
+    are recoverable (`percentiles`/`latency_snapshot`) — counters alone
+    only give means, and serving SLOs are tail-defined.
     Thread-safe; process-local (each worker reports its own counts,
     matching per-worker ps-lite server stats in the reference).
     """
 
+    #: per-name latency sample retention (ring buffer) — bounds memory
+    #: on long-lived serving hosts while keeping p99 over a recent
+    #: window meaningful
+    MAX_SAMPLES = 4096
+
     def __init__(self):
         self._lock = threading.Lock()
         self._counts = {}
+        self._samples = {}
 
     def incr(self, name: str, n: int = 1) -> int:
         with self._lock:
@@ -50,6 +60,54 @@ class EventCounters:
         with self._lock:
             return self._counts.get(name, 0)
 
+    # -- latency samples / percentiles ---------------------------------
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample (convention: microseconds, name ends in
+        `_us`) into a bounded per-name ring buffer; `incr`s the
+        companion counter `<name>.n` so sample flow is visible in plain
+        snapshots too."""
+        from collections import deque
+        with self._lock:
+            dq = self._samples.get(name)
+            if dq is None:
+                dq = self._samples[name] = deque(maxlen=self.MAX_SAMPLES)
+            dq.append(float(value))
+            self._counts[name + ".n"] = \
+                self._counts.get(name + ".n", 0) + 1
+
+    def observe_time(self, name: str, seconds: float) -> None:
+        """`observe` a wall-clock interval in integer microseconds AND
+        accumulate it on the monotonic `name` counter (so totals and
+        percentiles stay in one place)."""
+        us = int(seconds * 1e6)
+        self.incr(name, us)
+        self.observe(name, us)
+
+    def percentiles(self, name: str, pcts=(50, 90, 99)) -> dict:
+        """{'p50': ..., 'p90': ..., 'p99': ..., 'n': samples} over the
+        retained window for `name` (empty dict when nothing observed).
+        Nearest-rank on the sorted window — no numpy dependency."""
+        with self._lock:
+            dq = self._samples.get(name)
+            if not dq:
+                return {}
+            xs = sorted(dq)
+        n = len(xs)
+        out = {"n": n}
+        for p in pcts:
+            idx = min(n - 1, max(0, int(round(p / 100.0 * n)) - 1))
+            out["p%g" % p] = xs[idx]
+        return out
+
+    def latency_snapshot(self, prefix: str = None, pcts=(50, 90, 99)) \
+            -> dict:
+        """Percentile summary of every observed series (optionally
+        filtered by name prefix): {name: {'p50':..,'p99':..,'n':..}}."""
+        with self._lock:
+            names = [k for k in self._samples
+                     if prefix is None or k.startswith(prefix)]
+        return {k: self.percentiles(k, pcts) for k in names}
+
     def snapshot(self, prefix: str = None) -> dict:
         with self._lock:
             if prefix is None:
@@ -60,6 +118,7 @@ class EventCounters:
     def reset(self) -> None:
         with self._lock:
             self._counts.clear()
+            self._samples.clear()
 
     def log_nonzero(self, logger=None) -> None:
         logger = logger or logging.getLogger(__name__)

@@ -15,7 +15,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_mesh", "Mesh", "NamedSharding", "P", "replicated",
-           "batch_sharded", "default_dp_mesh"]
+           "batch_sharded", "default_dp_mesh", "replica_contexts"]
 
 
 def make_mesh(shape: Sequence[int] = None,
@@ -35,6 +35,26 @@ def make_mesh(shape: Sequence[int] = None,
 
 def default_dp_mesh() -> Mesh:
     return make_mesh()
+
+
+def replica_contexts(mesh: Optional[Mesh] = None):
+    """This process's mesh devices as framework Contexts — the replica
+    set a `serving.InferenceEngine` round-robins inference buckets
+    across (each replica holds a full parameter copy; data-parallel
+    serving, the inference-side mirror of the DP training mesh).
+    Non-addressable devices (other processes' chips in a
+    multi-controller mesh) are skipped: each host serves its own."""
+    from ..context import Context
+    devs = (list(mesh.devices.flat) if mesh is not None
+            else jax.local_devices())
+    local_index = {d.id: i for i, d in enumerate(jax.local_devices())}
+    out = []
+    for d in devs:
+        i = local_index.get(d.id)
+        if i is None:       # not addressable from this process
+            continue
+        out.append(Context("cpu" if d.platform == "cpu" else "tpu", i))
+    return out
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

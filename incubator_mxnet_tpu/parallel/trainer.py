@@ -300,6 +300,20 @@ class ShardedTrainer:
         """Write trained params back into the Gluon block."""
         load_params(self.block, self.params)
 
+    def serve(self, **kwargs):
+        """Train→serve handoff: sync the trained params back into the
+        block and build a `serving.InferenceEngine` whose replica set is
+        THIS trainer's mesh devices (round-robin bucket dispatch, one
+        full parameter copy per device — the inference-side mirror of
+        the DP training mesh).  Pass `devices=` to override; all other
+        kwargs forward to `InferenceEngine` (buckets, max_batch,
+        example_shape, handle_sigterm, ...)."""
+        from ..serving import InferenceEngine
+        from .mesh import replica_contexts
+        self.sync_to_block()
+        kwargs.setdefault("devices", replica_contexts(self.mesh))
+        return InferenceEngine(self.block, **kwargs)
+
     # ------------------------------------------------------------------
     # sharded checkpoint/resume (ref: Trainer.save_states/load_states —
     # at pod scale the states are sharded over the mesh, so the

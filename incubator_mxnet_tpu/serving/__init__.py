@@ -1,0 +1,19 @@
+"""Inference serving subsystem (ISSUE 3): shape-bucketed dynamic
+batching over AOT-warmed executables — the deploy-side counterpart of
+the resilient trainer (PR 1) and the async device feed (PR 2).
+
+    from incubator_mxnet_tpu import serving
+    eng = net.inference_engine(ctx=mx.gpu())       # or serving.InferenceEngine(net)
+    eng.warmup(example_shape=(3, 224, 224), wire_dtype="uint8")
+    fut = eng.submit(img)                          # concurrent: returns a Future
+    probs = fut.result()
+    eng.close()
+
+See docs/serving.md for lifecycle, knob tuning and the counter
+reference.
+"""
+from .engine import (InferenceEngine, QueueFull, DeadlineExceeded,
+                     EngineClosed, serve_counters)
+
+__all__ = ["InferenceEngine", "QueueFull", "DeadlineExceeded",
+           "EngineClosed", "serve_counters"]

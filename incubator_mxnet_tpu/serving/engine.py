@@ -1,0 +1,796 @@
+"""Inference serving engine: shape-bucketed dynamic batching over
+AOT-warmed executables (ISSUE 3 tentpole).
+
+The ROADMAP north star is "heavy traffic from millions of users", and
+the serving-side analogue of the training recompilation problem is the
+RECOMPILATION CLIFF: eager `block(x)` compiles one executable per input
+batch size, so organic traffic (every batch size from 1 to N) triggers
+a fresh trace+compile on this backend's remote compiler — seconds to
+minutes of tail latency per new shape (PROFILE.md; the hazard TVM
+arxiv 1802.04799 and the XLA fusion analysis arxiv 2301.13062 both
+center on).  The engine closes the executable set instead:
+
+1. **Shape buckets.**  Requests are coalesced by a background
+   dispatcher into power-of-two batch buckets (`MXNET_SERVE_BUCKETS`,
+   default 1,2,4,...,`MXNET_SERVE_MAX_BATCH`) and padded up to the
+   bucket size, so the set of compiled executables is CLOSED and
+   known in advance.
+2. **AOT warm.**  `warmup()` pre-compiles every (device, bucket)
+   executable before traffic, through `aot_cache.aot_jit` — with
+   `MXNET_AOT_CACHE_DIR` set, a restarted serving host deserializes
+   the whole executable set from disk instead of recompiling
+   (sub-second vs 75-260 s per executable on the remote-compile
+   backend).  `serve.traces` counts executable traces; it stays FLAT
+   after warmup under mixed-size traffic — the zero-recompile
+   contract `bench.py serve` asserts.
+3. **Concurrency.**  Callers `submit()` single examples (or
+   `submit_batch()` small batches) and get `concurrent.futures`
+   futures; a dispatcher thread coalesces, and with multiple replica
+   devices each device gets its own single-thread worker so buckets
+   execute concurrently across replicas (in-flight bounded at the
+   replica count).  The request queue
+   is BOUNDED (`MXNET_SERVE_QUEUE_CAP`): submits beyond it fail fast
+   with `QueueFull` (backpressure, not unbounded memory).  Each
+   request may carry a deadline; a request that expires waiting is
+   resolved with `DeadlineExceeded` and never wastes device time.
+4. **Robustness (PR 1 patterns).**  `drain()`/`close()` complete
+   in-flight work and join the dispatcher within a timeout; every
+   outstanding future is resolved.  `handle_sigterm=True` installs the
+   flag-only preemption handler (resilience.py pattern): on SIGTERM
+   the engine stops intake, finishes the queue, and retires.  Fault
+   sites `serve.enqueue` / `serve.infer` (fault.py) inject rejection
+   and transient executable failure; infer faults are retried on the
+   standard `retry_transient` budget.
+5. **Observability.**  `serve.*` counters on `monitor.events`
+   (`queue_us`, `infer_us`, `e2e_us`, `batch_fill`, `pad_waste`,
+   `rejected`, `batches`, `requests`, `traces`, ...) plus per-request
+   latency samples for `events.percentiles("serve.e2e_us")` — tails,
+   not means, are the serving SLO.
+
+Multi-device replica dispatch: pass `devices=[ctx, ...]` (or build via
+`ShardedTrainer.serve()` / `parallel.mesh.replica_contexts`) and the
+dispatcher round-robins buckets across per-device parameter replicas.
+
+The uint8 wire contract matches PR 2's training path: with
+`HybridBlock.set_input_transform(normalize_transform(...))` installed,
+clients submit raw uint8 pixels, the engine ships them as-is (4x fewer
+wire bytes) and the normalize+cast is traced INTO each bucket
+executable.
+"""
+from __future__ import annotations
+
+import queue
+import signal
+import threading
+import time
+import weakref
+from concurrent.futures import Future
+
+import numpy as _np
+
+from .. import config as _cfg
+from .. import fault
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..monitor import events
+
+__all__ = ["InferenceEngine", "QueueFull", "DeadlineExceeded",
+           "EngineClosed", "serve_counters"]
+
+
+class QueueFull(MXNetError):
+    """The bounded request queue is at capacity — backpressure: the
+    caller should retry later or shed load upstream."""
+
+
+class DeadlineExceeded(MXNetError):
+    """The request's deadline expired before it reached the device."""
+
+
+class EngineClosed(MXNetError):
+    """submit() after drain()/close() (or during SIGTERM drain)."""
+
+
+def serve_counters():
+    """Snapshot of the `serve.*` counters (µs totals / counts)."""
+    return events.snapshot("serve.")
+
+
+class _Request:
+    __slots__ = ("data", "n", "future", "t_enq", "deadline", "single")
+
+    def __init__(self, data, n, future, deadline, single):
+        self.data = data
+        self.n = n
+        self.future = future
+        self.t_enq = time.monotonic()
+        self.deadline = None if deadline is None \
+            else self.t_enq + float(deadline)
+        self.single = single
+
+
+def _parse_buckets(spec, max_batch):
+    if spec and isinstance(spec, (list, tuple, set, frozenset)):
+        bs = sorted({int(s) for s in spec})
+    elif spec:
+        bs = sorted({int(s) for s in str(spec).split(",") if s.strip()})
+    else:
+        bs, b = [], 1
+        while b < max_batch:
+            bs.append(b)
+            b *= 2
+        bs.append(int(max_batch))
+        bs = sorted(set(bs))
+    if not bs or bs[0] < 1:
+        raise ValueError("serve buckets must be positive ints, got %r"
+                         % (spec,))
+    return tuple(bs)
+
+
+class InferenceEngine:
+    """Concurrent inference over a Block with bucketed dynamic batching.
+
+    block: a (Hybrid)Block with initialized parameters.  Its
+        `set_input_transform` (if any) is traced into every bucket
+        executable — the uint8-on-wire path.
+    ctx / devices: one Context, or a list for replica round-robin
+        (default: the current context).
+    buckets / max_batch / max_wait_us / queue_cap: see the
+        MXNET_SERVE_* knobs in config.py (arguments override).
+    example_shape / wire_dtype: per-example shape (no batch dim) and
+        the dtype clients put on the wire; needed by `warmup()` before
+        the first request has been seen.
+
+    Lifecycle: construct → `warmup()` → submit traffic → `drain()` /
+    `close()`.  The dispatcher thread starts lazily on first submit.
+    """
+
+    def __init__(self, block, ctx=None, devices=None, buckets=None,
+                 max_batch=None, max_wait_us=None, queue_cap=None,
+                 example_shape=None, wire_dtype=None,
+                 handle_sigterm=False):
+        from ..parallel.functional import functionalize
+        if devices is None:
+            devices = [ctx or current_context()]
+        elif ctx is not None:
+            raise ValueError("pass ctx= or devices=, not both")
+        if not devices:
+            raise ValueError("need at least one serving device")
+        self._block = block
+        self._ctxs = [d if isinstance(d, Context) else Context(*d)
+                      for d in devices]
+        max_batch = int(max_batch if max_batch is not None
+                        else _cfg.get("MXNET_SERVE_MAX_BATCH"))
+        self._buckets = _parse_buckets(
+            buckets if buckets is not None
+            else _cfg.get("MXNET_SERVE_BUCKETS"), max_batch)
+        self._max_wait = (int(max_wait_us if max_wait_us is not None
+                              else _cfg.get("MXNET_SERVE_MAX_WAIT_US"))
+                          / 1e6)
+        cap = int(queue_cap if queue_cap is not None
+                  else _cfg.get("MXNET_SERVE_QUEUE_CAP"))
+        self._q = queue.Queue(maxsize=max(1, cap))
+        self._example_shape = (tuple(example_shape)
+                               if example_shape is not None else None)
+        self._wire_dtype = (str(_np.dtype(wire_dtype))
+                            if wire_dtype is not None else None)
+
+        self._pure = functionalize(block, training=False)
+        self._infer = self._make_infer()
+        self._dev_params = None     # list of {name: jax.Array} per ctx
+        try:
+            self.refresh_params()
+        except Exception:
+            # deferred-shape params (model_zoo nets before a first
+            # forward): resolved lazily from the first concrete batch
+            # in _run (shape inference needs an input signature)
+            self._dev_params = None
+
+        self._lock = threading.Lock()       # submit/lifecycle state
+        self._exec_lock = threading.Lock()  # trace/execute (warmup vs
+                                            # dispatcher share the block)
+        self._thread = None
+        self._carry = None          # request pulled but not yet batched
+        self._rr = 0
+        self._n_batches = 0
+        self._dev_batches = [0] * len(self._ctxs)
+        self._n_inflight = 0
+        if len(self._ctxs) > 1:
+            # replica overlap: one single-thread worker per device so
+            # device k+1 executes while device k is still busy; the
+            # semaphore bounds total in-flight batches at the replica
+            # count (a pool backlog would reintroduce the unbounded
+            # memory the bounded queue exists to prevent)
+            from concurrent.futures import ThreadPoolExecutor
+            self._pools = [ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ServeReplica%d" % i)
+                for i in range(len(self._ctxs))]
+            self._inflight = threading.Semaphore(len(self._ctxs))
+        else:
+            self._pools = None
+            self._inflight = None
+        self._draining = False
+        self._stop = False
+        self._closed = False
+        self._warm = False
+        self._prev_sigterm = None
+        if handle_sigterm:
+            self._install_sigterm()
+
+    # -- executable construction ---------------------------------------
+    def _make_infer(self):
+        from ..aot_cache import aot_jit
+        from ..ndarray.ndarray import NDArray
+        pure = self._pure
+        block = self._block
+
+        def infer(params, x):
+            # trace-time side effect ONLY: a jit-cache hit never runs
+            # this python body, so the counter is the recompile meter
+            # the zero-recompile-after-warmup contract is asserted on
+            events.incr("serve.traces")
+            nd_in = (NDArray(x),)
+            tr = getattr(block, "_apply_input_transform", None)
+            if tr is not None:
+                # same seam as training (PR 2): uint8 wire → on-device
+                # normalize/cast, fused into this bucket's executable
+                nd_in = tr(nd_in)
+            out, _states = pure(params, *nd_in)
+            return out
+
+        return aot_jit(infer)
+
+    def refresh_params(self):
+        """(Re-)replicate the block's current parameters onto every
+        serving device (call after the block was retrained/updated)."""
+        import jax
+        from ..parallel.functional import extract_params
+        base = extract_params(self._block)
+        self._dev_params = [
+            {n: jax.device_put(v, c.jax_device)
+             for n, v in base.items()}
+            for c in self._ctxs]
+
+    # -- signal / preemption (PR 1 pattern) ----------------------------
+    def _install_sigterm(self):
+        ref = weakref.ref(self)         # the process-global handler
+        state = {}                      # must not pin the engine (same
+                                        # GC contract as the dispatcher)
+
+        def _on_sigterm(signum, frame):
+            eng = ref()
+            if eng is not None:
+                # flag only (signal-safe): the dispatcher notices,
+                # stops intake, completes queued work, and retires
+                eng._draining = True
+                events.incr("serve.preempted")
+                return
+            # engine collected without close(): restore the previous
+            # handler and re-deliver, so the process keeps honoring
+            # preemption instead of silently swallowing SIGTERM
+            try:
+                signal.signal(signal.SIGTERM,
+                              state.get("prev") or signal.SIG_DFL)
+                signal.raise_signal(signal.SIGTERM)
+            except Exception:           # noqa: BLE001
+                pass
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM,
+                                               _on_sigterm)
+            state["prev"] = self._prev_sigterm
+        except ValueError:          # not the main thread
+            self._prev_sigterm = None
+
+    def uninstall_sigterm(self):
+        if self._prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except ValueError:
+                pass
+            self._prev_sigterm = None
+
+    def request_shutdown(self):
+        """Programmatic SIGTERM equivalent: stop intake, finish queued
+        work in the background (pair with `close()` to join)."""
+        self._draining = True
+        events.incr("serve.preempted")
+
+    # -- submission ----------------------------------------------------
+    def _host_array(self, x):
+        from ..ndarray.ndarray import NDArray
+        if isinstance(x, NDArray):
+            return x.asnumpy()
+        return _np.asarray(x)
+
+    def _check_example(self, shape, dtype):
+        # shape AND wire dtype are the executable signature: accepting
+        # a wrong-dtype request would silently trace a NEW executable
+        # (the recompilation cliff this engine exists to close) and a
+        # mixed-dtype coalesced batch would promote via np.concatenate.
+        # Locked: two racing first-ever submits must agree on ONE
+        # signature (the loser gets the error, not the dispatcher).
+        dtype = str(_np.dtype(dtype))
+        with self._lock:
+            if self._example_shape is None:
+                self._example_shape = tuple(shape)
+                self._wire_dtype = dtype
+                return
+            if tuple(shape) != self._example_shape:
+                raise ValueError(
+                    "request example shape %r != engine example shape "
+                    "%r (one executable set serves ONE signature; "
+                    "build a second engine for a second signature)"
+                    % (tuple(shape), self._example_shape))
+            if self._wire_dtype is None:
+                self._wire_dtype = dtype
+            elif dtype != self._wire_dtype:
+                raise ValueError(
+                    "request wire dtype %s != engine wire dtype %s "
+                    "(dtype is part of the warmed executable "
+                    "signature; convert client-side)"
+                    % (dtype, self._wire_dtype))
+
+    def submit(self, x, deadline=None):
+        """Enqueue ONE example (no batch dim).  Returns a Future whose
+        result is the model output for this example (batch dim
+        stripped), an NDArray on the executing device.  `deadline` is
+        seconds from now; expiry resolves the future with
+        DeadlineExceeded.  Raises QueueFull / EngineClosed
+        synchronously."""
+        arr = self._host_array(x)
+        return self._submit(arr[None], deadline, single=True)
+
+    def submit_batch(self, x, deadline=None):
+        """Enqueue a small batch (leading batch dim, size ≤ the largest
+        bucket).  The batch is dispatched as one unit (never split), so
+        it shares one future."""
+        arr = self._host_array(x)
+        if arr.ndim < 1 or arr.shape[0] < 1:
+            raise ValueError("submit_batch needs a leading batch dim")
+        if arr.shape[0] > self._buckets[-1]:
+            raise ValueError(
+                "batch of %d exceeds the largest bucket (%d); chunk it "
+                "client-side (the bucket set is closed by design)"
+                % (arr.shape[0], self._buckets[-1]))
+        return self._submit(arr, deadline, single=False)
+
+    def _submit(self, arr, deadline, single):
+        if fault.should_fire("serve.enqueue"):
+            events.incr("serve.rejected")
+            raise QueueFull("injected enqueue fault (serve.enqueue)")
+        self._check_example(arr.shape[1:], arr.dtype)
+        fut = Future()
+        req = _Request(arr, arr.shape[0], fut, deadline, single)
+        # closed-check + enqueue are ATOMIC against close()'s final
+        # flush (which sets _closed then drains the queue under the
+        # same lock): a put that wins the race lands BEFORE the flush
+        # and is resolved by it — no future is ever stranded
+        with self._lock:
+            if self._closed or self._draining:
+                events.incr("serve.rejected")
+                raise EngineClosed("engine is draining/closed")
+            try:
+                self._q.put_nowait(req)
+            except queue.Full:
+                events.incr("serve.rejected")
+                raise QueueFull(
+                    "serve queue at capacity (%d requests); retry "
+                    "later or raise MXNET_SERVE_QUEUE_CAP"
+                    % self._q.maxsize)
+        self._ensure_dispatcher()
+        return fut
+
+    def _ensure_dispatcher(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=InferenceEngine._dispatch_loop,
+                    args=(weakref.ref(self),), daemon=True,
+                    name="ServeDispatcher")
+                self._thread.start()
+
+    # -- dispatcher ----------------------------------------------------
+    @staticmethod
+    def _dispatch_loop(ref):
+        """Holds the engine only through a WEAKREF between iterations
+        (the DeviceFeed._run pattern): an engine dropped without
+        close() becomes unreachable, the GC fires __del__ (stop
+        flags), and this thread retires at its next poll — a
+        bound-method target would pin the engine (and its per-device
+        parameter replicas) for process lifetime on exactly the
+        long-lived hosts that rebuild engines per model refresh."""
+        while True:
+            eng = ref()
+            if eng is None:
+                return
+            try:
+                reqs = eng._collect()
+                if reqs is None:
+                    return
+                if reqs:                # [] = idle poll: release the
+                    eng._execute(reqs)  # strong ref and re-resolve
+            except Exception:           # noqa: BLE001 — the dispatcher
+                # must survive ANYTHING (a dead dispatcher strands every
+                # queued future); _execute resolves its own requests, so
+                # whatever escaped here had none in hand
+                import logging
+                logging.getLogger(__name__).exception(
+                    "serve dispatcher error (recovered)")
+                events.incr("serve.dispatcher_errors")
+                time.sleep(0.01)
+            finally:
+                del eng
+
+    def _finish(self, req, result=None, exc=None):
+        """Resolve a request's future (result or exception) and retire
+        its queue slot — tolerant of caller-side cancel()/double
+        resolution (a cancelled future raises InvalidStateError on
+        set_*; that must never kill the dispatcher or skew task_done
+        accounting)."""
+        try:
+            if exc is not None:
+                req.future.set_exception(exc)
+            else:
+                req.future.set_result(result)
+        except Exception:               # noqa: BLE001 — cancelled/done
+            events.incr("serve.cancelled")
+        self._q.task_done()
+
+    def _collect(self):
+        """Coalesce queued requests into one bucket's worth: pull
+        greedily while the queue is non-empty, wait up to max_wait for
+        fill once it runs dry, stop at the largest bucket.  Returns the
+        request list, or None when the dispatcher should retire."""
+        max_b = self._buckets[-1]
+        reqs, total = [], 0
+        edl = None              # earliest deadline among collected reqs
+        with self._lock:        # carry handoff races close()'s flush
+            carry, self._carry = self._carry, None
+        if carry is not None:
+            reqs.append(carry)
+            total = carry.n
+            edl = carry.deadline
+        t_first = time.monotonic() if reqs else None
+        while total < max_b:
+            if self._stop:
+                break
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                if reqs:
+                    now = time.monotonic()
+                    rem = self._max_wait - (now - t_first)
+                    if edl is not None:
+                        # a collected request is about to expire: stop
+                        # filling and dispatch (or reap) it promptly
+                        # instead of padding the wait to max_wait
+                        rem = min(rem, edl - now)
+                    if rem <= 0:
+                        break
+                    try:
+                        item = self._q.get(timeout=min(rem, 0.05))
+                    except queue.Empty:
+                        continue
+                else:
+                    if self._draining:
+                        return None     # intake stopped + queue empty
+                    try:                # idle poll (watches stop flags)
+                        item = self._q.get(timeout=0.05)
+                    except queue.Empty:
+                        # surface to the outer loop so the dispatcher's
+                        # strong engine ref lapses between idle polls
+                        # (abandonment/GC liveness)
+                        return []
+            if item.deadline is not None and \
+                    time.monotonic() > item.deadline:
+                self._expire(item)
+                continue
+            if total + item.n > max_b:
+                with self._lock:
+                    self._carry = item  # next batch starts with it
+                break
+            reqs.append(item)
+            total += item.n
+            if item.deadline is not None:
+                edl = item.deadline if edl is None \
+                    else min(edl, item.deadline)
+            if t_first is None:
+                t_first = time.monotonic()
+        return reqs if reqs else None
+
+    def _expire(self, req):
+        events.incr("serve.rejected")
+        events.incr("serve.deadline_expired")
+        self._finish(req, exc=DeadlineExceeded(
+            "request expired after %.3fs in queue"
+            % (time.monotonic() - req.t_enq)))
+
+    def _bucket_for(self, n):
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return self._buckets[-1]
+
+    def _execute(self, reqs):
+        # deadline re-check at dispatch time: expiry during the
+        # coalescing window must not burn device time
+        live = []
+        now = time.monotonic()
+        for r in reqs:
+            if r.deadline is not None and now > r.deadline:
+                self._expire(r)
+            elif not r.future.set_running_or_notify_cancel():
+                # caller cancelled while queued: drop before burning
+                # device time; the future is already CANCELLED
+                events.incr("serve.cancelled")
+                self._q.task_done()
+            else:
+                live.append(r)          # RUNNING: cancel() is now inert
+        if not live:
+            return
+        total = sum(r.n for r in live)
+        bucket = self._bucket_for(total)
+        dev_i = self._rr % len(self._ctxs)
+        self._rr += 1
+        if self._pools is None:
+            self._run_and_fan(live, total, bucket, dev_i)
+            return
+        # replica overlap: hand the batch to device dev_i's worker so
+        # the dispatcher can coalesce the NEXT bucket while this one
+        # executes; the semaphore bounds in-flight batches at the
+        # replica count (the queue cap alone can't — pool backlogs
+        # would be the unbounded memory the bounded queue exists to
+        # prevent)
+        self._inflight.acquire()
+        with self._lock:
+            self._n_inflight += 1
+        try:
+            self._pools[dev_i].submit(self._run_and_fan, live, total,
+                                      bucket, dev_i)
+        except RuntimeError:            # pool shut down by a racing
+            self._inflight.release()    # close(): these futures are in
+            with self._lock:            # neither queue nor carry, so
+                self._n_inflight -= 1   # the flush can't see them —
+            for r in live:              # resolve here, never strand
+                self._finish(r, exc=EngineClosed(
+                    "engine closed before dispatch"))
+
+    def _run_and_fan(self, live, total, bucket, dev_i):
+        """Pad→execute→fan-out for one coalesced batch — inline on a
+        single-device engine, on the device's worker thread with
+        replicas.  EVERY exit resolves every live future (the
+        drain/close contract rides on task_done accounting)."""
+        from ..parallel.resilience import retry_transient
+        t0 = time.monotonic()
+        for r in live:
+            events.observe_time("serve.queue_us", t0 - r.t_enq)
+        try:
+            try:
+                batch = live[0].data if len(live) == 1 else \
+                    _np.concatenate([r.data for r in live], axis=0)
+                if bucket > total:
+                    pad = _np.zeros(
+                        (bucket - total,) + batch.shape[1:],
+                        batch.dtype)
+                    batch = _np.concatenate([batch, pad], axis=0)
+                out = retry_transient(
+                    lambda: self._run(dev_i, batch),
+                    what="serve.infer(bucket=%d)" % bucket,
+                    event="serve.retries")
+            except Exception as e:      # noqa: BLE001 — fan the failure
+                events.incr("serve.failed")
+                for r in live:          # out to every caller's future
+                    self._finish(r, exc=e)
+                return
+            events.observe_time("serve.infer_us",
+                                time.monotonic() - t0)
+            events.incr("serve.batches")
+            events.incr("serve.batch_fill", total)
+            events.incr("serve.pad_waste", bucket - total)
+            events.incr("serve.requests", len(live))
+            with self._lock:
+                self._n_batches += 1
+                self._dev_batches[dev_i] += 1
+            try:
+                self._fan_out(live, out, dev_i)
+            except Exception as e:      # noqa: BLE001 — e.g. an output
+                # leaf without a leading batch dim: the infer succeeded
+                # but slicing failed; the futures must still resolve
+                events.incr("serve.failed")
+                for r in live:
+                    if not r.future.done():
+                        self._finish(r, exc=e)
+        finally:
+            if self._pools is not None:
+                self._inflight.release()
+                with self._lock:
+                    self._n_inflight -= 1
+
+    def _materialize_params(self, batch_np):
+        """Resolve deferred parameter shapes from a concrete batch
+        (model_zoo nets defer channel dims until a first forward),
+        then replicate.  Mirrors HybridBlock.__call__'s pre-pass:
+        abstract infer_shape first, one paused eager forward as the
+        fallback for forwards eval_shape can't abstract."""
+        from ..ndarray.ndarray import NDArray
+        import jax
+        blk = self._block
+        x = NDArray(jax.device_put(batch_np[:1],
+                                   self._ctxs[0].jax_device),
+                    ctx=self._ctxs[0])
+        tr = getattr(blk, "_apply_input_transform", None)
+        pre = tr((x,)) if tr is not None else (x,)
+        try:
+            blk.infer_shape(*pre)
+            for p in blk.collect_params().values():
+                if p._deferred_init:
+                    p._finish_deferred_init()
+        except Exception:
+            from .. import autograd as _ag
+            from ..gluon.block import Block
+            with _ag.pause():
+                Block.__call__(blk, *pre)
+        self.refresh_params()
+
+    def _run(self, dev_i, batch_np):
+        import jax
+        fault.maybe_raise("serve.infer", step=self._n_batches)
+        if self._warm and self._dev_params is not None:
+            # warmed steady state: every (device, bucket) executable
+            # exists and the signature is locked, so replica workers
+            # execute lock-free (jit cache hits are thread-safe) —
+            # this is what lets device k+1 overlap device k
+            x = jax.device_put(batch_np,
+                               self._ctxs[dev_i].jax_device)
+            out = self._infer(self._dev_params[dev_i], x)
+            jax.block_until_ready(out)
+            return out
+        with self._exec_lock:           # traces/materialization
+            if self._dev_params is None:
+                self._materialize_params(batch_np)
+            x = jax.device_put(batch_np, self._ctxs[dev_i].jax_device)
+            out = self._infer(self._dev_params[dev_i], x)
+            jax.block_until_ready(out)
+        return out
+
+    def _fan_out(self, reqs, out, dev_i):
+        import jax
+        from ..ndarray.ndarray import NDArray
+        ctx = self._ctxs[dev_i]
+        off = 0
+        for r in reqs:
+            lo, hi, single = off, off + r.n, r.single
+            res = jax.tree_util.tree_map(
+                lambda a: NDArray(a[lo] if single else a[lo:hi],
+                                  ctx=ctx), out)
+            off = hi
+            self._finish(r, result=res)
+            events.observe_time("serve.e2e_us",
+                                time.monotonic() - r.t_enq)
+
+    # -- warmup --------------------------------------------------------
+    def warmup(self, example_shape=None, wire_dtype=None):
+        """Pre-compile (or AOT-deserialize) EVERY (device, bucket)
+        executable before traffic, so no organic request ever pays a
+        compile.  Needs the example signature — from the constructor,
+        a prior request, or the arguments here.  Returns a summary
+        dict; after it, `serve.traces` stays flat under any mix of
+        request sizes ≤ the largest bucket."""
+        if self._example_shape is None and example_shape is None:
+            raise ValueError(
+                "warmup() before any request needs example_shape= "
+                "(and wire_dtype=) — the executable signature")
+        # route through the SAME signature gate as submits: a warmup
+        # conflicting with an already-locked shape/dtype must raise,
+        # not silently re-point the executable set away from traffic
+        self._check_example(
+            tuple(example_shape) if example_shape is not None
+            else self._example_shape,
+            wire_dtype or self._wire_dtype or "float32")
+        dtype = _np.dtype(self._wire_dtype)
+        t0 = time.monotonic()
+        per_bucket = {}
+        for i in range(len(self._ctxs)):
+            for b in self._buckets:
+                x = _np.zeros((b,) + self._example_shape, dtype)
+                tb = time.monotonic()
+                self._run(i, x)
+                per_bucket[b] = round(time.monotonic() - tb, 4)
+        self._warm = True
+        events.incr("serve.warmups")
+        return {"buckets": list(self._buckets),
+                "devices": len(self._ctxs),
+                "wall_s": round(time.monotonic() - t0, 3),
+                "bucket_wall_s": per_bucket,
+                "traces": events.get("serve.traces")}
+
+    # -- lifecycle -----------------------------------------------------
+    def drain(self, timeout=30.0):
+        """Stop intake (submits raise EngineClosed) and wait until every
+        already-accepted request is resolved.  Returns True when the
+        queue fully drained within `timeout`."""
+        self._draining = True
+        deadline = time.monotonic() + float(timeout)
+        with self._q.all_tasks_done:
+            while self._q.unfinished_tasks:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    return False
+                if (self._thread is None or
+                        not self._thread.is_alive()) and \
+                        not self._n_inflight:
+                    break               # nothing will drain it
+                self._q.all_tasks_done.wait(min(rem, 0.1))
+        return self._q.unfinished_tasks == 0
+
+    def close(self, timeout=30.0):
+        """drain() + retire the dispatcher (joined within `timeout`) +
+        resolve any still-outstanding future (EngineClosed) so no
+        caller blocks forever.  Idempotent.  Returns True when the
+        dispatcher thread is fully joined."""
+        t_end = time.monotonic() + float(timeout)
+        self.drain(timeout)
+        self._stop = True
+        t = self._thread
+        joined = True
+        if t is not None and t.is_alive():
+            t.join(max(0.1, t_end - time.monotonic()))
+            joined = not t.is_alive()
+        if self._pools is not None:     # in-flight replica batches
+            for p in self._pools:       # complete (and resolve) first
+                p.shutdown(wait=True)
+        # anything the dispatcher never got to (drain timeout, dead
+        # dispatcher, a submit that raced the shutdown): resolve, don't
+        # strand.  _closed flips and the queue flushes under the SAME
+        # lock _submit enqueues under, so every accepted request is
+        # either flushed here or was visible to the dispatcher; the
+        # carry handoff is locked against a still-alive dispatcher for
+        # the same exactly-once reason.
+        leftovers = []
+        with self._lock:
+            self._closed = True
+            if self._carry is not None:
+                leftovers.append(self._carry)
+                self._carry = None
+            while True:
+                try:
+                    leftovers.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+        for r in leftovers:
+            self._finish(r, exc=EngineClosed(
+                "engine closed before dispatch"))
+        self.uninstall_sigterm()
+        return joined
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        # flags only — never join a thread from a finalizer; the
+        # daemon dispatcher retires at its next poll (replica pool
+        # workers exit when their executors are collected with us)
+        self._draining = True
+        self._stop = True
+        self._closed = True
+        try:                            # best-effort handler restore
+            self.uninstall_sigterm()    # (no-op unless installed; may
+        except Exception:               # fail off the main thread —
+            pass                        # the handler then chains prev)
+
+    # -- introspection -------------------------------------------------
+    def stats(self):
+        """Engine + process-wide `serve.*` counter snapshot, including
+        latency percentiles (p50/p90/p99) for the observed series."""
+        return {"counters": serve_counters(),
+                "latency": events.latency_snapshot("serve."),
+                "buckets": list(self._buckets),
+                "devices": [repr(c) for c in self._ctxs],
+                "device_batches": list(self._dev_batches),
+                "queue_depth": self._q.qsize(),
+                "warm": self._warm}

@@ -42,6 +42,12 @@ def pytest_configure(config):
         "run in tier-1 by default)")
     config.addinivalue_line(
         "markers", "slow: long-running tests excluded from tier-1")
+    # the serving suite is CPU-fast and runs in tier-1 by default; the
+    # marker lets the inference-engine tests be selected or excluded
+    # explicitly (pytest -m serve / -m 'not serve')
+    config.addinivalue_line(
+        "markers", "serve: inference-serving engine tests (CPU-fast, "
+        "run in tier-1 by default)")
 
 
 @pytest.fixture(autouse=True)

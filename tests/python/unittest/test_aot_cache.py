@@ -110,3 +110,74 @@ def test_disabled_without_cache_dir():
         assert not isinstance(j, _AotJitted)   # plain jax.jit passthrough
     finally:
         _cfg.set("MXNET_AOT_CACHE_DIR", prev or "")
+
+
+def _blobs(d):
+    import os as _os
+    return {f for f in _os.listdir(d) if f.endswith(".pjrtx")}
+
+
+def test_cache_eviction_keeps_newest_by_mtime(cache_dir):
+    """MXNET_AOT_CACHE_MAX bounds the on-disk cache: after each store,
+    oldest-mtime entries beyond K are evicted — keep-K LRU, so a
+    long-lived serving host's cache dir cannot grow without limit."""
+    from incubator_mxnet_tpu import config as _cfg
+    from incubator_mxnet_tpu.aot_cache import aot_jit
+
+    _cfg.set("MXNET_AOT_CACHE_MAX", "2")
+    try:
+        j = aot_jit(lambda a: a * 2.0)
+        now = os.path.getmtime(cache_dir)
+        j(jnp.ones((2,)))                       # blob A
+        (a,) = _blobs(cache_dir)
+        os.utime(os.path.join(cache_dir, a), (now - 100, now - 100))
+        j(jnp.ones((3,)))                       # blob B
+        (b,) = _blobs(cache_dir) - {a}
+        os.utime(os.path.join(cache_dir, b), (now - 50, now - 50))
+        j(jnp.ones((4,)))                       # blob C → trim to 2
+        left = _blobs(cache_dir)
+        assert len(left) == 2
+        assert a not in left, "oldest-mtime entry must be evicted first"
+        assert b in left
+    finally:
+        _cfg.unset("MXNET_AOT_CACHE_MAX")
+
+
+def test_cache_hit_refreshes_eviction_order(cache_dir):
+    """A deserialize HIT refreshes the entry's mtime, so
+    recently-SERVED executables survive eviction (LRU, not FIFO)."""
+    from incubator_mxnet_tpu import config as _cfg
+    from incubator_mxnet_tpu.aot_cache import aot_jit
+
+    _cfg.set("MXNET_AOT_CACHE_MAX", "2")
+    try:
+        j = aot_jit(lambda a: a * 3.0)
+        now = os.path.getmtime(cache_dir)
+        j(jnp.ones((2,)))                       # blob A
+        (a,) = _blobs(cache_dir)
+        os.utime(os.path.join(cache_dir, a), (now - 100, now - 100))
+        j(jnp.ones((3,)))                       # blob B
+        (b,) = _blobs(cache_dir) - {a}
+        os.utime(os.path.join(cache_dir, b), (now - 50, now - 50))
+        # fresh wrapper HITS blob A → its mtime refreshes past B's
+        j2 = aot_jit(lambda a: a * 3.0)
+        np.testing.assert_allclose(np.asarray(j2(jnp.ones((2,)))),
+                                   np.full((2,), 3.0))
+        assert os.path.getmtime(os.path.join(cache_dir, a)) > \
+            os.path.getmtime(os.path.join(cache_dir, b))
+        j(jnp.ones((4,)))                       # blob C → trim evicts B
+        left = _blobs(cache_dir)
+        assert len(left) == 2
+        assert a in left and b not in left
+    finally:
+        _cfg.unset("MXNET_AOT_CACHE_MAX")
+
+
+def test_cache_unbounded_by_default(cache_dir):
+    from incubator_mxnet_tpu.aot_cache import aot_jit, trim_cache
+
+    j = aot_jit(lambda a: a - 1.0)
+    for n in (2, 3, 4):
+        j(jnp.ones((n,)))
+    assert len(_blobs(cache_dir)) == 3          # MXNET_AOT_CACHE_MAX=0
+    assert trim_cache() == 0

@@ -1,0 +1,101 @@
+"""BatchNorm variance stability (MXNET_BN_STABLE_VAR — ISSUE 3
+satellite, ADVICE.md round 5): the fused one-pass E[x²]−E[x]² moments
+cancel catastrophically in f32 when |mean| ≫ std (unnormalized inputs),
+while the config-gated shifted two-pass path stays exact.  The fused
+form remains the default (one read of x — the HBM-bound bf16 training
+path's requirement)."""
+import numpy as onp
+import pytest
+
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon, autograd as ag
+from incubator_mxnet_tpu import config as cfg
+
+
+@pytest.fixture
+def stable_var():
+    cfg.set("MXNET_BN_STABLE_VAR", "1")
+    yield
+    cfg.unset("MXNET_BN_STABLE_VAR")
+
+
+def _shifted_input(n=256, c=4, shift=1e4, std=0.1, seed=0):
+    rs = onp.random.RandomState(seed)
+    return (shift + std * rs.randn(n, c)).astype(onp.float32)
+
+
+def test_one_pass_cancels_two_pass_exact(stable_var):
+    from incubator_mxnet_tpu.ops.nn import _bn_stats
+    x = _shifted_input()
+    true_var = x.astype(onp.float64).var(axis=0)
+    # stable (two-pass) path: accurate despite the 1e4 shift
+    _, v_stable = _bn_stats(jnp.asarray(x), 1)
+    rel_stable = float(onp.max(
+        onp.abs(onp.asarray(v_stable) - true_var) / true_var))
+    assert rel_stable < 0.01, rel_stable
+    # default one-pass path: E[x²] ~ 1e8, f32 ulp ~ 8 — the subtracted
+    # variance (~1e-2) is pure rounding noise
+    cfg.unset("MXNET_BN_STABLE_VAR")
+    _, v_fused = _bn_stats(jnp.asarray(x), 1)
+    rel_fused = float(onp.max(
+        onp.abs(onp.asarray(v_fused) - true_var) / true_var))
+    assert rel_fused > 10 * rel_stable, (rel_fused, rel_stable)
+
+
+def test_bn_layer_training_forward_stable(stable_var):
+    """End to end through the gluon layer: an f32 net on unnormalized
+    inputs normalizes correctly under the stable path (the default
+    path's collapsed variance rescales the output by ~rsqrt(eps))."""
+    mx.random.seed(0)
+    eps = 1e-5
+    layer = gluon.nn.BatchNorm(epsilon=eps)
+    layer.initialize(ctx=mx.cpu())
+    x = _shifted_input(seed=1)
+    with ag.record():                   # training mode → batch stats
+        y = layer(nd.array(x, ctx=mx.cpu()))
+    x64 = x.astype(onp.float64)
+    expect = (x64 - x64.mean(axis=0)) / onp.sqrt(x64.var(axis=0) + eps)
+    onp.testing.assert_allclose(y.asnumpy(), expect, rtol=5e-2,
+                                atol=5e-2)
+
+
+def test_default_stays_one_pass():
+    """The knob defaults OFF: normalized activations keep the fused
+    single-read moments (and its numerics stay fine there)."""
+    assert cfg.get("MXNET_BN_STABLE_VAR") is False
+    from incubator_mxnet_tpu.ops.nn import _bn_stats
+    rs = onp.random.RandomState(2)
+    x = rs.randn(128, 8).astype(onp.float32)    # mean ~ 0: benign
+    m, v = _bn_stats(jnp.asarray(x), 1)
+    onp.testing.assert_allclose(onp.asarray(v),
+                                x.astype(onp.float64).var(axis=0),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_sync_bn_stats_stable(stable_var):
+    """The shard_map SyncBatchNorm moments honor the same knob (global
+    mean subtracted before squaring, deviations pmean'd)."""
+    import jax
+    from functools import partial
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    from incubator_mxnet_tpu.ops.nn import _bn_sync_stats
+
+    devs = jax.devices()[:2]
+    mesh = Mesh(onp.asarray(devs), ("d",))
+    x = _shifted_input(n=64, c=4, seed=3)
+
+    @partial(shard_map, mesh=mesh, in_specs=P("d"),
+             out_specs=(P(), P()))
+    def stats(xs):
+        m, v = _bn_sync_stats(xs, 1, "d")
+        return m, v
+
+    m, v = stats(jnp.asarray(x))
+    x64 = x.astype(onp.float64)
+    onp.testing.assert_allclose(onp.asarray(v), x64.var(axis=0),
+                                rtol=0.01)
+    onp.testing.assert_allclose(onp.asarray(m), x64.mean(axis=0),
+                                rtol=1e-6)

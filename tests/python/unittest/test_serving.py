@@ -1,0 +1,436 @@
+"""Inference serving engine tests (serving.engine — ISSUE 3 tentpole):
+bucket padding numerics (incl. the uint8 wire path), zero-recompile
+after warmup, deadline expiry mid-queue, queue-full backpressure,
+drain/close lifecycle, fault injection, replica round-robin, and the
+EventCounters percentile helper.  CPU-only, fast."""
+import signal
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon, fault
+from incubator_mxnet_tpu import config as cfg
+from incubator_mxnet_tpu.monitor import events
+from incubator_mxnet_tpu.serving import (InferenceEngine, QueueFull,
+                                         DeadlineExceeded, EngineClosed)
+
+pytestmark = pytest.mark.serve
+
+
+def _dense_net(units=4, in_units=8, seed=0):
+    mx.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu"))
+        net.add(gluon.nn.Dense(units))
+    net.initialize(ctx=mx.cpu())
+    net.hybridize()
+    # materialise deferred shapes so the engine can extract params
+    net(nd.array(onp.zeros((1, in_units), onp.float32), ctx=mx.cpu()))
+    return net
+
+
+def _data(n, in_units=8, seed=1):
+    return onp.random.RandomState(seed).rand(n, in_units).astype(
+        onp.float32)
+
+
+# ---------------------------------------------------------------------------
+# numerics: padded bucket execution == unpadded eager forward
+# ---------------------------------------------------------------------------
+
+def test_padding_numerics_match_eager():
+    net = _dense_net()
+    x = _data(7)
+    ref = net(nd.array(x, ctx=mx.cpu())).asnumpy()
+    eng = InferenceEngine(net, ctx=mx.cpu(), max_batch=8,
+                          max_wait_us=1000)
+    try:
+        # single submits (pad 1→bucket) and an odd batch (pad 3→4)
+        futs = [eng.submit(x[i]) for i in range(3)]
+        fb = eng.submit_batch(x[3:6])
+        f1 = eng.submit(x[6])
+        got = onp.stack([f.result(timeout=30).asnumpy() for f in futs])
+        onp.testing.assert_allclose(got, ref[:3], rtol=1e-5, atol=1e-6)
+        onp.testing.assert_allclose(fb.result(30).asnumpy(), ref[3:6],
+                                    rtol=1e-5, atol=1e-6)
+        onp.testing.assert_allclose(f1.result(30).asnumpy(), ref[6],
+                                    rtol=1e-5, atol=1e-6)
+    finally:
+        eng.close()
+
+
+def test_uint8_wire_padding_numerics():
+    """uint8 on the wire + set_input_transform traced into the bucket
+    executable (the PR 2 training-path contract) — padded engine
+    results must equal the eager uint8 forward exactly."""
+    from incubator_mxnet_tpu.io.device_feed import normalize_transform
+    mx.random.seed(3)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Conv2D(4, 3, padding=1, activation="relu"))
+        net.add(gluon.nn.Dense(3))
+    net.initialize(ctx=mx.cpu())
+    net.hybridize()
+    net.set_input_transform(normalize_transform(127.5, 64.0, "float32"))
+    xu = onp.random.RandomState(4).randint(
+        0, 256, (5, 3, 8, 8)).astype(onp.uint8)
+    ref = net(nd.array(xu, ctx=mx.cpu(), dtype="uint8")).asnumpy()
+    eng = net.inference_engine(ctx=mx.cpu(), max_batch=4,
+                               max_wait_us=1000)
+    try:
+        eng.warmup(example_shape=(3, 8, 8), wire_dtype="uint8")
+        futs = [eng.submit(xu[i]) for i in range(2)]
+        fb = eng.submit_batch(xu[2:5])
+        got = onp.stack([f.result(30).asnumpy() for f in futs])
+        onp.testing.assert_allclose(got, ref[:2], rtol=1e-5, atol=1e-6)
+        onp.testing.assert_allclose(fb.result(30).asnumpy(), ref[2:5],
+                                    rtol=1e-5, atol=1e-6)
+    finally:
+        eng.close()
+        net.set_input_transform(None)
+
+
+def test_zero_recompile_after_warmup():
+    """The executable set is CLOSED: after warmup() pre-compiles every
+    bucket, a mixed-size request stream adds ZERO traces (the
+    recompilation-cliff guarantee the subsystem exists for)."""
+    net = _dense_net(seed=5)
+    eng = InferenceEngine(net, ctx=mx.cpu(), max_batch=8,
+                          max_wait_us=500)
+    try:
+        info = eng.warmup(example_shape=(8,), wire_dtype="float32")
+        assert info["buckets"] == [1, 2, 4, 8]
+        t0 = events.get("serve.traces")
+        futs = []
+        for n in (1, 2, 3, 5, 8, 7, 1, 6, 4):   # every bucket, odd fills
+            futs.append(eng.submit_batch(_data(n, seed=n)))
+        for f in futs:
+            f.result(timeout=30)
+        assert events.get("serve.traces") == t0, \
+            "recompile after warmup under mixed request sizes"
+        # fill/waste accounting covers every submitted example
+        s = eng.stats()["counters"]
+        assert s["serve.batch_fill"] >= sum((1, 2, 3, 5, 8, 7, 1, 6, 4))
+        assert s["serve.pad_waste"] >= 0
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# robustness: deadlines, backpressure, lifecycle
+# ---------------------------------------------------------------------------
+
+def test_deadline_expiry_mid_queue():
+    net = _dense_net(seed=7)
+    # long coalesce window: the lone request sits in the dispatcher's
+    # fill-wait — its deadline must cut the wait short and resolve it
+    eng = InferenceEngine(net, ctx=mx.cpu(), max_batch=8,
+                          max_wait_us=2_000_000)
+    try:
+        eng.warmup(example_shape=(8,), wire_dtype="float32")
+        r0 = events.get("serve.rejected")
+        d0 = events.get("serve.deadline_expired")
+        t0 = time.monotonic()
+        fut = eng.submit(_data(1)[0], deadline=0.05)
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=10)
+        assert time.monotonic() - t0 < 1.5      # not the 2 s window
+        assert events.get("serve.rejected") == r0 + 1
+        assert events.get("serve.deadline_expired") == d0 + 1
+        # the engine still serves after an expiry
+        ok = eng.submit(_data(1, seed=2)[0])
+        assert ok.result(timeout=30) is not None
+    finally:
+        eng.close()
+
+
+def test_queue_full_rejection_and_retry():
+    """Hold the dispatcher busy via an injected slow+transient
+    serve.infer fault; the bounded queue must reject overflow with
+    QueueFull while the held requests complete via the retry path."""
+    net = _dense_net(seed=9)
+    eng = InferenceEngine(net, ctx=mx.cpu(), max_batch=1,
+                          queue_cap=2, max_wait_us=500)
+    try:
+        eng.warmup(example_shape=(8,), wire_dtype="float32")
+        # batch #0's first attempt stalls 0.3 s then raises
+        # TransientFault; the retry succeeds
+        fault.install("serve.infer", at_calls=[1], times=1, seconds=0.3)
+        r0 = events.get("serve.rejected")
+        x = _data(4)
+        f1 = eng.submit(x[0])           # dispatcher picks this up
+        time.sleep(0.05)                # let it enter the stalled call
+        f2 = eng.submit(x[1])           # fills the queue (cap 2)
+        f3 = eng.submit(x[2])
+        with pytest.raises(QueueFull):
+            eng.submit(x[3])            # over cap → backpressure
+        assert events.get("serve.rejected") == r0 + 1
+        for f in (f1, f2, f3):          # held work still completes
+            assert f.result(timeout=30) is not None
+        assert events.get("serve.retries") >= 1
+    finally:
+        fault.clear()
+        eng.close()
+
+
+def test_enqueue_fault_injects_rejection():
+    net = _dense_net(seed=11)
+    eng = InferenceEngine(net, ctx=mx.cpu(), max_batch=2)
+    try:
+        fault.install("serve.enqueue", at_calls=[1])
+        with pytest.raises(QueueFull):
+            eng.submit(_data(1)[0])
+        # one-shot: the next submit goes through
+        assert eng.submit(_data(1)[0]).result(30) is not None
+    finally:
+        fault.clear()
+        eng.close()
+
+
+def test_close_with_in_flight_futures():
+    """close() must complete queued work, join the dispatcher within
+    the timeout, and leave every outstanding future resolved."""
+    net = _dense_net(seed=13)
+    eng = InferenceEngine(net, ctx=mx.cpu(), max_batch=2,
+                          max_wait_us=200_000, queue_cap=64)
+    try:
+        eng.warmup(example_shape=(8,), wire_dtype="float32")
+        x = _data(10)
+        futs = [eng.submit(x[i]) for i in range(10)]
+        assert eng.close(timeout=30)    # drains + joins
+        t = eng._thread
+        assert t is None or not t.is_alive()
+        for f in futs:
+            assert f.done()
+            try:                        # result OR a defined rejection
+                f.result(timeout=0)
+            except (EngineClosed, DeadlineExceeded, QueueFull):
+                pass
+        with pytest.raises(EngineClosed):
+            eng.submit(x[0])
+    finally:
+        eng.close()
+
+
+def test_drain_completes_then_rejects():
+    net = _dense_net(seed=15)
+    eng = InferenceEngine(net, ctx=mx.cpu(), max_batch=4,
+                          max_wait_us=1000)
+    try:
+        x = _data(6)
+        futs = [eng.submit(x[i]) for i in range(6)]
+        assert eng.drain(timeout=30)
+        for f in futs:
+            assert f.done() and f.exception() is None
+        with pytest.raises(EngineClosed):
+            eng.submit(x[0])
+    finally:
+        eng.close()
+
+
+def test_sigterm_drains_and_stops_intake():
+    net = _dense_net(seed=17)
+    eng = InferenceEngine(net, ctx=mx.cpu(), max_batch=4,
+                          max_wait_us=1000, handle_sigterm=True)
+    try:
+        eng.warmup(example_shape=(8,), wire_dtype="float32")
+        x = _data(4)
+        futs = [eng.submit(x[i]) for i in range(4)]
+        signal.raise_signal(signal.SIGTERM)     # flag-only handler
+        deadline = time.monotonic() + 30
+        while not all(f.done() for f in futs) and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        for f in futs:                  # accepted work completed
+            assert f.done() and f.exception() is None
+        with pytest.raises(EngineClosed):
+            eng.submit(x[0])            # intake stopped by the signal
+        assert events.get("serve.preempted") >= 1
+    finally:
+        eng.close()                     # restores the prev handler
+    assert signal.getsignal(signal.SIGTERM) != eng._prev_sigterm or \
+        eng._prev_sigterm is None
+
+
+# ---------------------------------------------------------------------------
+# replicas / construction surfaces
+# ---------------------------------------------------------------------------
+
+def test_replica_round_robin_across_devices():
+    net = _dense_net(seed=19)
+    x = _data(2)
+    ref = net(nd.array(x, ctx=mx.cpu())).asnumpy()
+    eng = InferenceEngine(net, devices=[mx.cpu(0), mx.cpu(1)],
+                          max_batch=2, max_wait_us=100)
+    try:
+        eng.warmup(example_shape=(8,), wire_dtype="float32")
+        futs = [eng.submit_batch(x) for _ in range(6)]
+        outs = [f.result(timeout=30) for f in futs]
+        for o in outs:
+            onp.testing.assert_allclose(o.asnumpy(), ref, rtol=1e-5,
+                                        atol=1e-6)
+        # both replicas took traffic, and results carry their ctx
+        assert all(b > 0 for b in eng._dev_batches), eng._dev_batches
+        assert {o.context for o in outs} == {mx.cpu(0), mx.cpu(1)}
+    finally:
+        eng.close()
+
+
+def test_sharded_trainer_serve_handoff():
+    from incubator_mxnet_tpu import parallel
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    net(nd.array(onp.zeros((2, 8), onp.float32)))
+    trainer = parallel.ShardedTrainer(net, optimizer="sgd", lr=0.01)
+    eng = trainer.serve(max_batch=2, max_wait_us=100)
+    try:
+        assert len(eng._ctxs) == len(trainer.mesh.devices.flat)
+        x = _data(1, seed=21)
+        out = eng.submit(x[0]).result(timeout=30)
+        ref = net(nd.array(x)).asnumpy()[0]
+        onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5,
+                                    atol=1e-6)
+    finally:
+        eng.close()
+
+
+def test_submit_validations():
+    net = _dense_net(seed=23)
+    eng = InferenceEngine(net, ctx=mx.cpu(), max_batch=4,
+                          example_shape=(8,), wire_dtype="float32")
+    try:
+        with pytest.raises(ValueError):         # signature mismatch
+            eng.submit(onp.zeros((5,), onp.float32))
+        with pytest.raises(ValueError):         # beyond largest bucket
+            eng.submit_batch(onp.zeros((9, 8), onp.float32))
+        # wrong wire dtype: would trace a NEW executable (breaks the
+        # closed-set / zero-recompile contract) — rejected at submit
+        with pytest.raises(ValueError):
+            eng.submit(onp.zeros((8,), onp.float64))
+        with pytest.raises(ValueError):
+            eng.submit_batch(onp.zeros((2, 8), onp.uint8))
+        # warmup without a signature fails loudly on a fresh engine
+        eng2 = InferenceEngine(net, ctx=mx.cpu(), max_batch=2)
+        with pytest.raises(ValueError):
+            eng2.warmup()
+        eng2.close()
+        # warmup conflicting with the locked wire dtype must raise,
+        # not silently re-point the executable set away from traffic
+        with pytest.raises(ValueError):
+            eng.warmup(example_shape=(8,), wire_dtype="uint8")
+        assert eng._wire_dtype == "float32"
+    finally:
+        eng.close()
+
+
+def test_abandoned_engine_dispatcher_retires():
+    """An engine dropped WITHOUT close() must be collectable: the
+    dispatcher holds it only via weakref between polls, so GC fires
+    __del__ (stop flags) and the thread exits instead of pinning the
+    engine + its device parameter replicas forever."""
+    import gc
+    net = _dense_net(seed=29)
+    eng = InferenceEngine(net, ctx=mx.cpu(), max_batch=2,
+                          max_wait_us=100)
+    assert eng.submit(_data(1)[0]).result(timeout=30) is not None
+    t = eng._thread
+    assert t.is_alive()
+    del eng
+    gc.collect()
+    deadline = time.monotonic() + 10
+    while t.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.05)
+        gc.collect()
+    assert not t.is_alive(), "abandoned dispatcher never retired"
+
+
+def test_cancelled_future_does_not_kill_dispatcher():
+    """A caller cancelling its queued future must neither crash the
+    dispatcher nor strand the other requests of the batch."""
+    net = _dense_net(seed=27)
+    eng = InferenceEngine(net, ctx=mx.cpu(), max_batch=4,
+                          max_wait_us=100_000)
+    try:
+        eng.warmup(example_shape=(8,), wire_dtype="float32")
+        # hold the dispatcher on a stalled batch so the next submits
+        # stay cancellable in the queue
+        fault.install("serve.infer", at_calls=[1], times=1, seconds=0.3)
+        x = _data(4)
+        f0 = eng.submit(x[0])
+        time.sleep(0.05)                # dispatcher inside the stall
+        f1 = eng.submit(x[1])
+        f2 = eng.submit(x[2])
+        assert f1.cancel()              # still queued → cancellable
+        assert f0.result(timeout=30) is not None
+        assert f2.result(timeout=30) is not None   # batchmate survives
+        assert f1.cancelled()
+        # dispatcher alive and serving after the cancellation
+        assert eng.submit(x[3]).result(timeout=30) is not None
+        t = eng._thread
+        assert t is not None and t.is_alive()
+    finally:
+        fault.clear()
+        eng.close()
+
+
+def test_fanout_error_resolves_futures():
+    """An output leaf without a leading batch dim makes result slicing
+    fail AFTER a successful infer — the futures must still resolve
+    (with the error) and the queue must drain clean."""
+    class ScalarNet(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return x.sum()      # scalar: no batch dim to slice
+
+    net = ScalarNet()
+    net.initialize(ctx=mx.cpu())
+    eng = InferenceEngine(net, ctx=mx.cpu(), max_batch=2,
+                          max_wait_us=100)
+    try:
+        fut = eng.submit(onp.ones((4,), onp.float32))
+        with pytest.raises(Exception):
+            fut.result(timeout=30)
+        assert eng.drain(timeout=10)    # task_done accounting intact
+    finally:
+        eng.close()
+
+
+def test_bucket_spec_from_config():
+    net = _dense_net(seed=25)
+    cfg.set("MXNET_SERVE_BUCKETS", "2,4,6")
+    try:
+        eng = InferenceEngine(net, ctx=mx.cpu())
+        assert list(eng._buckets) == [2, 4, 6]
+        eng.close()
+    finally:
+        cfg.unset("MXNET_SERVE_BUCKETS")
+    # the keyword accepts a python sequence, not just the env string
+    eng = InferenceEngine(net, ctx=mx.cpu(), buckets=[4, 1, 2])
+    assert list(eng._buckets) == [1, 2, 4]
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# observability: the percentile snapshot helper
+# ---------------------------------------------------------------------------
+
+def test_event_percentiles_helper():
+    from incubator_mxnet_tpu.monitor import EventCounters
+    ec = EventCounters()
+    for v in range(1, 101):             # 1..100 µs
+        ec.observe("lat_us", v)
+    p = ec.percentiles("lat_us", (50, 90, 99))
+    assert p["n"] == 100
+    assert p["p50"] == 50 and p["p90"] == 90 and p["p99"] == 99
+    # observe bumps the companion .n counter; totals via observe_time
+    assert ec.get("lat_us.n") == 100
+    ec.observe_time("wall_us", 0.002)
+    assert ec.get("wall_us") == 2000
+    snap = ec.latency_snapshot("lat_")
+    assert set(snap) == {"lat_us"} and snap["lat_us"]["p50"] == 50
+    assert ec.percentiles("nothing") == {}
+    ec.reset()
+    assert ec.percentiles("lat_us") == {}
