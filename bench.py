@@ -656,6 +656,11 @@ def run_serve(n_images=512, max_batch=32, seed=0, extra=None):
         "serve_traces_after_warmup_delta":
             events.get("serve.traces") - traces0,
     }
+    # counter/percentile snapshot block (ISSUE 4): bench runs double as
+    # telemetry fixtures — teletop --file renders this, and the
+    # BENCH_serve.json trajectory keeps the tails next to the rates
+    from incubator_mxnet_tpu import telemetry
+    out["telemetry"] = telemetry.snapshot_dict()
     if extra is not None:
         extra.update(out)
     return out
@@ -957,6 +962,14 @@ def _cfg_resnet():
     extra = {}
     imgs, batch = _try_batches(run_cachedop, (128, 64, 32), extra=extra)
     extra.update({"value": round(imgs, 2), "batch": batch})
+    # feed./train./aot. counter+tail snapshot of this config's process
+    # (ISSUE 4): the e2e feed counters above are deltas, this is the
+    # whole-ledger block teletop --file renders
+    try:
+        from incubator_mxnet_tpu import telemetry
+        extra["telemetry"] = telemetry.snapshot_dict()
+    except Exception:
+        pass
     return extra
 
 

@@ -58,6 +58,7 @@ from . import callback
 from . import monitor
 from .monitor import Monitor
 from . import fault
+from . import telemetry
 from . import serving
 from . import numpy as np              # mx.np — NumPy-semantics front-end
 from . import numpy_extension as npx   # mx.npx — NN extensions + set_np
@@ -68,4 +69,5 @@ __all__ = ["MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",
            "gluon", "optimizer", "Optimizer", "metric", "initializer",
            "kvstore", "kv", "io", "image", "profiler", "runtime",
            "test_utils", "symbol", "sym", "Symbol", "module", "mod",
-           "parallel", "fault", "monitor", "np", "npx", "__version__"]
+           "parallel", "fault", "monitor", "telemetry", "np", "npx",
+           "__version__"]

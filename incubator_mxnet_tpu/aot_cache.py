@@ -32,8 +32,14 @@ import os
 import jax
 
 from . import config as _cfg
+from .monitor import events
+from .telemetry import spans as _tele
 
 __all__ = ["aot_jit", "cache_dir", "trim_cache"]
+
+_EXEC_DEVICES_KW = None     # lazy: does this jax's deserialize_and_load
+                            # accept execution_devices=? (one signature
+                            # reflection per process, not per load)
 
 
 def cache_dir():
@@ -137,15 +143,40 @@ class _AotJitted:
                     pass
         return jax.devices()[0]
 
-    def _get_compiled(self, args):
+    @staticmethod
+    def _deserialize(blob, in_tree, out_tree, dev):
+        """deserialize_and_load, pinned to the argument device where
+        this jax supports it.  Older jax (≤0.4.x) has no
+        `execution_devices` kwarg — before the aot.hit/aot.stale
+        counters existed, the unconditional kwarg made EVERY load
+        raise TypeError and silently recompile as 'stale': the hit
+        path never engaged on those builds.  Feature-detect instead
+        (the loader's own device assignment is honored there)."""
+        global _EXEC_DEVICES_KW
         from jax.experimental.serialize_executable import (
-            serialize, deserialize_and_load)
+            deserialize_and_load)
+        if _EXEC_DEVICES_KW is None:
+            import inspect
+            _EXEC_DEVICES_KW = "execution_devices" in \
+                inspect.signature(deserialize_and_load).parameters
+        if _EXEC_DEVICES_KW:
+            # pin to the ARGUMENT device — the loader's default binds
+            # the blob to EVERY visible device, which fails shard
+            # checks under a virtual multi-device mesh
+            return deserialize_and_load(blob, in_tree, out_tree,
+                                        execution_devices=[dev])
+        return deserialize_and_load(blob, in_tree, out_tree)
+
+    def _get_compiled(self, args):
+        from jax.experimental.serialize_executable import serialize
         import jax.tree_util as tu
         import time as _t
         dbg = os.environ.get("MXNET_AOT_CACHE_DEBUG")
         t0 = _t.perf_counter()
-        lowered = self._jit.lower(*args)
+        with _tele.span("aot.lower"):
+            lowered = self._jit.lower(*args)
         t1 = _t.perf_counter()
+        events.observe_time("aot.lower_us", t1 - t0)
         dev = self._args_device(args)
         # the execution device is part of the key: a blob loaded onto a
         # different device than it was compiled for fails at CALL time,
@@ -156,21 +187,21 @@ class _AotJitted:
         t2 = _t.perf_counter()
         if os.path.exists(path):
             try:
-                with open(path, "rb") as f:
-                    blob = f.read()
-                in_tree = tu.tree_structure((tuple(args), {}))
-                out_tree = tu.tree_structure(lowered.out_info)
-                # single-device programs only (plain jit): pin to the
-                # ARGUMENT device — the loader's default binds the
-                # blob to EVERY visible device, which fails shard
-                # checks under a virtual multi-device mesh
-                out = deserialize_and_load(
-                    blob, in_tree, out_tree,
-                    execution_devices=[dev])
+                with _tele.span("aot.load"):
+                    with open(path, "rb") as f:
+                        blob = f.read()
+                    in_tree = tu.tree_structure((tuple(args), {}))
+                    out_tree = tu.tree_structure(lowered.out_info)
+                    # single-device programs only (plain jit)
+                    out = self._deserialize(blob, in_tree, out_tree,
+                                            dev)
                 try:            # LRU: a hit refreshes eviction order
                     os.utime(path)
                 except OSError:
                     pass
+                events.incr("aot.hit")
+                events.observe_time("aot.load_us",
+                                    _t.perf_counter() - t2)
                 if dbg:
                     print("[aot] HIT lower=%.1fs key=%.1fs load=%.1fs"
                           % (t1 - t0, t2 - t1, _t.perf_counter() - t2))
@@ -178,12 +209,17 @@ class _AotJitted:
             except Exception:
                 # corrupt/stale blob: fall through to compile and
                 # overwrite the entry
+                events.incr("aot.stale")
                 if dbg:
                     print("[aot] STALE %s" % os.path.basename(path))
-        compiled = lowered.compile()
+        t3 = _t.perf_counter()      # fresh stamp: a failed stale-blob
+        with _tele.span("aot.compile"):  # load above must not inflate
+            compiled = lowered.compile()  # the compile-cost tail
+        events.incr("aot.miss")
+        events.observe_time("aot.compile_us", _t.perf_counter() - t3)
         if dbg:
             print("[aot] MISS lower=%.1fs key=%.1fs compile=%.1fs"
-                  % (t1 - t0, t2 - t1, _t.perf_counter() - t2))
+                  % (t1 - t0, t2 - t1, _t.perf_counter() - t3))
         try:
             blob, _, _ = serialize(compiled)
             tmp = path + ".tmp.%d" % os.getpid()

@@ -268,6 +268,23 @@ register("MXNET_BN_STABLE_VAR", bool, False,
          "one-pass E[x^2]-E[x]^2 (single read of x; the bf16 default "
          "where activations are normalized and HBM reads are the step "
          "time)")
+register("MXNET_TELEMETRY", bool, False,
+         "Telemetry instrumentation (telemetry/): spans on the "
+         "profiler timeline + per-step train.* counters.  Off = every "
+         "hook is a single bool read (near-zero hot-path overhead); "
+         "the monitor.events counters the subsystems always report "
+         "are unaffected")
+register("MXNET_TELEMETRY_PORT", int, 0,
+         "MetricsExporter HTTP endpoint port (/metrics Prometheus "
+         "text, /metrics.json, /healthz); 0 = no endpoint.  Used by "
+         "telemetry.start() / MetricsExporter.serve_http()")
+register("MXNET_TELEMETRY_EXPORT_PATH", str, "",
+         "MetricsExporter periodic-file path: counters + percentiles "
+         "written atomically every MXNET_TELEMETRY_EXPORT_S seconds "
+         "('.prom'/'.txt' = Prometheus text, else JSON — the teletop "
+         "snapshot format). Empty = no file export")
+register("MXNET_TELEMETRY_EXPORT_S", float, 15.0,
+         "Seconds between periodic telemetry file exports")
 register("MXNET_INT64_TENSOR_SIZE", bool, False,
          "Large-tensor support: enable 64-bit index arithmetic so "
          "arrays past 2**31 elements index correctly (ref: the "

@@ -49,6 +49,7 @@ import numpy as _np
 
 from .. import config as _cfg
 from ..monitor import events
+from ..telemetry import spans as _tele
 
 __all__ = ["DeviceFeed", "feed_counters", "make_normalizer",
            "normalize_transform"]
@@ -157,6 +158,7 @@ class DeviceFeed:
         self._q = None              # retires the worker at its next put
         self._thread = None
         self._epoch_it = None       # current epoch's source iterator
+        self._tele_parent = None    # consumer-side span ctx (at _start)
         self._exhausted = False
         self._started = False
         self._last_t = None
@@ -232,13 +234,19 @@ class DeviceFeed:
             feed = ref()
             if feed is None or feed._gen != gen:
                 return
+            # spans parent onto the CONSUMER's trace (captured at
+            # _start): the worker thread's read/transfer intervals
+            # join the training timeline they feed
+            parent = feed._tele_parent
             t0 = time.perf_counter()
             try:
-                batch = next(feed._epoch_it)
-                if feed._transform is not None:
-                    batch = feed._transform(batch)
+                with _tele.span("feed.read", parent=parent):
+                    batch = next(feed._epoch_it)
+                    if feed._transform is not None:
+                        batch = feed._transform(batch)
                 t1 = time.perf_counter()
-                placed, nbytes = feed._place(batch)
+                with _tele.span("feed.transfer", parent=parent):
+                    placed, nbytes = feed._place(batch)
             except StopIteration:
                 del feed
                 DeviceFeed._safe_put(ref, q, gen, _EOE)
@@ -277,6 +285,9 @@ class DeviceFeed:
         self._exhausted = False
         self._started = True
         self._last_t = None
+        # cross-thread span parent: the consumer's innermost open span
+        # at feed start (None when telemetry is off / no span is open)
+        self._tele_parent = _tele.current()
         self._epoch_it = self._epoch_iter()
         events.incr("feed.epochs")      # epochs STARTED (first included)
         if self._async:

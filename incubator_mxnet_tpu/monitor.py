@@ -14,6 +14,7 @@ from __future__ import annotations
 import logging
 import re
 import threading
+from collections import deque
 
 __all__ = ["Monitor", "EventCounters", "events"]
 
@@ -66,7 +67,6 @@ class EventCounters:
         `_us`) into a bounded per-name ring buffer; `incr`s the
         companion counter `<name>.n` so sample flow is visible in plain
         snapshots too."""
-        from collections import deque
         with self._lock:
             dq = self._samples.get(name)
             if dq is None:
@@ -121,10 +121,19 @@ class EventCounters:
             self._samples.clear()
 
     def log_nonzero(self, logger=None) -> None:
+        """Log every nonzero counter, then p50/p90/p99 for every
+        observed sample series — a plain log dump shows the tails, not
+        just the totals (serving SLOs are tail-defined)."""
         logger = logger or logging.getLogger(__name__)
         for name, v in sorted(self.snapshot().items()):
             if v:
                 logger.info("event %-36s %d", name, v)
+        for name, p in sorted(self.latency_snapshot().items()):
+            if p:
+                logger.info(
+                    "event %-36s p50=%g p90=%g p99=%g n=%d",
+                    name, p.get("p50", 0), p.get("p90", 0),
+                    p.get("p99", 0), p.get("n", 0))
 
 
 #: process-wide event counters (the resilience layer's shared ledger)

@@ -49,6 +49,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import fault
 from ..monitor import events
+from ..telemetry import spans as _tele
+from ..telemetry.stepstats import StepTelemetry
 from ..contrib.amp.loss_scaler import LossScaler
 
 __all__ = ["ResilientTrainer", "retry_transient"]
@@ -148,7 +150,9 @@ class ResilientTrainer:
         self.loss_ema = None               # running mean of good losses
         self.scaler = loss_scaler or LossScaler(init_scale=1.0)
         self.bad_steps = 0                 # consecutive skipped steps
-        self._gstep = None
+        self._tele = None                  # StepTelemetry, lazy on
+        self._gstep = None                 # telemetry.enabled()
+        self._trace_count = 0              # this wrapper's gstep traces
         self._preempted = False
         self._prev_sigterm = None
         if self.ckpt_dir:
@@ -200,6 +204,13 @@ class ResilientTrainer:
 
         def gstep(params, opt_state, batch, labels, rng_bits,
                   poison, spike_thresh, loss_scale):
+            # trace-time side effect only (the serve.traces pattern):
+            # the counter meters guarded-step recompiles, a jit-cache
+            # hit never runs this python body; the per-wrapper count
+            # keeps multi-trainer attribution straight
+            events.incr("train.traces")
+            self._trace_count += 1
+
             def lf(p):
                 out, states = fwd(p, batch, rng_bits=rng_bits)
                 return loss_fn(out, labels) * loss_scale, states
@@ -261,6 +272,13 @@ class ResilientTrainer:
             # injected preemption goes through the REAL signal path
             signal.raise_signal(signal.SIGTERM)
 
+        tele = self._tele
+        if tele is None and _tele.enabled():
+            # baseline on this wrapper's trace count (mid-run enable
+            # must not flag the next step as compiling)
+            tele = self._tele = StepTelemetry(
+                own_traces=self._trace_count)
+
         poison = 1.0
         if fault.should_fire("grad_nan", stepno):
             poison = float("nan")
@@ -270,24 +288,40 @@ class ResilientTrainer:
         if self.spike_factor > 0 and self.loss_ema is not None:
             spike_thresh = self.spike_factor * self.loss_ema
 
-        batch_g = t._place_batch(batch, t._batch_sharding)
-        labels_g = t._place_batch(
-            labels, NamedSharding(t.mesh, P(t.batch_axis)))
+        step_span = _tele.span("train.step")
+        step_span.start()
+        t0 = time.perf_counter()
+        try:
+            batch_g = t._place_batch(batch, t._batch_sharding)
+            labels_g = t._place_batch(
+                labels, NamedSharding(t.mesh, P(t.batch_axis)))
+            t1 = time.perf_counter()
 
-        def dispatch():
-            # transient collective failures surface at dispatch time
-            fault.maybe_raise("collective", stepno)
-            return self._gstep(t.params, t.opt_state, batch_g, labels_g,
-                               self._rng_bits(stepno), poison,
-                               spike_thresh, self.scaler.loss_scale)
-        new_params, new_opt, loss, ok = retry_transient(
-            dispatch, what="train step %d" % stepno,
-            retryable=(fault.TransientFault,))
-        t.params, t.opt_state = new_params, new_opt
-        t._n_step = stepno + 1
+            def dispatch():
+                # transient collective failures surface at dispatch time
+                fault.maybe_raise("collective", stepno)
+                return self._gstep(t.params, t.opt_state, batch_g,
+                                   labels_g, self._rng_bits(stepno),
+                                   poison, spike_thresh,
+                                   self.scaler.loss_scale)
+            new_params, new_opt, loss, ok = retry_transient(
+                dispatch, what="train step %d" % stepno,
+                retryable=(fault.TransientFault,))
+            t.params, t.opt_state = new_params, new_opt
+            t._n_step = stepno + 1
 
-        ok = bool(ok)
-        loss = float(loss)
+            # the guarded step is host-synchronous by design (the guard
+            # decisions are host control flow), so compute wall is
+            # observable here: dispatch → loss/ok materialized
+            ok = bool(ok)
+            loss = float(loss)
+        finally:
+            step_span.stop()
+        t2 = time.perf_counter()
+        if tele is not None:
+            tele.record_step(loss=loss, ok=ok, wall_s=t2 - t0,
+                             data_wait_s=t1 - t0, compute_s=t2 - t1,
+                             traces=self._trace_count)
         self.scaler.update(overflow=not ok)
         if ok:
             self.bad_steps = 0
@@ -368,10 +402,17 @@ class ResilientTrainer:
                 json.dump(meta, f)
             os.replace(tmp, final)
 
-        retry_transient(write, what="checkpoint step %d" % step)
-        self._publish_latest(self._ckpt_name(step))
+        t_ck = time.perf_counter()
+        with _tele.span("train.checkpoint"):
+            retry_transient(write, what="checkpoint step %d" % step)
+            self._publish_latest(self._ckpt_name(step))
         self._have_ckpt = True
         events.incr("resilience.checkpoint_written")
+        if _tele.enabled():
+            if self._tele is None:
+                self._tele = StepTelemetry(
+                    own_traces=self._trace_count)
+            self._tele.record_checkpoint(time.perf_counter() - t_ck)
         self._gc()
         return final
 

@@ -12,6 +12,7 @@ automatically.  Buffer donation makes updates in-place in HBM.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable, Dict, Optional
 
 import jax
@@ -20,6 +21,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .functional import functionalize, extract_params, load_params
 from .mesh import make_mesh
+from ..monitor import events
+from ..telemetry import spans as _tele
+from ..telemetry.stepstats import StepTelemetry
 
 __all__ = ["ShardedTrainer", "softmax_ce_loss", "sgd_momentum_tree",
            "adam_tree"]
@@ -158,6 +162,8 @@ class ShardedTrainer:
         self._batch_sharding = NamedSharding(self.mesh, P(batch_axis))
         self._step = None
         self._n_step = 0
+        self._tele = None           # StepTelemetry, lazy on enabled()
+        self._trace_count = 0       # this trainer's executable traces
 
     def _place_value(self, value, sharding):
         """Host value → global array on `sharding`.  Multi-controller:
@@ -215,6 +221,12 @@ class ShardedTrainer:
             if self.zero else (lambda tree, **_: tree)
 
         def step(params, opt_state, batch, labels, rng_bits):
+            # trace-time side effect only (the serve.traces pattern):
+            # meters train-step recompiles; cache hits never run this.
+            # The per-trainer count keeps steps_compiling attribution
+            # correct when several trainers share the process ledger
+            events.incr("train.traces")
+            self._trace_count += 1
             if preprocess is not None:
                 # on-device normalize/cast fused into this executable
                 # (uint8 stays the wire format — device_feed contract)
@@ -269,14 +281,33 @@ class ShardedTrainer:
         from .. import random as _rnd
         if self._step is None:
             self._step = self._build_step()
+        # telemetry: one bool read when disabled; enabled, the step
+        # records data-wait (placement) vs dispatch wall.  The loss
+        # deliberately stays on device (async dispatch), so compute
+        # wall is NOT observed here — ResilientTrainer's guarded step,
+        # which syncs anyway, records it
+        tele = self._tele
+        if tele is None and _tele.enabled():
+            # baseline on THIS trainer's trace count: enabling
+            # telemetry mid-run must not count old compiles as a
+            # compiling first step
+            tele = self._tele = StepTelemetry(
+                own_traces=self._trace_count)
+        t0 = time.perf_counter() if tele is not None else 0.0
         batch = self._place_batch(batch, self._batch_sharding)
         labels = self._place_batch(
             labels, NamedSharding(self.mesh, P(self.batch_axis)))
         if rng_bits is None:
             rng_bits = jax.random.key_data(_rnd.split_key())
+        t1 = time.perf_counter() if tele is not None else 0.0
         self.params, self.opt_state, loss = self._step(
             self.params, self.opt_state, batch, labels, rng_bits)
         self._n_step += 1
+        if tele is not None:
+            t2 = time.perf_counter()
+            tele.record_step(wall_s=t2 - t0, data_wait_s=t1 - t0,
+                             dispatch_s=t2 - t1,
+                             traces=self._trace_count)
         return loss
 
     def device_feed(self, source, depth=None, transform=None):

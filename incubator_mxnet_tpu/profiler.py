@@ -19,30 +19,53 @@ from . import engine
 
 __all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
            "Task", "Frame", "Marker", "scope", "start_jax_trace",
-           "stop_jax_trace"]
+           "stop_jax_trace", "add_trace_event"]
 
 _CONFIG = {"filename": "profile.json", "profile_all": False,
            "profile_imperative": True, "aggregate_stats": True}
-_STATE = {"running": False, "paused": False}
+# `registered` tracks whether OUR dispatch listener is installed — the
+# run/stop transitions key on it so stop-before-run and double-stop are
+# idempotent no-ops instead of unregistering a listener never added
+_STATE = {"running": False, "paused": False, "registered": False}
 _EVENTS = []
 _LOCK = threading.Lock()
 _T0 = time.perf_counter()
+
+
+def _append_event(name, cat, t0_s, dur_s, args=None, ph="X"):
+    """Build one chrome-trace event (shared ts/tid conventions) and
+    append it to the sink unconditionally."""
+    ev = {"name": name, "cat": cat, "ph": ph,
+          "ts": (t0_s - _T0) * 1e6, "dur": dur_s * 1e6,
+          "pid": os.getpid(),
+          "tid": threading.get_ident() % 100000}
+    if args:
+        ev["args"] = dict(args)
+    with _LOCK:
+        _EVENTS.append(ev)
+
+
+def add_trace_event(name, cat, t0_s, dur_s, args=None, ph="X"):
+    """Append one complete event to the shared chrome-trace sink.
+    `t0_s` is a `time.perf_counter()` stamp (converted to this
+    module's trace origin), `dur_s` seconds.  Telemetry spans use this
+    so framework-thread intervals (feed transfers, serving dispatch,
+    checkpoint writes) land on the SAME timeline `dump()` renders for
+    the op-dispatch events.  Dropped while the profiler is stopped —
+    the sink is unbounded, and a span that merely STARTED while it was
+    collecting (a long checkpoint straddling set_state('stop')) must
+    not grow it afterwards."""
+    if not _STATE["running"] or _STATE["paused"]:
+        return
+    _append_event(name, cat, t0_s, dur_s, args=args, ph=ph)
 
 
 def _listener(name, ctx, elapsed):
     if not _STATE["running"] or _STATE["paused"]:
         return
     now = time.perf_counter()
-    with _LOCK:
-        _EVENTS.append({
-            "name": name, "cat": "operator",
-            "ph": "X",
-            "ts": (now - elapsed - _T0) * 1e6,
-            "dur": elapsed * 1e6,
-            "pid": os.getpid(),
-            "tid": threading.get_ident() % 100000,
-            "args": {"ctx": repr(ctx)},
-        })
+    _append_event(name, "operator", now - elapsed, elapsed,
+                  args={"ctx": repr(ctx)})
 
 
 def set_config(**kwargs):
@@ -50,14 +73,21 @@ def set_config(**kwargs):
 
 
 def set_state(state="stop", profile_process="worker"):
+    """'run' installs the dispatch listener (once) and starts
+    collecting; anything else stops.  Idempotent in both directions:
+    stop-before-run and double-stop only unregister a listener that
+    was actually added, run-while-running never double-registers."""
     if state == "run":
-        if not _STATE["running"]:
+        if not _STATE["registered"]:
             engine.add_dispatch_listener(_listener)
+            _STATE["registered"] = True
         _STATE["running"] = True
         _STATE["paused"] = False
     else:
         _STATE["running"] = False
-        engine.remove_dispatch_listener(_listener)
+        if _STATE["registered"]:
+            engine.remove_dispatch_listener(_listener)
+            _STATE["registered"] = False
 
 
 def pause(profile_process="worker"):
@@ -113,15 +143,8 @@ class _Scope:
     def stop(self):
         if self._t is None:
             return
-        now = time.perf_counter()
-        with _LOCK:
-            _EVENTS.append({
-                "name": self.name, "cat": self.cat, "ph": "X",
-                "ts": (self._t - _T0) * 1e6,
-                "dur": (now - self._t) * 1e6,
-                "pid": os.getpid(),
-                "tid": threading.get_ident() % 100000,
-            })
+        _append_event(self.name, self.cat, self._t,
+                      time.perf_counter() - self._t)
         self._t = None
 
     def __enter__(self):

@@ -73,6 +73,7 @@ from .. import fault
 from ..base import MXNetError
 from ..context import Context, current_context
 from ..monitor import events
+from ..telemetry import spans as _tele
 
 __all__ = ["InferenceEngine", "QueueFull", "DeadlineExceeded",
            "EngineClosed", "serve_counters"]
@@ -97,7 +98,8 @@ def serve_counters():
 
 
 class _Request:
-    __slots__ = ("data", "n", "future", "t_enq", "deadline", "single")
+    __slots__ = ("data", "n", "future", "t_enq", "deadline", "single",
+                 "tele")
 
     def __init__(self, data, n, future, deadline, single):
         self.data = data
@@ -107,6 +109,11 @@ class _Request:
         self.deadline = None if deadline is None \
             else self.t_enq + float(deadline)
         self.single = single
+        # the submitter's span context (telemetry): the dispatcher's
+        # serve.dispatch/serve.infer spans parent onto it, so a
+        # request's submit→dispatch→infer chain shares one trace id
+        # across the three threads it crosses
+        self.tele = _tele.current()
 
 
 def _parse_buckets(spec, max_batch):
@@ -566,7 +573,13 @@ class InferenceEngine:
         t0 = time.monotonic()
         for r in live:
             events.observe_time("serve.queue_us", t0 - r.t_enq)
+        # the dispatch span parents onto the first request's submit-side
+        # context, so the cross-thread submit→dispatch→infer chain
+        # shares one trace; nested serve.infer inherits automatically
+        dispatch_span = _tele.span("serve.dispatch",
+                                   parent=live[0].tele)
         try:
+            dispatch_span.start()
             try:
                 batch = live[0].data if len(live) == 1 else \
                     _np.concatenate([r.data for r in live], axis=0)
@@ -575,10 +588,11 @@ class InferenceEngine:
                         (bucket - total,) + batch.shape[1:],
                         batch.dtype)
                     batch = _np.concatenate([batch, pad], axis=0)
-                out = retry_transient(
-                    lambda: self._run(dev_i, batch),
-                    what="serve.infer(bucket=%d)" % bucket,
-                    event="serve.retries")
+                with _tele.span("serve.infer"):
+                    out = retry_transient(
+                        lambda: self._run(dev_i, batch),
+                        what="serve.infer(bucket=%d)" % bucket,
+                        event="serve.retries")
             except Exception as e:      # noqa: BLE001 — fan the failure
                 events.incr("serve.failed")
                 for r in live:          # out to every caller's future
@@ -603,6 +617,7 @@ class InferenceEngine:
                     if not r.future.done():
                         self._finish(r, exc=e)
         finally:
+            dispatch_span.stop()
             if self._pools is not None:
                 self._inflight.release()
                 with self._lock:

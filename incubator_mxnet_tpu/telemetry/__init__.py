@@ -1,0 +1,85 @@
+"""Unified telemetry backbone (ISSUE 4): spans, metrics export, and
+per-step training telemetry over the `monitor.events` ledger.
+
+Three layers, one ledger:
+
+- `telemetry.span(name, parent=ctx)` — thread-safe spans with explicit
+  cross-thread parent propagation, emitted into the profiler's
+  chrome-trace sink (spans.py).
+- `telemetry.MetricsExporter` — `monitor.events` counters + latency
+  percentiles rendered as Prometheus text / JSON, with periodic file
+  export and an optional `/metrics` + `/healthz` HTTP thread
+  (export.py).
+- `telemetry.StepTelemetry` — per-step `train.*` counters/samples,
+  wired into `ResilientTrainer` / `ShardedTrainer` (stepstats.py).
+
+Switch: `MXNET_TELEMETRY=1` or `telemetry.enable()`.  Disabled, every
+hot-path hook is a single bool read.  `telemetry.start()` boots the
+process-wide exporter off the MXNET_TELEMETRY_* knobs;
+`python -m incubator_mxnet_tpu.tools.teletop` renders a live or
+file-snapshot table.  See docs/observability.md.
+"""
+from __future__ import annotations
+
+from .spans import (SpanContext, current, enable, enabled, recording,
+                    span)
+from .export import MetricsExporter
+from .stepstats import StepTelemetry
+
+__all__ = ["SpanContext", "span", "current", "enable", "enabled",
+           "recording", "MetricsExporter", "StepTelemetry", "start",
+           "stop", "get_exporter", "snapshot_dict"]
+
+#: counter families the condensed snapshot (bench.py JSON) carries
+SNAPSHOT_PREFIXES = ("serve.", "feed.", "train.", "aot.",
+                     "resilience.")
+
+_exporter = None
+
+
+def start(port=None, path=None, period_s=None) -> MetricsExporter:
+    """Boot (or return) the process-wide exporter: HTTP endpoint when
+    `port`/MXNET_TELEMETRY_PORT is nonzero, periodic file export when
+    `path`/MXNET_TELEMETRY_EXPORT_PATH is set.  Also flips
+    `telemetry.enable()` on — starting an export surface means the
+    operator wants the instrumentation feeding it."""
+    from .. import config as _cfg
+    global _exporter
+    enable()
+    if _exporter is None:
+        _exporter = MetricsExporter()
+    if port is not None:
+        # explicit port starts the endpoint (0 = ephemeral bind)
+        _exporter.serve_http(port)
+    elif int(_cfg.get("MXNET_TELEMETRY_PORT")):
+        # knob semantics: 0 means "no endpoint"
+        _exporter.serve_http()
+    if path or _cfg.get("MXNET_TELEMETRY_EXPORT_PATH"):
+        _exporter.start(path=path, period_s=period_s)
+    return _exporter
+
+
+def get_exporter():
+    """The process-wide exporter (None until `start()`)."""
+    return _exporter
+
+
+def stop():
+    """Flag-drain the process-wide exporter (idempotent)."""
+    global _exporter
+    exp, _exporter = _exporter, None
+    if exp is not None:
+        exp.close()
+
+
+def snapshot_dict(prefixes=SNAPSHOT_PREFIXES, pcts=(50, 99)) -> dict:
+    """Condensed counter + percentile snapshot of the telemetry
+    families, sized for embedding in a one-line JSON record (bench.py's
+    BENCH_r*/BENCH_serve schema)."""
+    from ..monitor import events
+    keep = lambda k: any(k.startswith(p) for p in prefixes)
+    return {"counters": {k: v for k, v in events.snapshot().items()
+                         if keep(k)},
+            "percentiles": {k: v for k, v in
+                            events.latency_snapshot(pcts=pcts).items()
+                            if keep(k)}}

@@ -1,0 +1,299 @@
+"""Metrics export surface (ISSUE 4 tentpole part 2).
+
+`monitor.events` already holds every survival/feed/serving counter and
+latency sample ring in the process — but only in memory.
+`MetricsExporter` renders that ledger two ways:
+
+- **Prometheus text format** (`prometheus_text()` / `GET /metrics`):
+  every counter as a `counter` metric, every observed sample series
+  (the `observe()`/`observe_time()` names, conventionally `*_us`) as a
+  `summary` with p50/p90/p99 quantiles, `_sum` (the companion
+  monotonic counter, when one exists) and `_count`.
+- **JSON** (`json_dict()` / `GET /metrics.json` / the periodic file):
+  `{"ts": ..., "counters": {...}, "percentiles": {...}}` — the
+  round-trippable snapshot `tools/teletop.py` and bench.py embed.
+
+Serving modes:
+
+- `export_file(path)` — one atomic snapshot (`.prom`/`.txt` → text
+  format, anything else → JSON).
+- `start(path, period_s)` — background periodic file export.  The
+  worker holds the exporter only through a weakref (the DeviceFeed
+  pattern): an abandoned exporter is GC'd and its thread retires.
+- `serve_http(port)` — stdlib `ThreadingHTTPServer` thread answering
+  `/metrics`, `/metrics.json` and `/healthz` (port 0 picks a free one;
+  default `MXNET_TELEMETRY_PORT`).  `close()` is flag-drain like the
+  serving engine: intake flips to draining (healthz reports it, new
+  scrapes get 503), the server shuts down, threads join.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+import weakref
+
+from .. import config as _cfg
+from ..monitor import events
+
+__all__ = ["MetricsExporter"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _metric_name(prefix, name):
+    return _NAME_RE.sub("_", prefix + name)
+
+
+def _fmt(v):
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+class MetricsExporter:
+    """Render an `EventCounters` ledger (default: the process-wide
+    `monitor.events`) as Prometheus text / JSON, with optional periodic
+    file export and an HTTP endpoint thread."""
+
+    def __init__(self, counters=None, prefix="mxnet_",
+                 pcts=(50, 90, 99)):
+        self._c = counters if counters is not None else events
+        self._prefix = prefix
+        self._pcts = tuple(pcts)
+        self._t0 = time.time()
+        self._stop = threading.Event()
+        self._draining = False
+        self._thread = None
+        self._path = None
+        self._httpd = None
+        self._http_thread = None
+        self.http_port = None
+
+    # -- rendering -----------------------------------------------------
+    def _snapshot(self):
+        return self._c.snapshot(), self._c.latency_snapshot(
+            pcts=self._pcts)
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition text (version 0.0.4): counters +
+        quantile summaries for every observed sample series."""
+        counts, lats = self._snapshot()
+        # an empty percentile dict (a reset() racing this scrape
+        # between the snapshot's name collection and the per-name
+        # percentiles) renders as a plain counter path, never KeyError
+        sampled = {n for n, p in lats.items() if p}
+        # sampled series render as summaries; their companion counters
+        # (the same name = total µs, '<name>.n' = total observations)
+        # fold into _sum/_count instead of repeating as bare counters
+        folded = sampled | {n + ".n" for n in sampled}
+        lines = []
+        for name in sorted(set(counts) | sampled):
+            if name in sampled:
+                m = _metric_name(self._prefix, name)
+                p = lats[name]
+                lines.append("# TYPE %s summary" % m)
+                for pct in self._pcts:
+                    lines.append('%s{quantile="%s"} %s'
+                                 % (m, _fmt(pct / 100.0),
+                                    _fmt(p["p%g" % pct])))
+                if name in counts:      # observe_time keeps the total
+                    lines.append("%s_sum %s" % (m, _fmt(counts[name])))
+                lines.append("%s_count %s"
+                             % (m, _fmt(counts.get(name + ".n",
+                                                   p["n"]))))
+            elif name not in folded:
+                m = _metric_name(self._prefix, name)
+                lines.append("# TYPE %s counter" % m)
+                lines.append("%s %s" % (m, _fmt(counts[name])))
+        return "\n".join(lines) + "\n"
+
+    def json_dict(self) -> dict:
+        counts, lats = self._snapshot()
+        return {"ts": time.time(),
+                "uptime_s": round(time.time() - self._t0, 3),
+                "counters": counts,
+                "percentiles": lats}
+
+    def json_text(self) -> str:
+        return json.dumps(self.json_dict(), sort_keys=True)
+
+    # -- file export ---------------------------------------------------
+    def export_file(self, path=None) -> str:
+        """Write one snapshot atomically (tmp + os.replace).  `.prom` /
+        `.txt` suffix → Prometheus text, anything else → JSON.
+        Default path: MXNET_TELEMETRY_EXPORT_PATH."""
+        path = path or self._path or _cfg.get("MXNET_TELEMETRY_EXPORT_PATH")
+        if not path:
+            raise ValueError("no export path (argument, start(), or "
+                             "MXNET_TELEMETRY_EXPORT_PATH)")
+        body = self.prometheus_text() \
+            if path.endswith((".prom", ".txt")) else self.json_text()
+        # pid+tid: the periodic worker and a manual/close-time export
+        # in the same process must not interleave on one temp file
+        tmp = "%s.tmp.%d.%d" % (path, os.getpid(),
+                                threading.get_ident())
+        with open(tmp, "w") as f:
+            f.write(body)
+        os.replace(tmp, path)
+        return path
+
+    @staticmethod
+    def _export_loop(ref, stop, period):
+        while not stop.wait(period):
+            exp = ref()
+            if exp is None:
+                return
+            try:
+                exp.export_file()
+            except Exception:           # noqa: BLE001 — periodic export
+                pass                    # is best-effort, never fatal
+            del exp
+
+    def start(self, path=None, period_s=None):
+        """Begin periodic file export every `period_s` seconds (default
+        MXNET_TELEMETRY_EXPORT_S) to `path` (default
+        MXNET_TELEMETRY_EXPORT_PATH).  Returns self (chainable)."""
+        self._path = path or _cfg.get("MXNET_TELEMETRY_EXPORT_PATH")
+        if not self._path:
+            raise ValueError("periodic export needs a path (argument "
+                             "or MXNET_TELEMETRY_EXPORT_PATH)")
+        if period_s is None:
+            period_s = float(_cfg.get("MXNET_TELEMETRY_EXPORT_S"))
+        # (re)configure: retire any live worker (its Event flips, it
+        # exits without a straggler export) and hand the NEW worker a
+        # fresh Event with the new period — a second start() must
+        # honor new args, and a start() after close() must not inherit
+        # the already-set stop Event (the thread would exit on its
+        # first wait without ever exporting)
+        if (self._thread is not None and self._thread.is_alive()) \
+                or self._stop.is_set():
+            self._stop.set()
+            self._stop = threading.Event()
+            self._draining = False
+        self._thread = threading.Thread(
+            target=MetricsExporter._export_loop,
+            args=(weakref.ref(self), self._stop, float(period_s)),
+            daemon=True, name="TelemetryExport")
+        self._thread.start()
+        return self
+
+    # -- HTTP endpoint -------------------------------------------------
+    def serve_http(self, port=None, host="127.0.0.1") -> int:
+        """Start the `/metrics` + `/healthz` endpoint thread.  `port`
+        defaults to MXNET_TELEMETRY_PORT; 0 binds an ephemeral port.
+        Binds loopback by default — counters and loss samples are
+        process internals; exposing them fleet-wide is an explicit
+        `host="0.0.0.0"` opt-in.  Returns the bound port (also on
+        `self.http_port`)."""
+        from http.server import BaseHTTPRequestHandler, \
+            ThreadingHTTPServer
+        if self._httpd is not None:
+            if port is not None and int(port) not in (0, self.http_port):
+                raise ValueError(
+                    "metrics endpoint already bound on port %d; "
+                    "close() it before rebinding to %d"
+                    % (self.http_port, int(port)))
+            return self.http_port
+        if port is None:
+            port = int(_cfg.get("MXNET_TELEMETRY_PORT"))
+        ref = weakref.ref(self)         # the handler must not pin the
+                                        # exporter (GC liveness — the
+                                        # DeviceFeed/engine contract)
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802 — stdlib name
+                pass                    # scrapes must not spam stderr
+
+            def _send(self, code, ctype, body):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):           # noqa: N802 — stdlib name
+                exp = ref()
+                if exp is None or exp._draining:
+                    self._send(503, "application/json",
+                               '{"status": "draining"}')
+                    return
+                path = self.path.split("?")[0].rstrip("/") or "/"
+                if path == "/metrics":
+                    self._send(200,
+                               "text/plain; version=0.0.4",
+                               exp.prometheus_text())
+                elif path in ("/metrics.json", "/json"):
+                    self._send(200, "application/json",
+                               exp.json_text())
+                elif path == "/healthz":
+                    self._send(200, "application/json", json.dumps(
+                        {"status": "ok",
+                         "uptime_s": round(time.time() - exp._t0, 3),
+                         "counters": len(exp._c.snapshot())}))
+                else:
+                    self._send(404, "application/json",
+                               '{"error": "not found"}')
+
+        # binding a fresh endpoint un-drains (symmetric with start():
+        # a serve_http() after close() must serve, not 503 forever)
+        self._draining = False
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self.http_port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            daemon=True, name="TelemetryHTTP")
+        self._http_thread.start()
+        return self.http_port
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self, timeout=5.0):
+        """Flag-drain shutdown: scrapes start getting 503, the export
+        thread retires (after one final file snapshot when a path is
+        configured), the HTTP server joins.  Idempotent."""
+        self._draining = True
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        self._thread = None
+        if self._path:
+            try:
+                self.export_file()      # final state on disk
+            except Exception:           # noqa: BLE001
+                pass
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            try:
+                httpd.shutdown()
+                httpd.server_close()
+            except Exception:           # noqa: BLE001
+                pass
+        ht = self._http_thread
+        if ht is not None and ht.is_alive():
+            ht.join(timeout)
+        self._http_thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        # flags only — never join threads from a finalizer; the daemon
+        # workers see the stop flag / dead weakref and retire
+        self._draining = True
+        self._stop.set()
+        httpd = self._httpd
+        if httpd is not None:
+            try:
+                httpd.shutdown()
+            except Exception:           # noqa: BLE001
+                pass

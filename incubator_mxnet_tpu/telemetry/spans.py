@@ -1,0 +1,188 @@
+"""Cross-thread spans on the profiler's chrome-trace timeline (ISSUE 4
+tentpole part 1).
+
+The op-dispatch profiler (profiler.py) sees imperative dispatches; the
+async layers — DeviceFeed's transfer worker, the serving dispatcher and
+its replica workers, checkpoint writes — are invisible to it because
+their work happens on framework threads, between dispatches.  A span
+names one such interval:
+
+    with telemetry.span("serve.dispatch"):
+        ...
+
+Spans carry a trace id (one per causal chain) and a span id, with
+EXPLICIT cross-thread parent propagation — thread-locals cannot follow
+a request from the submitting thread onto the dispatcher:
+
+    ctx = telemetry.current()           # producer thread
+    ...
+    with telemetry.span("feed.transfer", parent=ctx):   # worker thread
+        ...
+
+Completed spans are appended to the SAME chrome-trace sink profiler.py
+dumps (`profiler.add_trace_event`), so `profiler.dump()` renders feed
+transfers, dispatch→infer chains and checkpoint writes on one timeline
+with the op events; trace/span/parent ids ride in each event's `args`.
+
+Cost model: recording requires BOTH `telemetry.enable()` (or
+`MXNET_TELEMETRY=1`) AND a collecting profiler (`set_state("run")`,
+not paused) — the sink is unbounded, so spans must not grow it on
+runs nobody is tracing.  When either switch is off, `span()` returns a
+shared no-op context manager: one bool read and two dict reads on the
+hot path, no allocation.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from .. import config as _cfg
+from .. import profiler as _prof
+
+__all__ = ["SpanContext", "enabled", "enable", "span", "current",
+           "recording"]
+
+_ids = itertools.count(1)       # CPython-atomic next(); no lock needed
+_tls = threading.local()
+
+# None = follow the MXNET_TELEMETRY knob live (config.set / env work
+# like every other registered knob); enable() installs an explicit
+# process-local override
+_enabled = None
+
+
+def enabled() -> bool:
+    """Whether telemetry instrumentation (spans + per-step training
+    counters) is switched on for this process."""
+    if _enabled is not None:
+        return _enabled
+    return bool(_cfg.get("MXNET_TELEMETRY"))
+
+
+def enable(flag=True):
+    """Flip telemetry instrumentation on/off (None = revert to the
+    MXNET_TELEMETRY knob); returns the previous effective state (so
+    tests can restore it)."""
+    global _enabled
+    prev = enabled()
+    _enabled = None if flag is None else bool(flag)
+    return prev
+
+
+def recording() -> bool:
+    """Whether a span opened now would actually be recorded: telemetry
+    enabled AND the profiler collecting (the shared sink's gate)."""
+    return (enabled() and _prof._STATE["running"]
+            and not _prof._STATE["paused"])
+
+
+class SpanContext:
+    """Immutable (trace_id, span_id) handle for cross-thread parenting.
+    Hand it to a worker thread and open child spans with
+    ``span(name, parent=ctx)``."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __repr__(self):
+        return "SpanContext(trace=%s, span=%s)" % (self.trace_id,
+                                                   self.span_id)
+
+
+def _stack():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current():
+    """The innermost open span's context on THIS thread (None outside
+    any span, or when telemetry is disabled).  Capture it before
+    handing work to another thread — that thread's spans pass it as
+    `parent=` to join the same trace."""
+    if not enabled():
+        return None
+    st = getattr(_tls, "stack", None)
+    return st[-1] if st else None
+
+
+class _NullSpan:
+    """Shared no-op for the disabled path — `with` works, nothing is
+    recorded, nothing is allocated per call."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+    def start(self):
+        return self
+
+    def stop(self):
+        pass
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "ctx", "parent_id", "_t0")
+
+    def __init__(self, name, parent):
+        if parent is None:
+            parent = current()
+        if parent is not None:
+            trace = parent.trace_id
+            self.parent_id = parent.span_id
+        else:
+            trace = "t%08x" % next(_ids)
+            self.parent_id = None
+        self.ctx = SpanContext(trace, "s%08x" % next(_ids))
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def start(self):
+        self._t0 = time.perf_counter()
+        _stack().append(self.ctx)
+        return self
+
+    def stop(self):
+        if self._t0 is None:
+            return
+        t0, self._t0 = self._t0, None
+        st = _stack()
+        if st and st[-1] is self.ctx:
+            st.pop()
+        elif self.ctx in st:        # mispaired stop(): drop ours only
+            st.remove(self.ctx)
+        args = {"trace_id": self.ctx.trace_id,
+                "span_id": self.ctx.span_id}
+        if self.parent_id is not None:
+            args["parent_id"] = self.parent_id
+        _prof.add_trace_event(self.name, "span", t0,
+                              time.perf_counter() - t0, args=args)
+
+
+def span(name: str, parent: SpanContext = None):
+    """Open a span (use as a context manager, or `.start()`/`.stop()`).
+    `parent` joins an existing trace across threads; by default the
+    innermost open span on this thread is the parent.  Returns a shared
+    no-op when spans are not being recorded (see module docstring)."""
+    if not recording():
+        return _NULL
+    return _Span(name, parent)

@@ -1,0 +1,5 @@
+"""Operator-facing CLIs (``python -m incubator_mxnet_tpu.tools.<name>``).
+
+- ``teletop`` — live / file-snapshot table of the telemetry counters
+  and latency percentiles (the `top(1)` of `monitor.events`).
+"""

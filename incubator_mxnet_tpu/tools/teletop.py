@@ -1,0 +1,174 @@
+"""teletop — the `top(1)` of the telemetry ledger.
+
+Renders one table from a `MetricsExporter` snapshot: counters, latency
+percentiles (p50/p90/p99 per observed series), and the derived health
+ratios operators actually page on (serving batch fill vs pad waste,
+feed stall fraction, AOT hit rate, skipped-step rate).
+
+Sources (one of):
+
+    python -m incubator_mxnet_tpu.tools.teletop --url http://host:9100
+        scrape a live `telemetry.start()` endpoint (`/metrics.json`)
+    python -m incubator_mxnet_tpu.tools.teletop --file snap.json
+        a JSON snapshot written by `MetricsExporter.export_file()` /
+        the periodic exporter (MXNET_TELEMETRY_EXPORT_PATH)
+
+With neither, MXNET_TELEMETRY_PORT (when nonzero) implies
+`--url http://127.0.0.1:$MXNET_TELEMETRY_PORT`.  `--watch S` redraws
+every S seconds (live mode); `--prefix serve.` filters the table.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+__all__ = ["load_snapshot", "render", "main"]
+
+
+def load_snapshot(url=None, path=None) -> dict:
+    """One `{counters, percentiles, ...}` snapshot from an endpoint or
+    an exporter JSON file."""
+    if url:
+        import urllib.request
+        base = url.rstrip("/")
+        if not base.endswith((".json", "/json")):
+            base += "/metrics.json"
+        with urllib.request.urlopen(base, timeout=10) as r:
+            return json.loads(r.read().decode())
+    with open(path) as f:
+        snap = json.loads(f.read())
+    # bench fixtures: a BENCH_r*/BENCH_serve blob (or its parsed line)
+    # carries the snapshot as a nested "telemetry" block — unwrap it
+    if "counters" not in snap:
+        inner = snap.get("telemetry") or \
+            snap.get("parsed", {}).get("telemetry")
+        if isinstance(inner, dict):
+            snap = inner
+    return snap
+
+
+def _ratio(num, den):
+    return (100.0 * num / den) if den else None
+
+
+def _derived(c):
+    """The fill/waste/health ratios, from whatever families are
+    present (missing subsystems simply contribute no rows)."""
+    out = []
+    fill, waste = c.get("serve.batch_fill", 0), c.get("serve.pad_waste", 0)
+    r = _ratio(fill, fill + waste)
+    if r is not None:
+        out.append(("serve batch fill", "%.1f%% (pad waste %.1f%%)"
+                    % (r, 100 - r)))
+    stall, step = c.get("feed.stall_us", 0), c.get("feed.step_us", 0)
+    r = _ratio(stall, stall + step)
+    if r is not None:
+        out.append(("feed stall fraction",
+                    "%.1f%% of consumer wall" % r))
+    hit, miss = c.get("aot.hit", 0), c.get("aot.miss", 0)
+    r = _ratio(hit, hit + miss)
+    if r is not None:
+        out.append(("aot cache hit rate", "%.1f%% (%d hit / %d miss)"
+                    % (r, hit, miss)))
+    steps = c.get("train.steps", 0)
+    if steps:
+        out.append(("train steps skipped", "%d / %d (%.2f%%)"
+                    % (c.get("train.steps_skipped", 0), steps,
+                       _ratio(c.get("train.steps_skipped", 0), steps))))
+        dw, tot = c.get("train.data_wait_us", 0), c.get("train.step_us", 0)
+        r = _ratio(dw, tot)
+        if r is not None:
+            out.append(("train data-wait share", "%.1f%% of step wall" % r))
+    req, rej = c.get("serve.requests", 0), c.get("serve.rejected", 0)
+    if req or rej:
+        out.append(("serve rejected", "%d (%.2f%% of %d accepted+rej)"
+                    % (rej, _ratio(rej, req + rej) or 0.0, req + rej)))
+    return out
+
+
+def render(snap: dict, prefix: str = "") -> str:
+    """The snapshot as one fixed-width table block."""
+    counters = {k: v for k, v in snap.get("counters", {}).items()
+                if k.startswith(prefix)}
+    pcts = {k: v for k, v in snap.get("percentiles", {}).items()
+            if k.startswith(prefix)}
+    sampled_companions = {n + ".n" for n in pcts}
+    lines = []
+    ts = snap.get("ts")
+    head = "teletop — %d counters, %d sampled series" \
+        % (len(counters), len(pcts))
+    if ts:
+        head += " — " + time.strftime("%Y-%m-%d %H:%M:%S",
+                                      time.localtime(ts))
+    lines += [head, "=" * len(head), ""]
+
+    lines.append("%-36s %14s" % ("counter", "value"))
+    lines.append("-" * 51)
+    for name in sorted(counters):
+        if name in sampled_companions:
+            continue            # shown as n in the percentile table
+        lines.append("%-36s %14d" % (name, counters[name]))
+
+    if pcts:
+        lines += ["", "%-36s %8s %10s %10s %10s"
+                  % ("series", "n", "p50", "p90", "p99"),
+                  "-" * 78]
+        for name in sorted(pcts):
+            p = pcts[name]
+            fmt = lambda k: ("%10g" % p[k]) if k in p else "%10s" % "-"
+            lines.append("%-36s %8d %s %s %s"
+                         % (name, p.get("n", 0), fmt("p50"),
+                            fmt("p90"), fmt("p99")))
+
+    derived = _derived(snap.get("counters", {}))
+    if derived:
+        lines += ["", "derived", "-" * 7]
+        for k, v in derived:
+            lines.append("%-24s %s" % (k, v))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    from .. import config as _cfg
+    ap = argparse.ArgumentParser(
+        prog="teletop",
+        description="table view of the telemetry counters/percentiles")
+    ap.add_argument("--url", help="telemetry endpoint base URL "
+                    "(e.g. http://host:9100)")
+    ap.add_argument("--file", help="exporter JSON snapshot file")
+    ap.add_argument("--prefix", default="",
+                    help="only show names with this prefix "
+                    "(e.g. serve.)")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="S",
+                    help="redraw every S seconds (live sources)")
+    args = ap.parse_args(argv)
+    url, path = args.url, args.file
+    if not url and not path:
+        port = int(_cfg.get("MXNET_TELEMETRY_PORT"))
+        if not port:
+            ap.error("need --url or --file (or MXNET_TELEMETRY_PORT)")
+        url = "http://127.0.0.1:%d" % port
+    while True:
+        try:
+            snap = load_snapshot(url=url, path=path)
+        except Exception as e:      # noqa: BLE001 — operator tool:
+            print("teletop: cannot read %s: %s"
+                  % (url or path, e), file=sys.stderr)
+            return 1
+        out = render(snap, prefix=args.prefix)
+        if args.watch > 0:
+            sys.stdout.write("\x1b[2J\x1b[H" + out + "\n")
+            sys.stdout.flush()
+            try:
+                time.sleep(args.watch)
+            except KeyboardInterrupt:
+                return 0
+        else:
+            print(out)
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

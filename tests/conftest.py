@@ -48,6 +48,12 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "serve: inference-serving engine tests (CPU-fast, "
         "run in tier-1 by default)")
+    # the telemetry suite (spans/exporter/StepTelemetry/teletop) is
+    # CPU-fast and runs in tier-1 by default; the marker lets it be
+    # selected or excluded explicitly (pytest -m telemetry)
+    config.addinivalue_line(
+        "markers", "telemetry: observability-layer tests (CPU-fast, "
+        "run in tier-1 by default)")
 
 
 @pytest.fixture(autouse=True)

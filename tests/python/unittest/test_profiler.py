@@ -93,6 +93,28 @@ def test_pause_resume(prof):
     assert len(after.splitlines()) >= len(before.splitlines())
 
 
+def test_set_state_idempotent():
+    """stop-before-run, double-stop and double-run must all be no-ops:
+    the dispatch listener is registered exactly while running, never
+    unregistered when it was never added (ISSUE 4 satellite)."""
+    from incubator_mxnet_tpu import engine
+    n0 = len(engine._LISTENERS)
+    profiler.set_state("stop")          # stop before any run
+    profiler.set_state("stop")          # double stop
+    assert len(engine._LISTENERS) == n0
+    profiler.set_state("run")
+    profiler.set_state("run")           # double run: no double-register
+    assert len(engine._LISTENERS) == n0 + 1
+    profiler.set_state("stop")
+    profiler.set_state("stop")
+    assert len(engine._LISTENERS) == n0
+    # run→stop→run keeps collecting
+    profiler.set_state("run")
+    assert len(engine._LISTENERS) == n0 + 1
+    profiler.set_state("stop")
+    assert len(engine._LISTENERS) == n0
+
+
 def test_wait_all_is_safe():
     """wait_all walks live buffers (plugin-honest barrier) — must not
     raise with donated/deleted arrays around."""
