@@ -1141,16 +1141,45 @@ if __name__ == "__main__":
                 parsed.get("serve_traces_after_warmup_delta", 1) == 0 \
                 else 1
         except Exception as e:
-            parsed, rc = {"serve_error": str(e)[:160]}, 1
+            parsed, rc = {"serve_error": str(e)[:160],
+                          "serve_failed": str(e)[:160]}, 1
+            try:
+                from incubator_mxnet_tpu import telemetry
+                parsed["serve_blackbox"] = telemetry.dump_blackbox(
+                    reason="bench.serve", exc=e)
+            except Exception:
+                pass
         print(_write_bench_serve(parsed, rc=rc))
         sys.exit(rc)
     if len(sys.argv) >= 3 and sys.argv[1] == "--config":
         name = sys.argv[2]
         batch = sys.argv[3] if len(sys.argv) >= 4 else None
         try:
-            print(json.dumps(_CONFIGS[name](batch)))
+            out = _CONFIGS[name](batch)
+            try:
+                # cost-table totals (flops / bytes / hbm peak) ride in
+                # every config's JSON line (ISSUE 5)
+                from incubator_mxnet_tpu.telemetry import costs as _costs
+                t = _costs.totals()
+                if t.get("executables"):
+                    out[name + "_costs"] = t
+            except Exception:
+                pass
+            print(json.dumps(out))
             sys.exit(0)
         except Exception as e:
-            print(json.dumps({name + "_error": str(e)[:160]}))
+            # a crashing config leaves its black box (ring + counters +
+            # cost table) and reports <cfg>_failed instead of killing
+            # the whole round (ISSUE 5); _error kept for the driver's
+            # batch-retry ladder
+            fail = {name + "_failed": str(e)[:160],
+                    name + "_error": str(e)[:160]}
+            try:
+                from incubator_mxnet_tpu import telemetry
+                fail[name + "_blackbox"] = telemetry.dump_blackbox(
+                    reason="bench." + name, exc=e)
+            except Exception:
+                pass
+            print(json.dumps(fail))
             sys.exit(0)
     sys.exit(main())

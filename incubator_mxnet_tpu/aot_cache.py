@@ -33,6 +33,8 @@ import jax
 
 from . import config as _cfg
 from .monitor import events
+from .telemetry import costs as _costs
+from .telemetry import flightrec as _bb
 from .telemetry import spans as _tele
 
 __all__ = ["aot_jit", "cache_dir", "trim_cache"]
@@ -109,9 +111,12 @@ class _AotJitted:
     """Callable with jax.jit semantics + executable disk persistence.
     One compiled executable per input aval signature."""
 
-    def __init__(self, fn, donate_argnums=()):
+    def __init__(self, fn, donate_argnums=(), label=None, kind="aot"):
         self._jit = jax.jit(fn, donate_argnums=donate_argnums)
         self._compiled = {}
+        self._label = label or getattr(fn, "__name__", "fn")
+        self._kind = kind
+        self._cost_keys = {}        # sig -> costs registry row key
 
     def _sig(self, args):
         leaves, treedef = jax.tree_util.tree_flatten(args)
@@ -167,7 +172,21 @@ class _AotJitted:
                                         execution_devices=[dev])
         return deserialize_and_load(blob, in_tree, out_tree)
 
-    def _get_compiled(self, args):
+    def _note_cost(self, sig, lowered, compiled, compile_s,
+                   loaded=False):
+        """File this executable's row in the cost registry (ISSUE 5):
+        flops/bytes from cost_analysis, arg/out/donated bytes from
+        memory_analysis — both None-tolerant (the axon plugin)."""
+        try:
+            self._cost_keys[sig] = _costs.note_executable(
+                self._kind, "%s[%d]" % (self._label,
+                                        len(self._cost_keys)),
+                lowered=lowered, compiled=compiled,
+                compile_s=compile_s, loaded=loaded)
+        except Exception:           # noqa: BLE001 — attribution is
+            pass                    # best-effort, never fatal
+
+    def _get_compiled(self, args, sig=None):
         from jax.experimental.serialize_executable import serialize
         import jax.tree_util as tu
         import time as _t
@@ -202,6 +221,8 @@ class _AotJitted:
                 events.incr("aot.hit")
                 events.observe_time("aot.load_us",
                                     _t.perf_counter() - t2)
+                self._note_cost(sig, lowered, out,
+                                _t.perf_counter() - t2, loaded=True)
                 if dbg:
                     print("[aot] HIT lower=%.1fs key=%.1fs load=%.1fs"
                           % (t1 - t0, t2 - t1, _t.perf_counter() - t2))
@@ -217,6 +238,8 @@ class _AotJitted:
             compiled = lowered.compile()  # the compile-cost tail
         events.incr("aot.miss")
         events.observe_time("aot.compile_us", _t.perf_counter() - t3)
+        self._note_cost(sig, lowered, compiled,
+                        _t.perf_counter() - t3)
         if dbg:
             print("[aot] MISS lower=%.1fs key=%.1fs compile=%.1fs"
                   % (t1 - t0, t2 - t1, _t.perf_counter() - t3))
@@ -237,7 +260,7 @@ class _AotJitted:
         comp = self._compiled.get(sig)
         if comp is None:
             try:
-                comp = self._get_compiled(args)
+                comp = self._get_compiled(args, sig)
             except Exception as e:      # any AOT failure → plain jit
                 import warnings
                 warnings.warn(
@@ -246,6 +269,10 @@ class _AotJitted:
                     "process)" % (type(e).__name__, str(e)[:120]))
                 comp = False
             self._compiled[sig] = comp
+        if _bb.enabled():
+            ck = self._cost_keys.get(sig)
+            if ck is not None:
+                _costs.invoke(ck)
         if comp is False:
             return self._jit(*args)
         return comp(*args)
@@ -254,9 +281,20 @@ class _AotJitted:
         return self._jit.lower(*args, **kw)
 
 
-def aot_jit(fn, donate_argnums=()):
+def aot_jit(fn, donate_argnums=(), label=None, kind="aot"):
     """`jax.jit(fn, donate_argnums=...)` with executable persistence
-    under `MXNET_AOT_CACHE_DIR` (no-op passthrough when unset)."""
+    under `MXNET_AOT_CACHE_DIR` (no-op passthrough when unset).
+
+    `label` additionally registers the executable in the cost registry
+    (`telemetry.costs`) under `kind`/`label`: with the cache dir set,
+    cost/memory analysis is extracted from the compiled executable
+    already in hand; without it, the plain jit is wrapped in a
+    `MeteredJit` (invocation counts + lazily-resolved cost analysis).
+    Unlabeled calls keep the original zero-overhead contract."""
     if not cache_dir():
+        if label is not None:
+            return _costs.metered_jit(fn, donate_argnums=donate_argnums,
+                                      kind=kind, label=label)
         return jax.jit(fn, donate_argnums=donate_argnums)
-    return _AotJitted(fn, donate_argnums=donate_argnums)
+    return _AotJitted(fn, donate_argnums=donate_argnums, label=label,
+                      kind=kind)

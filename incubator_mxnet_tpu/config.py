@@ -285,6 +285,19 @@ register("MXNET_TELEMETRY_EXPORT_PATH", str, "",
          "snapshot format). Empty = no file export")
 register("MXNET_TELEMETRY_EXPORT_S", float, 15.0,
          "Seconds between periodic telemetry file exports")
+register("MXNET_BLACKBOX", bool, True,
+         "Flight recorder (telemetry/flightrec.py): always-on bounded "
+         "event ring + black-box JSON dumps on rollback/preemption/"
+         "uncaught exceptions/SIGUSR2, and per-executable cost "
+         "metering (telemetry/costs.py).  0 reduces every hook to a "
+         "single bool read")
+register("MXNET_BLACKBOX_RING", int, 4096,
+         "Flight-recorder ring capacity (events retained for the "
+         "last-N timeline a black-box dump embeds)")
+register("MXNET_BLACKBOX_DIR", str, "",
+         "Directory for black-box dumps (auto-named "
+         "blackbox-<ts>-p<pid>-<seq>-<reason>.json). Empty = current "
+         "working directory")
 register("MXNET_INT64_TENSOR_SIZE", bool, False,
          "Large-tensor support: enable 64-bit index arithmetic so "
          "arrays past 2**31 elements index correctly (ref: the "

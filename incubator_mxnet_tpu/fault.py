@@ -230,6 +230,13 @@ def should_fire(site: str, step: Optional[int] = None) -> bool:
         seconds = hit.seconds
     from .monitor import events
     events.incr("fault.injected")
+    try:
+        # every injected fault is a flight-recorder marker: the dump
+        # timeline shows WHAT was injected next to what broke
+        from .telemetry import flightrec as _bb
+        _bb.record("fault", site, step=step)
+    except Exception:               # noqa: BLE001 — forensics must not
+        pass                        # change fault-injection semantics
     if seconds:
         time.sleep(seconds)
     return True

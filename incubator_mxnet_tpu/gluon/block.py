@@ -554,7 +554,8 @@ class _FusedProgram:
         def fwd(*leaves):
             return jax.vjp(raw, *leaves)
         from ..aot_cache import aot_jit
-        self.fwd_jit = aot_jit(fwd)
+        self.fwd_jit = aot_jit(fwd, label="gluon.fused_fwd_vjp",
+                               kind="train")
         self.keep = keep
         self.n_net = n_net_leaves
         self.n_loss = n_loss
@@ -938,7 +939,8 @@ class _CachedGraph:
             outs, vjp_fn = jax.vjp(pure_flat, *leaves)
             return outs, vjp_fn
         from ..aot_cache import aot_jit
-        self._jit_fwdvjp[fkey] = aot_jit(fwd)
+        self._jit_fwdvjp[fkey] = aot_jit(
+            fwd, label=self.block.name + ".fwd_vjp", kind="train")
         return self._jit_fwdvjp[fkey]
 
     def __call__(self, args):
@@ -1002,7 +1004,8 @@ class _CachedGraph:
                 if fkey not in self._jitted:
                     from ..aot_cache import aot_jit
                     self._jitted[fkey] = aot_jit(
-                        self._get_flat(training, np_, ni_))
+                        self._get_flat(training, np_, ni_),
+                        label=self.block.name + ".fwd", kind="infer")
                 result = self._jitted[fkey](*leaf_data)
         if _engine.naive_mode():
             for o in result:

@@ -49,7 +49,12 @@ import numpy as _np
 
 from .. import config as _cfg
 from ..monitor import events
+from ..telemetry import flightrec as _bb
 from ..telemetry import spans as _tele
+
+#: consumer waits above this land in the flight-recorder ring — a
+#: buffered q.get returns in µs, a genuine starvation stall in ms+
+_STALL_RECORD_US = 1000
 
 __all__ = ["DeviceFeed", "feed_counters", "make_normalizer",
            "normalize_transform"]
@@ -319,7 +324,13 @@ class DeviceFeed:
             out = self._next_sync(t0)
         else:
             kind, val = self._q.get()
-            events.add_time("feed.stall_us", time.perf_counter() - t0)
+            stall_s = time.perf_counter() - t0
+            events.add_time("feed.stall_us", stall_s)
+            stall_us = int(stall_s * 1e6)
+            if stall_us > _STALL_RECORD_US:
+                # compute starved by the feed: one timeline event per
+                # real stall (buffered sub-ms gets are just poll cost)
+                _bb.record("feed", "stall", us=stall_us)
             if kind == "eoe":
                 self._exhausted = True
                 raise StopIteration

@@ -49,6 +49,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import fault
 from ..monitor import events
+from ..telemetry import costs as _costs
+from ..telemetry import flightrec as _bb
 from ..telemetry import spans as _tele
 from ..telemetry.stepstats import StepTelemetry
 from ..contrib.amp.loss_scaler import LossScaler
@@ -162,6 +164,10 @@ class ResilientTrainer:
         self._have_ckpt = bool(self._list_checkpoints())
         if handle_sigterm:
             self._install_sigterm()
+        # a resilient run is exactly what black-box forensics exist
+        # for: arm the uncaught-exception/SIGUSR2 dump triggers
+        # (idempotent; MXNET_BLACKBOX=0 disarms)
+        _bb.install_crash_hooks()
 
     # -- signal / preemption -------------------------------------------
     def _install_sigterm(self):
@@ -246,7 +252,11 @@ class ResilientTrainer:
                 for n, v in new_params.items()}
             return new_params, new_opt, loss, ok
 
-        return jax.jit(gstep, donate_argnums=(0, 1))
+        # metered: the guarded fused train step gets a cost-registry
+        # row (FLOPs/bytes + invocation counts) — the headline line of
+        # a training run's black-box dump
+        return _costs.metered_jit(gstep, donate_argnums=(0, 1),
+                                  kind="train", label="resilient.gstep")
 
     def _rng_bits(self, step: int):
         """Per-step RNG stream: a pure function of (seed, step), so the
@@ -318,6 +328,11 @@ class ResilientTrainer:
         finally:
             step_span.stop()
         t2 = time.perf_counter()
+        # always-on flight-recorder step record (one ring append): the
+        # last-N step timeline a black-box dump replays
+        _bb.record("step", "resilient", step=stepno,
+                   loss=(loss if loss == loss else None), ok=ok,
+                   us=int((t2 - t0) * 1e6))
         if tele is not None:
             tele.record_step(loss=loss, ok=ok, wall_s=t2 - t0,
                              data_wait_s=t1 - t0, compute_s=t2 - t1,
@@ -408,6 +423,12 @@ class ResilientTrainer:
             self._publish_latest(self._ckpt_name(step))
         self._have_ckpt = True
         events.incr("resilience.checkpoint_written")
+        _bb.record("ckpt", "written", step=step,
+                   us=int((time.perf_counter() - t_ck) * 1e6))
+        # checkpoint boundaries are the natural cadence for the HBM
+        # watermark + counter-delta samples the timeline carries
+        _bb.hbm_sample(tag="checkpoint")
+        _bb.sample_counters()
         if _tele.enabled():
             if self._tele is None:
                 self._tele = StepTelemetry(
@@ -442,12 +463,19 @@ class ResilientTrainer:
         self.scaler.loss_scale = scale
         self.bad_steps = 0
         events.incr("resilience.rollback")
+        _bb.record("rollback", "bad_steps", step=self.trainer._n_step)
+        # a rollback means the run just survived something that kills
+        # unguarded jobs — leave the forensic file while the evidence
+        # (bad-step timeline, loss samples, counters) is still in ring
+        _bb.crash_dump("rollback")
         log.warning("rolled back to step %d after repeated bad steps",
                     self.trainer._n_step)
 
     def _handle_preemption(self):
         self._preempted = False
         step = self.trainer._n_step
+        _bb.record("preempt", "sigterm", step=step,
+                   ckpt=bool(self.ckpt_dir))
         if self.ckpt_dir:
             self.checkpoint()
             marker_tmp = os.path.join(self.ckpt_dir,
@@ -457,6 +485,10 @@ class ResilientTrainer:
             os.replace(marker_tmp,
                        os.path.join(self.ckpt_dir, _PREEMPT_MARKER))
         events.incr("resilience.preemption")
+        # the black box is the last thing written before the process
+        # dies: it carries this preemption AND any earlier rollback
+        # markers still in the ring (the acceptance scenario)
+        _bb.crash_dump("preemption")
         log.warning("preemption handled at step %d; checkpoint saved",
                     step)
         raise fault.Preempted(step, self.ckpt_dir)

@@ -22,6 +22,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .functional import functionalize, extract_params, load_params
 from .mesh import make_mesh
 from ..monitor import events
+from ..telemetry import costs as _costs
+from ..telemetry import flightrec as _bb
 from ..telemetry import spans as _tele
 from ..telemetry.stepstats import StepTelemetry
 
@@ -253,7 +255,12 @@ class ShardedTrainer:
                 for n, v in new_params.items()}
             return new_params, new_opt, loss
 
-        return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+        # metered: one cost-registry row per input signature
+        # (FLOPs/bytes-accessed + cumulative invocation counts) — the
+        # pod-path train step's line in a black-box dump's cost table
+        return _costs.metered_jit(
+            step, donate_argnums=(0, 1) if donate else (),
+            kind="train", label="sharded.step")
 
     def _place_batch(self, arr, sharding):
         """Single-controller: the full global batch device_puts onto the
@@ -293,7 +300,7 @@ class ShardedTrainer:
             # compiling first step
             tele = self._tele = StepTelemetry(
                 own_traces=self._trace_count)
-        t0 = time.perf_counter() if tele is not None else 0.0
+        t0 = time.perf_counter()
         batch = self._place_batch(batch, self._batch_sharding)
         labels = self._place_batch(
             labels, NamedSharding(self.mesh, P(self.batch_axis)))
@@ -303,8 +310,12 @@ class ShardedTrainer:
         self.params, self.opt_state, loss = self._step(
             self.params, self.opt_state, batch, labels, rng_bits)
         self._n_step += 1
+        t2 = time.perf_counter()
+        # always-on flight-recorder step record (loss stays on device —
+        # forcing it here would forfeit dispatch/compute overlap)
+        _bb.record("step", "sharded", step=self._n_step - 1,
+                   us=int((t2 - t0) * 1e6))
         if tele is not None:
-            t2 = time.perf_counter()
             tele.record_step(wall_s=t2 - t0, data_wait_s=t1 - t0,
                              dispatch_s=t2 - t1,
                              traces=self._trace_count)

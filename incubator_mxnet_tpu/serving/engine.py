@@ -73,6 +73,7 @@ from .. import fault
 from ..base import MXNetError
 from ..context import Context, current_context
 from ..monitor import events
+from ..telemetry import flightrec as _bb
 from ..telemetry import spans as _tele
 
 __all__ = ["InferenceEngine", "QueueFull", "DeadlineExceeded",
@@ -223,6 +224,9 @@ class InferenceEngine:
         self._prev_sigterm = None
         if handle_sigterm:
             self._install_sigterm()
+        # a serving host is exactly the process black-box dumps exist
+        # for: arm the uncaught-exception/SIGUSR2 triggers (idempotent)
+        _bb.install_crash_hooks()
 
     # -- executable construction ---------------------------------------
     def _make_infer(self):
@@ -245,7 +249,10 @@ class InferenceEngine:
             out, _states = pure(params, *nd_in)
             return out
 
-        return aot_jit(infer)
+        # each (device, bucket) signature becomes one cost-registry row
+        # under serve.infer — the per-bucket FLOPs/HBM attribution the
+        # blackbox dump reports for a serving host
+        return aot_jit(infer, label="serve.infer", kind="serve")
 
     def refresh_params(self):
         """(Re-)replicate the block's current parameters onto every
@@ -418,7 +425,7 @@ class InferenceEngine:
                     return
                 if reqs:                # [] = idle poll: release the
                     eng._execute(reqs)  # strong ref and re-resolve
-            except Exception:           # noqa: BLE001 — the dispatcher
+            except Exception as e:      # noqa: BLE001 — the dispatcher
                 # must survive ANYTHING (a dead dispatcher strands every
                 # queued future); _execute resolves its own requests, so
                 # whatever escaped here had none in hand
@@ -426,6 +433,12 @@ class InferenceEngine:
                 logging.getLogger(__name__).exception(
                     "serve dispatcher error (recovered)")
                 events.incr("serve.dispatcher_errors")
+                # the backstop firing means the engine survived
+                # something it shouldn't have seen — leave the forensic
+                # file while the evidence (ring + counters) is fresh
+                _bb.record("fault", "serve.dispatcher",
+                           error=type(e).__name__)
+                _bb.crash_dump("serve.dispatcher", e)
                 time.sleep(0.01)
             finally:
                 del eng
@@ -539,6 +552,11 @@ class InferenceEngine:
             return
         total = sum(r.n for r in live)
         bucket = self._bucket_for(total)
+        # queue-depth sample per dispatched batch: the black-box
+        # timeline shows backlog growth leading up to a death, which
+        # counters (totals) cannot reconstruct
+        _bb.record("serve", "queue", depth=self._q.qsize(),
+                   bucket=bucket, n=total)
         dev_i = self._rr % len(self._ctxs)
         self._rr += 1
         if self._pools is None:

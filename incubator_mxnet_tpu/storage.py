@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import threading
 
-__all__ = ["Storage", "memory_info"]
+__all__ = ["Storage", "memory_info", "memory_events"]
 
 
 def memory_info(device=None):
@@ -39,6 +39,42 @@ def memory_info(device=None):
     return (stats.get("bytes_in_use", 0),
             stats.get("bytes_limit", stats.get("bytes_reservable_limit",
                                                0)))
+
+
+def memory_events(devices=None, counters=None):
+    """Sample per-device HBM used/peak onto `monitor.events` as `mem.*`
+    observed series (ISSUE 5): `mem.bytes_in_use` / `mem.peak_bytes`
+    samples whose p50/p99 render through the MetricsExporter like any
+    latency series.  Returns one dict per device that HAS stats.
+
+    Degrades cleanly on backends whose PJRT `memory_stats` returns
+    None or raises (the axon plugin, ndarray.py:77): that device
+    contributes NO event and NO crash — the return is simply shorter
+    (empty on a statless backend, e.g. CPU jax)."""
+    import jax
+    if counters is None:
+        from .monitor import events as counters
+    out = []
+    for d in (devices if devices is not None else jax.devices()):
+        d = getattr(d, "jax_device", d)
+        try:
+            stats = getattr(d, "memory_stats", lambda: None)()
+        except Exception:           # noqa: BLE001 — introspection must
+            stats = None            # never take the run down
+        if not stats:
+            continue
+        used = int(stats.get("bytes_in_use", 0))
+        peak = int(stats.get("peak_bytes_in_use", used))
+        limit = int(stats.get("bytes_limit",
+                              stats.get("bytes_reservable_limit", 0)))
+        counters.observe("mem.bytes_in_use", used)
+        counters.observe("mem.peak_bytes", max(peak, used))
+        out.append({"device": "%s:%d" % (getattr(d, "platform", "dev"),
+                                         getattr(d, "id", 0)),
+                    "bytes_in_use": used,
+                    "peak_bytes": max(peak, used),
+                    "bytes_limit": limit})
+    return out
 
 
 class Storage:

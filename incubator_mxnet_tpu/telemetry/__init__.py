@@ -18,6 +18,21 @@ hot-path hook is a single bool read.  `telemetry.start()` boots the
 process-wide exporter off the MXNET_TELEMETRY_* knobs;
 `python -m incubator_mxnet_tpu.tools.teletop` renders a live or
 file-snapshot table.  See docs/observability.md.
+
+ISSUE 5 adds the push-based layer the pull-based surfaces above can't
+replace when a run dies:
+
+- `telemetry.flightrec` — the ALWAYS-ON flight recorder: a bounded
+  ring of structured events (steps, spans, markers, stalls, HBM
+  watermarks) dumped atomically as a self-contained forensic JSON on
+  rollback/preemption/uncaught exceptions/SIGUSR2 or an explicit
+  `telemetry.dump_blackbox()` (`MXNET_BLACKBOX=0` disarms).
+- `telemetry.costs` — the per-executable FLOPs/HBM cost registry every
+  jitted executable (aot_cache, fused imperative step, trainer steps,
+  serving buckets) reports into.
+
+`python -m incubator_mxnet_tpu.tools.blackbox <dump>` summarizes a
+dump.
 """
 from __future__ import annotations
 
@@ -25,14 +40,18 @@ from .spans import (SpanContext, current, enable, enabled, recording,
                     span)
 from .export import MetricsExporter
 from .stepstats import StepTelemetry
+from . import costs
+from . import flightrec
+from .flightrec import dump_blackbox, install_crash_hooks
 
 __all__ = ["SpanContext", "span", "current", "enable", "enabled",
            "recording", "MetricsExporter", "StepTelemetry", "start",
-           "stop", "get_exporter", "snapshot_dict"]
+           "stop", "get_exporter", "snapshot_dict", "costs",
+           "flightrec", "dump_blackbox", "install_crash_hooks"]
 
 #: counter families the condensed snapshot (bench.py JSON) carries
 SNAPSHOT_PREFIXES = ("serve.", "feed.", "train.", "aot.",
-                     "resilience.")
+                     "resilience.", "mem.", "fault.", "blackbox.")
 
 _exporter = None
 
@@ -46,6 +65,9 @@ def start(port=None, path=None, period_s=None) -> MetricsExporter:
     from .. import config as _cfg
     global _exporter
     enable()
+    # a started export surface implies a production run — arm the
+    # black-box crash hooks too (idempotent; MXNET_BLACKBOX=0 disarms)
+    flightrec.install_crash_hooks()
     if _exporter is None:
         _exporter = MetricsExporter()
     if port is not None:
@@ -78,8 +100,17 @@ def snapshot_dict(prefixes=SNAPSHOT_PREFIXES, pcts=(50, 99)) -> dict:
     BENCH_r*/BENCH_serve schema)."""
     from ..monitor import events
     keep = lambda k: any(k.startswith(p) for p in prefixes)
-    return {"counters": {k: v for k, v in events.snapshot().items()
-                         if keep(k)},
-            "percentiles": {k: v for k, v in
-                            events.latency_snapshot(pcts=pcts).items()
-                            if keep(k)}}
+    out = {"counters": {k: v for k, v in events.snapshot().items()
+                        if keep(k)},
+           "percentiles": {k: v for k, v in
+                           events.latency_snapshot(pcts=pcts).items()
+                           if keep(k)}}
+    try:
+        t = costs.totals()
+        if t.get("executables"):
+            # cost-table totals ride in the same one-line record
+            # (flops / bytes / hbm peak — the bench.py contract)
+            out["costs"] = t
+    except Exception:               # noqa: BLE001 — attribution is
+        pass                        # best-effort in a snapshot
+    return out

@@ -1,0 +1,321 @@
+"""Per-executable FLOPs/HBM cost attribution (ISSUE 5 tentpole
+part 2).
+
+Telemetry so far says how long things took; nothing says what the
+hardware was ASKED to do.  XLA exposes exactly that per executable —
+`cost_analysis()` (flops, bytes accessed) and `memory_analysis()`
+(argument/output/temp/alias bytes) — and the repo already touches the
+surface per-op (ndarray.py:77) but never aggregates it.  This registry
+is the aggregation point: every jitted executable the framework builds
+(the aot_cache entries, the fused imperative train step in
+gluon/block.py + optimizer.py, ShardedTrainer/ResilientTrainer steps,
+the serving bucket executables) registers one row per input signature,
+and every call bumps the row's invocation count — so a blackbox dump
+or a `/metrics` scrape can say "this run spent N invocations × M
+GFLOPs on `resilient.gstep`, and the serving buckets held K bytes of
+HBM".
+
+Two registration paths:
+
+- `note_executable(...)` — the aot_cache path: a `Lowered` and/or
+  `Compiled` is already in hand, analysis is extracted eagerly (no
+  extra work was done to get it).
+- `metered_jit(fn, ...)` — the plain-jit path (ShardedTrainer /
+  ResilientTrainer steps, aot_jit's no-cache-dir fallback).  New
+  signatures are detected by a trace-time hook (a jit cache hit never
+  runs the python body — the `train.traces` pattern), which captures
+  the tracer avals and files a PENDING row; `table()`/`totals()`
+  resolve pending rows by lowering against the stored avals — off the
+  hot path, and (because jit shares its trace cache with `.lower()`)
+  usually without re-tracing.  The steady-state call pays two int
+  compares and one locked counter bump, never a pytree flatten.
+
+Both guards: `cost_analysis()`/`memory_analysis()` returning None or
+raising (the axon plugin, ndarray.py:77) degrades to a row with the
+walls and invocation counts but zeroed cost fields — never a crash.
+The per-call hot path is gated on `flightrec.enabled()`:
+MXNET_BLACKBOX=0 makes `MeteredJit.__call__` a bool read + the inner
+jit call.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+
+__all__ = ["note_executable", "invoke", "table", "totals", "snapshot",
+           "reset", "metered_jit", "MeteredJit"]
+
+_LOCK = threading.Lock()
+_ROWS = {}                      # key -> dict row
+_NEXT = [1]
+
+
+def _cost_dict(obj):
+    """`obj.cost_analysis()` as a plain dict — tolerant of None, a
+    per-device list, a missing method, or a raising backend."""
+    fn = getattr(obj, "cost_analysis", None)
+    if fn is None:
+        return {}
+    try:
+        c = fn()
+    except Exception:               # noqa: BLE001 — axon returns None /
+        return {}                   # raises; attribution degrades
+    if isinstance(c, (list, tuple)):
+        c = c[0] if c else None
+    return dict(c) if c else {}
+
+
+def _mem_dict(compiled):
+    """`compiled.memory_analysis()` fields as a plain dict (same
+    tolerance as `_cost_dict`)."""
+    fn = getattr(compiled, "memory_analysis", None)
+    if fn is None:
+        return {}
+    try:
+        m = fn()
+    except Exception:               # noqa: BLE001
+        return {}
+    if m is None:
+        return {}
+    out = {}
+    for field, key in (("argument_size_in_bytes", "argument_bytes"),
+                       ("output_size_in_bytes", "output_bytes"),
+                       ("temp_size_in_bytes", "temp_bytes"),
+                       ("alias_size_in_bytes", "donated_bytes"),
+                       ("generated_code_size_in_bytes", "code_bytes")):
+        v = getattr(m, field, None)
+        if v is not None:
+            try:
+                out[key] = int(v)
+            except (TypeError, ValueError):
+                pass
+    return out
+
+
+def _apply_analysis(row, cost, mem):
+    c = _cost_dict(cost) if cost is not None else {}
+    row["flops"] = float(c.get("flops", 0.0) or 0.0)
+    row["bytes_accessed"] = float(c.get("bytes accessed", 0.0) or 0.0)
+    row["analyzed"] = bool(c)
+    if mem is not None:
+        row.update(_mem_dict(mem))
+
+
+def note_executable(kind, label, lowered=None, compiled=None,
+                    compile_s=None, loaded=False, nsig=None):
+    """Register one executable's cost row (eager path — analysis
+    objects are in hand).  Prefers `compiled` for cost/memory analysis,
+    falls back to `lowered` for cost (a deserialized executable may not
+    re-expose cost_analysis).  Returns the row key for `invoke()`."""
+    row = {"kind": str(kind), "label": str(label),
+           "flops": 0.0, "bytes_accessed": 0.0,
+           "compile_wall_s": float(compile_s) if compile_s else 0.0,
+           "loaded": bool(loaded), "invocations": 0,
+           "analyzed": False, "pending": None}
+    c = _cost_dict(compiled)
+    # prefer the compiled executable's analysis; a deserialized blob
+    # may not re-expose it, so fall back to the lowering's (one
+    # cost_analysis pass either way)
+    if c:
+        row["flops"] = float(c.get("flops", 0.0) or 0.0)
+        row["bytes_accessed"] = float(c.get("bytes accessed", 0.0)
+                                      or 0.0)
+        row["analyzed"] = True
+        row.update(_mem_dict(compiled))
+    else:
+        _apply_analysis(row, lowered, compiled)
+    if nsig:
+        row["sig"] = str(nsig)
+    with _LOCK:
+        key = _NEXT[0]
+        _NEXT[0] += 1
+        _ROWS[key] = row
+    return key
+
+
+def _note_pending(kind, label, resolver, compile_s=None):
+    """Register a row whose analysis is resolved lazily by `resolver()`
+    (returns a Lowered, or None) at table/totals time."""
+    row = {"kind": str(kind), "label": str(label),
+           "flops": 0.0, "bytes_accessed": 0.0,
+           "compile_wall_s": float(compile_s) if compile_s else 0.0,
+           "loaded": False, "invocations": 0,
+           "analyzed": False, "pending": resolver}
+    with _LOCK:
+        key = _NEXT[0]
+        _NEXT[0] += 1
+        _ROWS[key] = row
+    return key
+
+
+def invoke(key, n=1):
+    """Bump a row's cumulative invocation count (one lock; the per-step
+    cost of attribution)."""
+    with _LOCK:
+        row = _ROWS.get(key)
+        if row is not None:
+            row["invocations"] += int(n)
+
+
+def set_compile_wall(key, seconds):
+    with _LOCK:
+        row = _ROWS.get(key)
+        if row is not None:
+            row["compile_wall_s"] = float(seconds)
+
+
+def _resolve(row):
+    # pending swap under the lock: two concurrent table() callers (the
+    # exporter worker and a crash dump) must not run one resolver twice
+    with _LOCK:
+        resolver, row["pending"] = row["pending"], None
+    if resolver is None:
+        return
+    try:
+        lowered = resolver()
+    except Exception:               # noqa: BLE001 — resolution is
+        lowered = None              # best-effort forensics
+    if lowered is not None:
+        _apply_analysis(row, lowered, None)
+
+
+def table():
+    """The cost table: one dict per registered executable, pending
+    analyses resolved, sorted by cumulative FLOPs (flops × calls)
+    descending."""
+    with _LOCK:
+        items = list(_ROWS.items())
+    out = []
+    for key, row in items:
+        if row.get("pending") is not None:
+            _resolve(row)
+        r = {k: v for k, v in row.items() if k != "pending"}
+        r["key"] = key
+        r["cum_flops"] = r["flops"] * max(1, r["invocations"])
+        r["cum_bytes"] = r["bytes_accessed"] * max(1, r["invocations"])
+        out.append(r)
+    out.sort(key=lambda r: r["cum_flops"], reverse=True)
+    return out
+
+
+def totals():
+    """Aggregates for embedding in one JSON line (bench.py): executable
+    and invocation counts, total/cumulative flops + bytes accessed, and
+    the HBM peak watermark (flightrec's `hbm_sample` high-water)."""
+    rows = table()
+    from . import flightrec as _bb
+    peaks = _bb.hbm_peaks()
+    return {"executables": len(rows),
+            "invocations": sum(r["invocations"] for r in rows),
+            "flops": sum(r["flops"] for r in rows),
+            "bytes_accessed": sum(r["bytes_accessed"] for r in rows),
+            "cum_flops": sum(r["cum_flops"] for r in rows),
+            "cum_bytes": sum(r["cum_bytes"] for r in rows),
+            "compile_wall_s": round(sum(r["compile_wall_s"]
+                                        for r in rows), 3),
+            "hbm_peak_bytes": max(peaks.values()) if peaks else 0}
+
+
+def snapshot():
+    """{"rows": table(), "totals": totals()} — the dump/export block."""
+    return {"rows": table(), "totals": totals()}
+
+
+def reset():
+    with _LOCK:
+        _ROWS.clear()
+
+
+class MeteredJit:
+    """`jax.jit` + cost-row registration + invocation counting for the
+    plain-jit executables (no aot_cache involved).
+
+    Hot-path contract (the check_overhead.py gate): NO per-call
+    signature computation.  New input signatures are detected by a
+    trace-time side effect inside the wrapped function (the
+    `train.traces` pattern — a jit cache hit never runs the python
+    body): the tracer avals are captured THERE, at trace cost, and the
+    steady-state call pays one bool read, two int compares and one
+    locked counter bump.  Recorder off: one bool read, then the inner
+    jit."""
+
+    def __init__(self, fn, donate_argnums=(), kind="jit", label=None):
+        import jax
+        self._kind = kind
+        self._label = label or getattr(fn, "__name__", "fn")
+        self._keys = []             # registry row key per traced sig
+        self._pending = []          # avals captured at trace time
+        # suppresses the hook during lazy cost resolution (its lower()
+        # may re-trace).  THREAD-local: a resolver running on the
+        # exporter thread must not swallow a genuinely new signature
+        # the training thread traces concurrently
+        self._tls = threading.local()
+
+        def _traced(*a):
+            # trace-time only: a jit cache hit never runs this
+            if not getattr(self._tls, "resolving", False):
+                self._pending.append(jax.tree_util.tree_map(
+                    lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype),
+                    a))
+            return fn(*a)
+
+        self._jit = jax.jit(_traced, donate_argnums=donate_argnums)
+
+    def _register_pending(self, wall_s):
+        """Turn trace-time aval captures into pending cost rows (the
+        lowering/analysis happens at table()/dump time — jit shares
+        its trace cache with .lower(), so resolution usually re-traces
+        nothing).  `wall_s` (this call's wall, which included the
+        trace+compile) is the compile-wall proxy."""
+        jref = weakref.ref(self._jit)
+        me = weakref.ref(self)
+        while self._pending:
+            avals = self._pending.pop(0)
+
+            def resolver(avals=avals):
+                j, s = jref(), me()
+                if j is None:
+                    return None
+                if s is not None:
+                    s._tls.resolving = True
+                try:
+                    return j.lower(*avals)
+                finally:
+                    if s is not None:
+                        s._tls.resolving = False
+
+            key = _note_pending(
+                self._kind, "%s[%d]" % (self._label, len(self._keys)),
+                resolver, compile_s=wall_s)
+            self._keys.append(key)
+
+    def __call__(self, *args):
+        from . import flightrec as _bb
+        if not _bb.enabled():
+            return self._jit(*args)
+        t0 = time.perf_counter()
+        out = self._jit(*args)
+        if self._pending:
+            # this call traced a new signature: register it, with the
+            # call's wall (≈ trace + compile + one execution) as the
+            # honest compile-wall proxy
+            self._register_pending(time.perf_counter() - t0)
+        if self._keys:
+            # cache-hit calls attribute to the newest row — knowing the
+            # exact signature would cost a per-call pytree flatten,
+            # which is precisely what the overhead gate forbids; totals
+            # stay exact, per-row splits are approximate under
+            # alternating shapes
+            invoke(self._keys[-1])
+        return out
+
+    def lower(self, *args, **kw):       # introspection passthrough
+        return self._jit.lower(*args, **kw)
+
+
+def metered_jit(fn, donate_argnums=(), kind="jit", label=None):
+    """`jax.jit(fn, donate_argnums=...)` with a cost-registry row per
+    input signature and cumulative invocation counts."""
+    return MeteredJit(fn, donate_argnums=donate_argnums, kind=kind,
+                      label=label)

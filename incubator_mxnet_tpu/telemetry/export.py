@@ -80,9 +80,47 @@ class MetricsExporter:
         return self._c.snapshot(), self._c.latency_snapshot(
             pcts=self._pcts)
 
+    @staticmethod
+    def _escape_label(v):
+        """Prometheus exposition label-value escaping: backslash,
+        double quote and newline (an unescaped one invalidates the
+        WHOLE scrape, not just the line)."""
+        return (str(v).replace("\\", r"\\").replace('"', r"\"")
+                .replace("\n", r"\n"))
+
+    @staticmethod
+    def _cost_lines(prefix):
+        """The executable cost registry (telemetry.costs) as labeled
+        gauge families — flops / bytes-accessed / invocations /
+        compile wall per registered executable (ISSUE 5)."""
+        from . import costs as _costs
+        rows = _costs.table()
+        if not rows:
+            return []
+        lines = []
+        fams = (("executable_flops", "flops"),
+                ("executable_bytes_accessed", "bytes_accessed"),
+                ("executable_invocations", "invocations"),
+                ("executable_compile_seconds", "compile_wall_s"))
+        for fam, field in fams:
+            m = _metric_name(prefix, fam)
+            lines.append("# TYPE %s gauge" % m)
+            for r in rows:
+                # the registry key makes the labelset unique: two
+                # trainers/engines in one process produce rows with
+                # identical kind+label, and duplicate series make the
+                # whole scrape unparseable to Prometheus
+                lines.append('%s{kind="%s",label="%s",key="%d"} %s'
+                             % (m,
+                                MetricsExporter._escape_label(r["kind"]),
+                                MetricsExporter._escape_label(r["label"]),
+                                r["key"], _fmt(r[field])))
+        return lines
+
     def prometheus_text(self) -> str:
         """Prometheus exposition text (version 0.0.4): counters +
-        quantile summaries for every observed sample series."""
+        quantile summaries for every observed sample series, plus the
+        per-executable cost families."""
         counts, lats = self._snapshot()
         # an empty percentile dict (a reset() racing this scrape
         # between the snapshot's name collection and the per-name
@@ -111,14 +149,31 @@ class MetricsExporter:
                 m = _metric_name(self._prefix, name)
                 lines.append("# TYPE %s counter" % m)
                 lines.append("%s %s" % (m, _fmt(counts[name])))
+        if self._c is events:
+            # the cost registry is process-wide state: it accompanies
+            # the process ledger only — an exporter over a custom
+            # EventCounters renders exactly those counters
+            try:
+                lines += self._cost_lines(self._prefix)
+            except Exception:       # noqa: BLE001 — cost attribution
+                pass                # must never break a scrape
         return "\n".join(lines) + "\n"
 
     def json_dict(self) -> dict:
         counts, lats = self._snapshot()
-        return {"ts": time.time(),
-                "uptime_s": round(time.time() - self._t0, 3),
-                "counters": counts,
-                "percentiles": lats}
+        out = {"ts": time.time(),
+               "uptime_s": round(time.time() - self._t0, 3),
+               "counters": counts,
+               "percentiles": lats}
+        if self._c is events:
+            try:
+                from . import costs as _costs
+                block = _costs.snapshot()
+                if block["rows"]:
+                    out["costs"] = block
+            except Exception:       # noqa: BLE001
+                pass
+        return out
 
     def json_text(self) -> str:
         return json.dumps(self.json_dict(), sort_keys=True)
@@ -153,6 +208,15 @@ class MetricsExporter:
                 exp.export_file()
             except Exception:           # noqa: BLE001 — periodic export
                 pass                    # is best-effort, never fatal
+            try:
+                # each export tick also lands a counter-delta sample in
+                # the flight-recorder ring, so a later black-box dump
+                # shows counter FLOW over time, not just final totals
+                from . import flightrec as _bb
+                _bb.sample_counters()
+                _bb.hbm_sample(tag="export")
+            except Exception:           # noqa: BLE001
+                pass
             del exp
 
     def start(self, path=None, period_s=None):

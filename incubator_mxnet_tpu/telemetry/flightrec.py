@@ -1,0 +1,431 @@
+"""Flight recorder: always-on black-box forensics (ISSUE 5 tentpole
+part 1).
+
+PR 4's telemetry is pull-based: a dashboard someone is watching.  When
+a run DIES — NaN rollback, preemption, a serving dispatcher backstop,
+an uncaught exception on a feed thread — nothing durable survives to
+explain it.  This module is the black box: a lock-guarded bounded ring
+of structured events that every subsystem appends to unconditionally
+(step records, span completions, counter-delta samples, checkpoint /
+rollback / fault / preemption markers, feed stalls, serving
+queue-depth samples, HBM watermarks), plus an atomic JSON dump that
+turns the ring + the counter ledger + the executable cost table
+(costs.py) + the config-knob snapshot into ONE self-contained forensic
+file a dead run leaves behind.
+
+Cost model — the recorder is ON BY DEFAULT, so it must be nearly free:
+`record()` is one enabled-check, one tuple build and one deque append
+under a lock; no string formatting, no serialization, nothing until
+dump time.  `MXNET_BLACKBOX=0` reduces every hook to a single bool
+read.
+
+Dump triggers (all end in `dump_blackbox()`):
+
+- NaN-rollback and preemption in `ResilientTrainer`
+- the serving dispatcher's error backstop (`serving/engine.py`)
+- uncaught exceptions: `sys.excepthook` + `threading.excepthook`
+  (a raising feed/dispatcher worker leaves a dump, not silence)
+- `SIGUSR2` — a live-run snapshot without stopping anything
+- an explicit `telemetry.dump_blackbox()`
+
+`install_crash_hooks()` is idempotent and chains the previous hooks;
+`ResilientTrainer`, `InferenceEngine` and `telemetry.start()` install
+them on construction.  `python -m incubator_mxnet_tpu.tools.blackbox
+<dump>` summarizes a dump (timeline tail, counters, cost table, a
+one-line suspected-cause heuristic).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from collections import deque
+
+from .. import config as _cfg
+from ..monitor import events
+
+__all__ = ["enabled", "enable", "record", "ring_snapshot", "clear",
+           "configure", "hbm_sample", "hbm_peaks", "sample_counters",
+           "dump_blackbox", "crash_dump", "install_crash_hooks",
+           "uninstall_crash_hooks", "last_dump_path"]
+
+SCHEMA = "mxtpu-blackbox/1"
+
+_LOCK = threading.Lock()
+_RING = None                    # deque of (ts, tid, kind, name, data)
+_SEQ = itertools.count(1)       # CPython-atomic; dump filename ordinal
+_HBM_PEAK = {}                  # device label -> peak bytes_in_use seen
+_LAST_COUNTS = {}               # sample_counters baseline
+_LAST = {"path": None}          # newest dump path (tests / CLI)
+_CRASH_SEEN = {}                # reason -> last crash_dump wall time
+#: min seconds between crash dumps for the SAME reason — a persistent
+#: dispatcher error loops every ~10ms, and each dump is a full file;
+#: without a throttle a degraded host fills its disk with forensics
+CRASH_DUMP_MIN_GAP_S = 10.0
+
+# None = follow the MXNET_BLACKBOX knob; enable() installs an explicit
+# process-local override (the spans.py pattern)
+_enabled = None
+
+
+def enabled() -> bool:
+    """Whether the flight recorder is on (default: yes — it exists for
+    the runs nobody instrumented in advance)."""
+    if _enabled is not None:
+        return _enabled
+    return bool(_cfg.get("MXNET_BLACKBOX"))
+
+
+def enable(flag=True):
+    """Flip the recorder on/off (None = revert to the MXNET_BLACKBOX
+    knob); returns the previous effective state."""
+    global _enabled
+    prev = enabled()
+    _enabled = None if flag is None else bool(flag)
+    return prev
+
+
+def _ring():
+    global _RING
+    r = _RING
+    if r is None:
+        with _LOCK:
+            if _RING is None:
+                _RING = deque(maxlen=max(
+                    16, int(_cfg.get("MXNET_BLACKBOX_RING"))))
+            r = _RING
+    return r
+
+
+def configure(maxlen=None):
+    """(Re)size the ring (drops retained events).  Tests use this; the
+    default comes from MXNET_BLACKBOX_RING at first use."""
+    global _RING
+    with _LOCK:
+        _RING = deque(maxlen=max(16, int(
+            maxlen if maxlen is not None
+            else _cfg.get("MXNET_BLACKBOX_RING"))))
+
+
+def record(kind: str, name: str, **data):
+    """Append one structured event to the ring.  The HOT path: one
+    bool read disabled; enabled, one tuple + one locked deque append —
+    no formatting, no serialization until dump time."""
+    if not enabled():
+        return
+    ev = (time.time(), threading.get_ident(), kind, name,
+          data or None)
+    _ring()                         # ensure it exists (locks itself)
+    with _LOCK:
+        # re-read under the lock: a concurrent configure() swaps the
+        # ring, and appending to the discarded deque loses the event
+        _RING.append(ev)
+
+
+def clear():
+    with _LOCK:
+        if _RING is not None:
+            _RING.clear()
+        _LAST_COUNTS.clear()
+        _HBM_PEAK.clear()
+        _CRASH_SEEN.clear()
+        _LAST["path"] = None
+
+
+def ring_snapshot(last=None):
+    """The retained events, oldest first, as dicts (`last` keeps only
+    the newest N)."""
+    with _LOCK:
+        evs = list(_RING) if _RING is not None else []
+    if last is not None:
+        evs = evs[-int(last):]
+    out = []
+    for ts, tid, kind, name, data in evs:
+        d = {"ts": ts, "tid": tid % 100000, "kind": kind, "name": name}
+        if data:
+            d.update(data)
+        out.append(d)
+    return out
+
+
+# -- HBM watermarks ----------------------------------------------------
+def hbm_sample(tag="sample", force=False):
+    """Sample per-device HBM via `storage.memory_events` (which posts
+    the `mem.*` series on monitor.events), update the per-device peak
+    watermarks, and append one ring event per device.  Degrades to a
+    no-op (no event, no crash) on backends whose `memory_stats` returns
+    None — the axon plugin (ndarray.py:77).  Gated on `enabled()` (the
+    MXNET_BLACKBOX=0 contract is a single bool read per hook);
+    `force=True` is the dump path, which samples even when an explicit
+    dump was requested on a disarmed recorder."""
+    if not (enabled() or force):
+        return []
+    try:
+        from ..storage import memory_events
+        stats = memory_events()
+    except Exception:               # noqa: BLE001 — forensics must
+        return []                   # never take the run down
+    for s in stats:
+        dev = s["device"]
+        with _LOCK:
+            peak = max(_HBM_PEAK.get(dev, 0),
+                       s.get("peak_bytes", 0), s["bytes_in_use"])
+            _HBM_PEAK[dev] = peak
+        record("hbm", dev, tag=tag, bytes_in_use=s["bytes_in_use"],
+               peak_bytes=peak, bytes_limit=s.get("bytes_limit", 0))
+    return stats
+
+
+def hbm_peaks() -> dict:
+    """{device: peak bytes_in_use observed by hbm_sample}."""
+    with _LOCK:
+        return dict(_HBM_PEAK)
+
+
+# -- counter-delta samples ---------------------------------------------
+def sample_counters(prefixes=None):
+    """Record the nonzero counter DELTAS since the last sample as one
+    ring event (the periodic exporter calls this every tick, so the
+    timeline shows counter flow between dumps, not just the final
+    totals).  Returns the delta dict.  Baseline updates are locked —
+    the exporter worker and a checkpointing training thread sample
+    concurrently, and a racy read-modify-write would double-count or
+    drop deltas in the forensic timeline."""
+    if not enabled():
+        return {}
+    snap = events.snapshot()
+    if prefixes:
+        snap = {k: v for k, v in snap.items()
+                if any(k.startswith(p) for p in prefixes)}
+    delta = {}
+    with _LOCK:
+        for k, v in snap.items():
+            d = v - _LAST_COUNTS.get(k, 0)
+            if d:
+                delta[k] = d
+            _LAST_COUNTS[k] = v
+    if delta:                       # record() takes _LOCK itself —
+        record("counters", "delta", **delta)    # append outside it
+    return delta
+
+
+# -- dump --------------------------------------------------------------
+def _exc_block(exc):
+    if exc is None:
+        return None
+    import traceback
+    try:
+        tb = "".join(traceback.format_exception(
+            type(exc), exc, getattr(exc, "__traceback__", None)))
+    except Exception:               # noqa: BLE001
+        tb = ""
+    return {"type": type(exc).__name__,
+            "message": str(exc)[:500],
+            "traceback": tb[-8000:]}
+
+
+def _config_snapshot():
+    out = {}
+    for name in _cfg.list_vars():
+        try:
+            v = _cfg.get(name)
+            out[name] = v if isinstance(
+                v, (bool, int, float, str, type(None))) else str(v)
+        except Exception:           # noqa: BLE001
+            out[name] = "<unreadable>"
+    return out
+
+
+def _chrome_view(evs):
+    """The event timeline as chrome://tracing JSON: span events render
+    as complete ('X') slices, everything else as instants."""
+    out = []
+    for e in evs:
+        base = {"name": "%s:%s" % (e["kind"], e["name"]),
+                "cat": e["kind"], "pid": os.getpid(), "tid": e["tid"]}
+        dur = e.get("dur_us")
+        if dur is not None:
+            base.update(ph="X", ts=(e["ts"] * 1e6) - dur, dur=dur)
+        else:
+            base.update(ph="i", ts=e["ts"] * 1e6, s="t")
+        args = {k: v for k, v in e.items()
+                if k not in ("ts", "tid", "kind", "name")}
+        if args:
+            base["args"] = args
+        out.append(base)
+    return out
+
+
+def _slug(s):
+    return "".join(c if c.isalnum() or c in "-_." else "-"
+                   for c in str(s))[:48] or "dump"
+
+
+def _resolve_path(path, reason):
+    if path:
+        path = str(path)
+        if not os.path.isdir(path):
+            return path             # explicit file
+        d = path
+    else:
+        d = _cfg.get("MXNET_BLACKBOX_DIR") or os.getcwd()
+        os.makedirs(d, exist_ok=True)
+    name = "blackbox-%s-p%d-%03d-%s.json" % (
+        time.strftime("%Y%m%dT%H%M%S"), os.getpid(), next(_SEQ),
+        _slug(reason))
+    return os.path.join(d, name)
+
+
+def dump_blackbox(path=None, reason="manual", exc=None, last=None):
+    """Write the black box: config-knob snapshot, counter ledger +
+    percentiles, executable cost table, HBM watermarks, the last-N
+    event timeline, and a chrome-trace view of it — one atomic JSON
+    file (tmp + os.replace).  `path` may be a file, a directory, or
+    None (MXNET_BLACKBOX_DIR, else cwd; auto-named).  Returns the
+    written path."""
+    # order matters: snapshot the ledger FIRST, then sample (the
+    # sample's own events land in the timeline of the NEXT dump, and
+    # cost resolution must not skew the counters this dump reports)
+    counters = events.snapshot()
+    pcts = events.latency_snapshot()
+    hbm_sample(tag="dump", force=True)
+    from . import costs as _costs
+    try:
+        cost_block = _costs.snapshot()
+    except Exception:               # noqa: BLE001 — cost attribution
+        cost_block = {"rows": [], "totals": {}}  # is best-effort
+    evs = ring_snapshot(last=last)
+    doc = {
+        "schema": SCHEMA,
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "reason": str(reason),
+        "exception": _exc_block(exc),
+        "config": _config_snapshot(),
+        "counters": counters,
+        "percentiles": pcts,
+        "costs": cost_block,
+        "hbm": {"peaks": hbm_peaks()},
+        "events": evs,
+        "trace": {"traceEvents": _chrome_view(evs),
+                  "displayTimeUnit": "ms"},
+    }
+    path = _resolve_path(path, reason)
+    tmp = "%s.tmp.%d.%d" % (path, os.getpid(), threading.get_ident())
+    with open(tmp, "w") as f:
+        json.dump(doc, f, default=str)
+    os.replace(tmp, path)
+    _LAST["path"] = path
+    events.incr("blackbox.dumps")
+    record("dump", str(reason), path=path)
+    return path
+
+
+def last_dump_path():
+    """The newest dump this process wrote (None before the first)."""
+    return _LAST["path"]
+
+
+def crash_dump(reason, exc=None):
+    """`dump_blackbox` for crash paths: never raises (a failing dump
+    in an excepthook / signal handler / dispatcher backstop must not
+    mask the original failure), and throttled per reason
+    (CRASH_DUMP_MIN_GAP_S) — a persistently-failing dispatcher loop
+    must not fill the disk with one dump per poll.  Returns the path,
+    or None (disabled / throttled / failed)."""
+    if not enabled():
+        return None
+    now = time.monotonic()
+    with _LOCK:
+        last = _CRASH_SEEN.get(reason)
+        if last is not None and now - last < CRASH_DUMP_MIN_GAP_S:
+            return None
+        _CRASH_SEEN[reason] = now
+    try:
+        return dump_blackbox(reason=reason, exc=exc)
+    except Exception:               # noqa: BLE001
+        return None
+
+
+# -- crash hooks -------------------------------------------------------
+_HOOKS = {"installed": False, "prev_sys": None, "prev_thread": None,
+          "prev_sig": None, "sig_installed": False}
+
+
+def install_crash_hooks(sigusr2=True):
+    """Install the black-box triggers: `sys.excepthook` +
+    `threading.excepthook` (CHAINED — the previous hooks still run
+    after the dump) and, on the main thread, a SIGUSR2 handler (which
+    REPLACES any previous one; `uninstall_crash_hooks` restores it).
+    Idempotent, and each trigger arms independently: a first call off
+    the main thread installs the excepthooks only, and a later
+    main-thread call still arms SIGUSR2.  No-op (returns False) when
+    the recorder is disabled."""
+    if not enabled():
+        return False
+    did = False
+    if not _HOOKS["installed"]:
+        prev_sys = sys.excepthook
+        prev_thread = threading.excepthook
+
+        def _sys_hook(tp, val, tb):
+            if not (tp is SystemExit or tp is KeyboardInterrupt):
+                record("fault", "uncaught", where="main",
+                       type=getattr(tp, "__name__", str(tp)))
+                crash_dump("excepthook", val)
+            (prev_sys or sys.__excepthook__)(tp, val, tb)
+
+        def _thread_hook(args):
+            if args.exc_type is not SystemExit:
+                record("fault", "uncaught",
+                       where=getattr(args.thread, "name", "?"),
+                       type=getattr(args.exc_type, "__name__", "?"))
+                crash_dump("threading.excepthook", args.exc_value)
+            prev_thread(args)
+
+        sys.excepthook = _sys_hook
+        threading.excepthook = _thread_hook
+        _HOOKS.update(prev_sys=prev_sys, prev_thread=prev_thread,
+                      installed=True)
+        did = True
+    if sigusr2 and not _HOOKS["sig_installed"] \
+            and hasattr(signal, "SIGUSR2"):
+        def _usr2_work():
+            record("marker", "sigusr2")
+            crash_dump("sigusr2")
+
+        def _on_usr2(signum, frame):
+            # the handler interrupts the main thread BETWEEN bytecodes
+            # — it may hold the ring lock mid-record(), so taking it
+            # here would self-deadlock; hand the dump to a thread
+            threading.Thread(target=_usr2_work, daemon=True,
+                             name="BlackboxUSR2").start()
+        try:
+            _HOOKS["prev_sig"] = signal.signal(signal.SIGUSR2, _on_usr2)
+            _HOOKS["sig_installed"] = True
+            did = True
+        except (ValueError, OSError):   # not the main thread: a later
+            _HOOKS["prev_sig"] = None   # main-thread call retries
+    return did
+
+
+def uninstall_crash_hooks():
+    """Restore the chained hooks (tests; idempotent)."""
+    if not _HOOKS["installed"]:
+        return
+    sys.excepthook = _HOOKS["prev_sys"] or sys.__excepthook__
+    threading.excepthook = _HOOKS["prev_thread"] or \
+        threading.__excepthook__
+    if _HOOKS["sig_installed"]:
+        try:
+            signal.signal(signal.SIGUSR2,
+                          _HOOKS["prev_sig"] or signal.SIG_DFL)
+        except (ValueError, OSError):
+            pass
+        _HOOKS["sig_installed"] = False
+    _HOOKS.update(installed=False, prev_sys=None, prev_thread=None,
+                  prev_sig=None)

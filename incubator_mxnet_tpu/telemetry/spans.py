@@ -24,12 +24,18 @@ dumps (`profiler.add_trace_event`), so `profiler.dump()` renders feed
 transfers, dispatch→infer chains and checkpoint writes on one timeline
 with the op events; trace/span/parent ids ride in each event's `args`.
 
-Cost model: recording requires BOTH `telemetry.enable()` (or
-`MXNET_TELEMETRY=1`) AND a collecting profiler (`set_state("run")`,
-not paused) — the sink is unbounded, so spans must not grow it on
-runs nobody is tracing.  When either switch is off, `span()` returns a
-shared no-op context manager: one bool read and two dict reads on the
-hot path, no allocation.
+Cost model (revised in ISSUE 5): span OBJECTS exist whenever telemetry
+is enabled (`telemetry.enable()` / `MXNET_TELEMETRY=1`); with
+telemetry off, `span()` returns a shared no-op — one bool read, no
+allocation.  A completed span lands in TWO sinks with independent
+gates:
+
+- the profiler's chrome-trace sink, ONLY while the profiler is
+  collecting (`set_state("run")`, not paused — the sink is unbounded,
+  `recording()` reports this gate);
+- the flight-recorder ring (flightrec.py), whenever the recorder is
+  armed — the ring is bounded, so span completions survive into
+  black-box dumps even on runs nobody is tracing.
 """
 from __future__ import annotations
 
@@ -39,6 +45,7 @@ import time
 
 from .. import config as _cfg
 from .. import profiler as _prof
+from . import flightrec as _bb
 
 __all__ = ["SpanContext", "enabled", "enable", "span", "current",
            "recording"]
@@ -71,8 +78,10 @@ def enable(flag=True):
 
 
 def recording() -> bool:
-    """Whether a span opened now would actually be recorded: telemetry
-    enabled AND the profiler collecting (the shared sink's gate)."""
+    """Whether a span completed now would reach the CHROME-TRACE sink:
+    telemetry enabled AND the profiler collecting.  (Ring recording
+    into the flight recorder needs only `enabled()` — see the module
+    docstring.)"""
     return (enabled() and _prof._STATE["running"]
             and not _prof._STATE["paused"])
 
@@ -170,19 +179,30 @@ class _Span:
             st.pop()
         elif self.ctx in st:        # mispaired stop(): drop ours only
             st.remove(self.ctx)
+        dur = time.perf_counter() - t0
         args = {"trace_id": self.ctx.trace_id,
                 "span_id": self.ctx.span_id}
         if self.parent_id is not None:
             args["parent_id"] = self.parent_id
-        _prof.add_trace_event(self.name, "span", t0,
-                              time.perf_counter() - t0, args=args)
+        # chrome sink: add_trace_event self-gates on the profiler state
+        # (a span that STARTED while collecting must not grow the sink
+        # after set_state('stop'))
+        _prof.add_trace_event(self.name, "span", t0, dur, args=args)
+        # flight-recorder ring: bounded, so span completions survive
+        # into black-box dumps with NO profiler running (ISSUE 5) —
+        # record() is one bool read when the recorder is disarmed
+        _bb.record("span", self.name, dur_us=int(dur * 1e6),
+                   trace=self.ctx.trace_id, span=self.ctx.span_id,
+                   parent=self.parent_id)
 
 
 def span(name: str, parent: SpanContext = None):
     """Open a span (use as a context manager, or `.start()`/`.stop()`).
     `parent` joins an existing trace across threads; by default the
     innermost open span on this thread is the parent.  Returns a shared
-    no-op when spans are not being recorded (see module docstring)."""
-    if not recording():
+    no-op when telemetry is disabled; enabled, the completion reaches
+    the chrome sink and/or the flight-recorder ring per their own
+    gates (see module docstring)."""
+    if not enabled():
         return _NULL
     return _Span(name, parent)

@@ -1,0 +1,174 @@
+"""blackbox — summarize a flight-recorder dump (ISSUE 5).
+
+A black-box dump (telemetry.dump_blackbox / the crash hooks) is a
+self-contained forensic JSON: config snapshot, counter ledger,
+executable cost table, HBM watermarks, and the last-N event timeline
+with an embedded chrome-trace view.  This CLI renders the parts an
+operator reads first:
+
+    python -m incubator_mxnet_tpu.tools.blackbox dump.json
+    python -m incubator_mxnet_tpu.tools.blackbox dump.json --events 80
+    python -m incubator_mxnet_tpu.tools.blackbox dump.json \
+        --trace out.trace.json      # extract the chrome-trace view
+
+Sections: header (reason / pid / exception), the timeline tail, the
+nonzero counters, the cost table (per-executable FLOPs / bytes /
+invocations / compile wall), HBM peaks, and ONE suspected-cause line —
+a heuristic ranking of what the evidence points at.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from .teletop import _fmt_qty
+
+__all__ = ["load_dump", "render", "suspected_cause", "main"]
+
+
+def load_dump(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema", "").split("/")[0] != "mxtpu-blackbox":
+        raise ValueError("%s is not a black-box dump (schema=%r)"
+                         % (path, doc.get("schema")))
+    return doc
+
+
+def suspected_cause(doc: dict) -> str:
+    """One line: what the evidence points at, strongest signal first.
+    A heuristic, not a verdict — the timeline is the ground truth."""
+    c = doc.get("counters", {})
+    kinds = [e.get("kind") for e in doc.get("events", [])]
+    exc = doc.get("exception")
+    reason = doc.get("reason", "")
+    if exc:
+        return ("uncaught %s: %s" % (exc.get("type"),
+                                     (exc.get("message") or "")[:120]))
+    if "preempt" in kinds or reason == "preemption":
+        extra = " after earlier rollback(s)" if "rollback" in kinds \
+            else ""
+        return "preemption (SIGTERM) — checkpointed and resumable%s" \
+            % extra
+    if "rollback" in kinds or reason == "rollback":
+        return ("numeric instability: %d step(s) skipped "
+                "(non-finite/spiking loss) forced a rollback"
+                % c.get("resilience.step_skipped", 0))
+    if c.get("serve.dispatcher_errors"):
+        return ("serving dispatcher backstop fired %d time(s) — an "
+                "exception escaped batch execution"
+                % c["serve.dispatcher_errors"])
+    if c.get("resilience.step_skipped"):
+        return ("%d training step(s) skipped on non-finite/spiking "
+                "loss (below the rollback threshold)"
+                % c["resilience.step_skipped"])
+    stall, step = c.get("feed.stall_us", 0), c.get("feed.step_us", 0)
+    if stall and step and stall > step:
+        return ("input-pipeline starvation: feed stalls (%.1fs) exceed "
+                "compute wall between batches" % (stall / 1e6))
+    stale = c.get("aot.stale", 0) + c.get("aot.miss", 0)
+    if stale and stale > 2 * max(1, c.get("aot.hit", 0)):
+        return ("recompile storm: %d compile/stale executable-cache "
+                "events vs %d hits" % (stale, c.get("aot.hit", 0)))
+    if c.get("serve.deadline_expired"):
+        return ("serving overload: %d request(s) expired in queue"
+                % c["serve.deadline_expired"])
+    if reason == "sigusr2":
+        return "operator-requested snapshot (SIGUSR2) — no failure"
+    return "no anomaly detected by the heuristics; read the timeline"
+
+
+def render(doc: dict, events_tail=40) -> str:
+    lines = []
+    head = "blackbox — reason=%s pid=%s %s" % (
+        doc.get("reason"), doc.get("pid"),
+        time.strftime("%Y-%m-%d %H:%M:%S",
+                      time.localtime(doc.get("ts", 0))))
+    lines += [head, "=" * len(head)]
+    exc = doc.get("exception")
+    if exc:
+        lines.append("exception: %s: %s"
+                     % (exc.get("type"), (exc.get("message") or "")[:200]))
+
+    evs = doc.get("events", [])
+    tail = evs[-int(events_tail):]
+    lines += ["", "timeline (last %d of %d events)"
+              % (len(tail), len(evs)), "-" * 46]
+    t_end = doc.get("ts", 0)
+    for e in tail:
+        extra = " ".join(
+            "%s=%s" % (k, e[k]) for k in sorted(e)
+            if k not in ("ts", "tid", "kind", "name"))
+        lines.append("%+9.3fs %6s %-10s %-24s %s"
+                     % (e.get("ts", 0) - t_end, "t%d" % e.get("tid", 0),
+                        e.get("kind", "?"), e.get("name", "?"),
+                        extra[:60]))
+
+    counters = {k: v for k, v in doc.get("counters", {}).items() if v}
+    if counters:
+        lines += ["", "counters (nonzero)", "-" * 18]
+        for k in sorted(counters):
+            lines.append("%-36s %14d" % (k, counters[k]))
+
+    rows = doc.get("costs", {}).get("rows", [])
+    if rows:
+        lines += ["", "cost table (per executable)", "-" * 27,
+                  "%-6s %-28s %7s %10s %10s %9s" %
+                  ("kind", "label", "calls", "flops", "bytes",
+                   "compile_s")]
+        for r in rows[:20]:
+            lines.append("%-6s %-28s %7d %10s %10s %9.2f"
+                         % (r.get("kind", "?")[:6],
+                            r.get("label", "?")[:28],
+                            r.get("invocations", 0),
+                            _fmt_qty(r.get("flops", 0)),
+                            _fmt_qty(r.get("bytes_accessed", 0), "B"),
+                            r.get("compile_wall_s", 0)))
+        t = doc.get("costs", {}).get("totals", {})
+        if t:
+            lines.append("TOTAL  %-28s %7d %10s %10s %9.2f"
+                         % ("(cumulative)", t.get("invocations", 0),
+                            _fmt_qty(t.get("cum_flops", 0)),
+                            _fmt_qty(t.get("cum_bytes", 0), "B"),
+                            t.get("compile_wall_s", 0)))
+
+    peaks = doc.get("hbm", {}).get("peaks", {})
+    if peaks:
+        lines += ["", "hbm peaks", "-" * 9]
+        for dev in sorted(peaks):
+            lines.append("%-24s %s" % (dev, _fmt_qty(peaks[dev], "B")))
+
+    lines += ["", "suspected cause: " + suspected_cause(doc)]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="blackbox",
+        description="summarize a flight-recorder black-box dump")
+    ap.add_argument("dump", help="black-box dump JSON path")
+    ap.add_argument("--events", type=int, default=40, metavar="N",
+                    help="timeline tail length (default 40)")
+    ap.add_argument("--trace", metavar="OUT",
+                    help="also extract the embedded chrome-trace view "
+                    "to OUT (open in Perfetto / chrome://tracing)")
+    args = ap.parse_args(argv)
+    try:
+        doc = load_dump(args.dump)
+    except Exception as e:          # noqa: BLE001 — operator tool
+        print("blackbox: cannot read %s: %s" % (args.dump, e),
+              file=sys.stderr)
+        return 1
+    if args.trace:
+        with open(args.trace, "w") as f:
+            json.dump(doc.get("trace", {"traceEvents": []}), f)
+        print("chrome trace written to %s" % args.trace,
+              file=sys.stderr)
+    print(render(doc, events_tail=args.events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,7 +85,49 @@ def _derived(c):
     if req or rej:
         out.append(("serve rejected", "%d (%.2f%% of %d accepted+rej)"
                     % (rej, _ratio(rej, req + rej) or 0.0, req + rej)))
+    if c.get("blackbox.dumps"):
+        out.append(("blackbox dumps", "%d written this process"
+                    % c["blackbox.dumps"]))
     return out
+
+
+def _fmt_qty(v, unit=""):
+    v = float(v)
+    for mag, suf in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(v) >= mag:
+            return "%.2f%s%s" % (v / mag, suf, unit)
+    return "%g%s" % (v, unit)
+
+
+def _cost_lines(costs):
+    """The executable cost block (ISSUE 5) as table lines: per-row
+    kind/label/calls/flops/bytes/compile columns plus the totals."""
+    rows = costs.get("rows", [])
+    if not rows and not costs.get("totals"):
+        return []
+    lines = ["", "%-6s %-28s %8s %10s %10s %9s"
+             % ("kind", "executable", "calls", "flops", "bytes",
+                "compile_s"), "-" * 78]
+    for r in rows[:15]:
+        lines.append("%-6s %-28s %8d %10s %10s %9.2f"
+                     % (str(r.get("kind", "?"))[:6],
+                        str(r.get("label", "?"))[:28],
+                        r.get("invocations", 0),
+                        _fmt_qty(r.get("flops", 0)),
+                        _fmt_qty(r.get("bytes_accessed", 0), "B"),
+                        r.get("compile_wall_s", 0)))
+    t = costs.get("totals", {})
+    if t:
+        lines.append("TOTAL  %-28s %8d %10s %10s %9.2f"
+                     % ("(cumulative)", t.get("invocations", 0),
+                        _fmt_qty(t.get("cum_flops", 0)),
+                        _fmt_qty(t.get("cum_bytes", 0), "B"),
+                        t.get("compile_wall_s", 0)))
+        if t.get("hbm_peak_bytes"):
+            lines.append("%-35s %s" % ("hbm peak",
+                                       _fmt_qty(t["hbm_peak_bytes"],
+                                                "B")))
+    return lines
 
 
 def render(snap: dict, prefix: str = "") -> str:
@@ -121,6 +163,13 @@ def render(snap: dict, prefix: str = "") -> str:
             lines.append("%-36s %8d %s %s %s"
                          % (name, p.get("n", 0), fmt("p50"),
                             fmt("p90"), fmt("p99")))
+
+    costs = snap.get("costs")
+    if isinstance(costs, dict):
+        # a bench "telemetry" block carries totals only; a full
+        # exporter snapshot carries rows+totals — render what's there
+        lines += _cost_lines(costs if "rows" in costs
+                             else {"rows": [], "totals": costs})
 
     derived = _derived(snap.get("counters", {}))
     if derived:

@@ -6,6 +6,15 @@ per backend; distributed tests fake multi-chip as 8 virtual host devices
 Set MXNET_TEST_DEVICE=tpu to run the corpus against a real chip.
 """
 import os
+import tempfile
+
+# black-box dumps from fault-injection/backstop tests are real (the
+# triggers fire for real) — they must land in a scratch dir, not the
+# repo checkout the corpus runs from (mkdtemp only when the operator
+# hasn't pointed the dir somewhere already)
+if "MXNET_BLACKBOX_DIR" not in os.environ:
+    os.environ["MXNET_BLACKBOX_DIR"] = \
+        tempfile.mkdtemp(prefix="mxtpu-blackbox-")
 
 # must happen before jax backend initialisation
 if os.environ.get("MXNET_TEST_DEVICE", "cpu") == "cpu":
@@ -54,6 +63,13 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "telemetry: observability-layer tests (CPU-fast, "
         "run in tier-1 by default)")
+    # the flight-recorder / black-box suite (ring, dump triggers, cost
+    # registry, blackbox CLI) is CPU-fast and runs in tier-1 by
+    # default; the marker lets it be selected or excluded explicitly
+    # (pytest -m blackbox)
+    config.addinivalue_line(
+        "markers", "blackbox: flight-recorder forensics tests "
+        "(CPU-fast, run in tier-1 by default)")
 
 
 @pytest.fixture(autouse=True)

@@ -54,13 +54,25 @@ def test_span_disabled_is_noop():
 
 def test_span_requires_profiler_too(tmp_path):
     """Enabled telemetry without a collecting profiler must not grow
-    the (unbounded) chrome sink."""
+    the (unbounded) chrome sink — but the span itself is real now
+    (ISSUE 5): its completion lands in the bounded flight-recorder
+    ring instead, so black-box dumps see spans on untraced runs."""
+    from incubator_mxnet_tpu.telemetry import flightrec
+    profiler.set_config(filename=str(tmp_path / "trace.json"))
     prev = telemetry.enable(True)
+    prev_bb = flightrec.enable(True)
+    flightrec.clear()
     try:
-        assert not telemetry.recording()
-        assert telemetry.span("x") is telemetry.span("y")
+        assert not telemetry.recording()    # chrome-sink gate closed
+        with telemetry.span("tele.ringonly"):
+            assert telemetry.current() is not None
+        assert not _dumped_spans("tele.ringonly")   # sink untouched
+        assert any(e["kind"] == "span" and e["name"] == "tele.ringonly"
+                   for e in flightrec.ring_snapshot())
     finally:
         telemetry.enable(prev)
+        flightrec.enable(prev_bb)
+        flightrec.clear()
 
 
 def test_span_parent_propagation_across_thread(tele_on):

@@ -1,0 +1,109 @@
+"""check_overhead — CI gate for the flight recorder's hot-path cost.
+
+The recorder (telemetry/flightrec.py + telemetry/costs.py) is ON BY
+DEFAULT, which is only defensible if it is nearly free.  This script
+runs the same short synthetic train loop twice — recorder on vs
+recorder off (`flightrec.enable()`, the MXNET_BLACKBOX switch) — and
+exits nonzero when the measured overhead exceeds the threshold
+(default 2%).
+
+    JAX_PLATFORMS=cpu python tools/check_overhead.py
+    python tools/check_overhead.py --steps 200 --threshold 2.0
+
+Methodology: each mode gets its own freshly-built trainer (so compile
+cost is identical and excluded by warmup), modes run interleaved
+off/on/off/on, and the BEST wall per mode is compared — min-of-k is
+the standard noise-robust estimator for "what does the code cost when
+the machine isn't doing something else".  Wired as a `slow`-marked
+test (tests/python/unittest/test_blackbox.py), so tier-1 skips it but
+CI can run it.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+# runnable as `python tools/check_overhead.py` from anywhere: the repo
+# root (this file's parent's parent) must be importable
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def _build(hidden, batch, in_dim=64, classes=10, seed=11):
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, nd, parallel
+    mx.random.seed(seed)
+    net = gluon.nn.HybridSequential(prefix="ov_")
+    net.add(gluon.nn.Dense(hidden, in_units=in_dim, activation="relu",
+                           prefix="ov_d1_"),
+            gluon.nn.Dense(hidden, in_units=hidden, activation="relu",
+                           prefix="ov_d2_"),
+            gluon.nn.Dense(classes, in_units=hidden, prefix="ov_d3_"))
+    net.initialize(force_reinit=True)
+    net(nd.ones((2, in_dim)))
+    tr = parallel.ShardedTrainer(net, optimizer="sgd", lr=1e-2)
+    rs = np.random.RandomState(seed)
+    x = rs.randn(batch, in_dim).astype(np.float32)
+    y = rs.randint(0, classes, batch)
+    return tr, x, y
+
+
+def _timed_loop(recorder_on, steps, warmup, hidden, batch):
+    from incubator_mxnet_tpu.telemetry import flightrec
+    prev = flightrec.enable(bool(recorder_on))
+    try:
+        tr, x, y = _build(hidden, batch)
+        for _ in range(max(1, warmup)):     # ≥1: the compile must land
+            loss = tr.step(x, y)            # outside the timed window
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = tr.step(x, y)
+        float(loss)                  # async dispatch: block on the tail
+        return time.perf_counter() - t0
+    finally:
+        flightrec.enable(prev)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="check_overhead",
+        description="fail (rc!=0) when the flight recorder costs more "
+        "than --threshold %% on a synthetic train loop")
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--warmup", type=int, default=10)
+    ap.add_argument("--repeats", type=int, default=2,
+                    help="interleaved off/on pairs; best wall per mode "
+                    "is compared")
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--threshold", type=float, default=2.0,
+                    help="max tolerated overhead percent")
+    args = ap.parse_args(argv)
+
+    best = {False: float("inf"), True: float("inf")}
+    for r in range(args.repeats):
+        for mode in (False, True):
+            wall = _timed_loop(mode, args.steps, args.warmup,
+                               args.hidden, args.batch)
+            best[mode] = min(best[mode], wall)
+            print("round %d recorder=%-5s wall=%.3fs (%.0f steps/s)"
+                  % (r, mode, wall, args.steps / wall))
+    overhead = 100.0 * (best[True] - best[False]) / best[False]
+    print("best off=%.3fs on=%.3fs overhead=%.2f%% (threshold %.2f%%)"
+          % (best[False], best[True], overhead, args.threshold))
+    if overhead > args.threshold:
+        print("FAIL: flight-recorder overhead above threshold",
+              file=sys.stderr)
+        return 1
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())
