@@ -141,25 +141,23 @@ def run_cachedop(batch=128, warmup=3, iters=16, extra=None):
     extra["resnet50_spread_pct"] = round(
         100.0 * (rates[-1] - rates[0]) / rate, 2)
 
-    # ---- end-to-end: same train step, inputs from the native pipeline
-    # through the async device feed (ISSUE 2): uint8 on the wire (4x
-    # fewer tunnel bytes), the NEXT batch's H2D overlapped with the
-    # current step by a background transfer thread, mean/std+cast fused
-    # INTO the step executable (HybridBlock.set_input_transform) ----
+    # ---- end-to-end: same train step, inputs from the multi-process
+    # decode service through the async device feed (ISSUE 6 on top of
+    # ISSUE 2): worker processes decode into shared-memory slabs, the
+    # feed device_puts the slab views directly — uint8 end-to-end (4x
+    # fewer tunnel bytes), NEXT batch's H2D overlapped with the current
+    # step, mean/std+cast fused INTO the step executable
+    # (HybridBlock.set_input_transform) ----
+    svc = None
     try:
-        from incubator_mxnet_tpu.io import native
         from incubator_mxnet_tpu.io.device_feed import (
             DeviceFeed, feed_counters, normalize_transform)
+        from incubator_mxnet_tpu.io.decode_service import (
+            DecodeService, DecodeServiceUnavailable)
         from incubator_mxnet_tpu import config as _cfg
-        if not native.available():
-            raise RuntimeError("native io unavailable")
         path = _ensure_rec()
         wire = _cfg.get("MXNET_FEED_WIRE_DTYPE")        # default uint8
         depth = _cfg.get("MXNET_FEED_DEPTH")
-        reader = native.NativeImageRecordReader(
-            path, batch_size=batch, data_shape=(3, 224, 224),
-            resize=256, rand_crop=True, rand_mirror=True, shuffle=True,
-            dtype=wire)
         # H2D bandwidth probe: on this backend the chip sits behind a
         # network tunnel, so per-batch input transfer — not decode, not
         # compute — can bound the e2e rate.  Reported so the e2e number
@@ -170,15 +168,51 @@ def run_cachedop(batch=128, warmup=3, iters=16, extra=None):
         h2d = probe.nbytes / (time.perf_counter() - t0)
         extra["h2d_bytes_per_sec"] = round(h2d, 0)
 
-        # reader labels are (batch, label_width): flatten host-side in
-        # the feed worker to the (batch,) the compiled loss expects
-        def _host_labels(b):
-            data, label = b
-            return data, (label.reshape(label.shape[0], -1)[:, 0]
-                          .astype(np.float32) % 1000)
+        # the knob is authoritative when SET (0 = disabled → native
+        # fallback, per its registered doc); only unset means auto
+        io_workers = (int(_cfg.get("MXNET_IO_WORKERS"))
+                      if "MXNET_IO_WORKERS" in os.environ
+                      else min(4, os.cpu_count() or 1))
+        try:
+            if io_workers < 1:
+                raise DecodeServiceUnavailable(
+                    "MXNET_IO_WORKERS=0: decode service disabled")
+            svc = DecodeService(
+                path, batch, (3, 224, 224), workers=io_workers,
+                resize=256, rand_crop=True, rand_mirror=True,
+                shuffle=True, dtype=wire)
+            svc.reset()         # bring the pool up (or fall back) NOW
+            extra["resnet50_e2e_io_backend"] = "decode_service"
+            extra["resnet50_e2e_io_workers"] = svc.workers
 
-        feed = DeviceFeed(reader, ctx=ctx, depth=depth,
-                          transform=_host_labels)
+            def _epoch():
+                # slab views go straight into the feed's device_put;
+                # labels flatten to the (batch,) the compiled loss
+                # expects (slab labels are (count, label_width))
+                for sb in svc:
+                    yield sb.data, (sb.label[:, 0] % 1000)
+
+            feed = DeviceFeed(_epoch, ctx=ctx, depth=depth)
+        except DecodeServiceUnavailable:
+            # sandboxed host: native C++ threaded reader (PR 2 path)
+            from incubator_mxnet_tpu.io import native
+            if not native.available():
+                raise RuntimeError("decode service and native io both "
+                                   "unavailable")
+            reader = native.NativeImageRecordReader(
+                path, batch_size=batch, data_shape=(3, 224, 224),
+                resize=256, rand_crop=True, rand_mirror=True,
+                shuffle=True, dtype=wire)
+            extra["resnet50_e2e_io_backend"] = "native"
+            extra["resnet50_e2e_io_workers"] = 0
+
+            def _host_labels(b):
+                data, label = b
+                return data, (label.reshape(label.shape[0], -1)[:, 0]
+                              .astype(np.float32) % 1000)
+
+            feed = DeviceFeed(reader, ctx=ctx, depth=depth,
+                              transform=_host_labels)
         # wire→bf16 (x-127.5)/64 runs ON DEVICE inside the fused step
         # (a host-side ml_dtypes convert is a single-core C loop,
         # measured ~12x slower than the whole train step); the reader
@@ -222,6 +256,9 @@ def run_cachedop(batch=128, warmup=3, iters=16, extra=None):
             k: v - c0.get(k, 0) for k, v in feed_counters().items()}
     except Exception as e:
         extra["resnet50_e2e_error"] = str(e)[:120]
+    finally:
+        if svc is not None:
+            svc.close()             # stop the worker pool + free shm
     return rate
 
 
@@ -834,25 +871,88 @@ def run_quality(epochs=8, batch=256, train_n=5120, eval_n=1024,
 
 
 def run_io(batch=128):
-    """Input-pipeline-only throughput: native C++ RecordIO+JPEG pipeline
-    (src/io/recordio_pipeline.cc), images/sec/host-core — SURVEY §2.4
-    "must sustain v5e input rates".  Scales ~linearly with host cores;
-    this VM exposes os.cpu_count() of them (see PROFILE.md for the
-    thread-scaling curve)."""
+    """Input-pipeline-only throughput on the multi-process decode
+    service (io/decode_service.py): sharded RecordIO readers → worker-
+    process decode → shared-memory slab ring, uint8 slabs (the e2e
+    wire format) — SURVEY §2.4 "must sustain v5e input rates".
+
+    Sweeps worker counts (1 → min(4, cores)) and reports the decode
+    parallelism ACTUALLY in effect as `io_host_cores` — the old code
+    emitted os.cpu_count() regardless of what the pipeline used, which
+    made r3-vs-r4 rounds incomparable (r3's 864.7 really ran multiple
+    decode threads; r4's 399.9 ran one).  Hosts without shared memory
+    fall back to the native C++ reader (`io_backend` says which)."""
+    from incubator_mxnet_tpu import config as _cfg
+    from incubator_mxnet_tpu.io.decode_service import (
+        DecodeService, DecodeServiceUnavailable)
+    path = _ensure_rec()
+    cpu = os.cpu_count() or 1
+    # the knob is authoritative when SET: 0 disables the service
+    # (native fallback below), N joins the sweep so the configured
+    # count is actually measured
+    cfg_w = (int(_cfg.get("MXNET_IO_WORKERS"))
+             if "MXNET_IO_WORKERS" in os.environ else None)
+    try:
+        if cfg_w is not None and cfg_w < 1:
+            raise DecodeServiceUnavailable(
+                "MXNET_IO_WORKERS=0: decode service disabled")
+        counts = {1, min(2, cpu), min(4, cpu)}
+        if cfg_w:
+            counts.add(cfg_w)
+        sweep = {}
+        best_w, best_rates = 0, [0.0]
+        for w in sorted(counts):
+            svc = DecodeService(
+                path, batch, (3, 224, 224), workers=w, resize=256,
+                rand_crop=True, rand_mirror=True, shuffle=True,
+                dtype="uint8")
+            try:
+                for _ in svc:       # warm epoch (page cache, workers)
+                    pass
+                # median of 3 one-epoch windows (the resnet headline's
+                # variance discipline)
+                rates = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    n = 0
+                    for sb in svc:
+                        n += sb.count
+                    rates.append(n / (time.perf_counter() - t0))
+                rates.sort()
+                sweep[str(w)] = round(rates[1], 1)
+                if rates[1] > best_rates[len(best_rates) // 2]:
+                    best_w, best_rates = w, rates
+            finally:
+                svc.close()
+        rate = best_rates[len(best_rates) // 2]
+        out = {"io_pipeline_images_per_sec": round(rate, 1),
+               "io_spread_pct": round(
+                   100.0 * (best_rates[-1] - best_rates[0]) / rate, 2),
+               # the decode worker count the headline number actually
+               # used — NOT os.cpu_count()
+               "io_host_cores": best_w,
+               "io_worker_sweep": sweep,
+               "io_backend": "decode_service"}
+        if len(sweep) > 1:
+            lo, hi = min(sweep, key=int), max(sweep, key=int)
+            out["io_worker_scaling"] = round(
+                sweep[hi] / max(sweep[lo], 1e-9), 2)
+        return out
+    except DecodeServiceUnavailable:
+        pass
+    # sandboxed host: native C++ threaded reader
     from incubator_mxnet_tpu.io import native
     if not native.available():
-        raise RuntimeError("native io unavailable")
-    path = _ensure_rec()
+        raise RuntimeError("decode service and native io both "
+                           "unavailable")
+    nthreads = min(cpu, 16)
     r = native.NativeImageRecordReader(
         path, batch_size=batch, data_shape=(3, 224, 224), resize=256,
-        rand_crop=True, rand_mirror=True, shuffle=True)
+        rand_crop=True, rand_mirror=True, shuffle=True,
+        num_threads=nthreads)
     for _ in r:     # warm epoch
         pass
     r.reset()
-    # median of 3 one-epoch windows (same variance discipline as the
-    # resnet headline).  NOTE the rate scales ~linearly with host cores
-    # — compare rounds via io_host_cores (r3's 864.7 was a multi-core
-    # host; r4's 399.9 ran with os.cpu_count()==1)
     rates = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -862,7 +962,11 @@ def run_io(batch=128):
         r.reset()
         rates.append(n / (time.perf_counter() - t0))
     rates.sort()
-    return rates[1], round(100.0 * (rates[-1] - rates[0]) / rates[1], 2)
+    return {"io_pipeline_images_per_sec": round(rates[1], 1),
+            "io_spread_pct": round(
+                100.0 * (rates[-1] - rates[0]) / rates[1], 2),
+            "io_host_cores": nthreads,      # decode threads in effect
+            "io_backend": "native"}
 
 
 def _free_device_memory():
@@ -1005,10 +1109,9 @@ def _cfg_simple(key, fn, batches, const=None, batch_key=None,
 
 
 def _cfg_io():
-    rate, spread = run_io()
-    return {"io_pipeline_images_per_sec": round(rate, 1),
-            "io_spread_pct": spread,
-            "io_host_cores": os.cpu_count()}
+    # run_io reports io_host_cores as the decode worker count actually
+    # in effect (not os.cpu_count() — ISSUE 6 satellite)
+    return run_io()
 
 
 def _cfg_serve():

@@ -226,6 +226,22 @@ register("MXNET_KVSTORE_BARRIER_TIMEOUT", float, 300.0,
          "DistKVStore barrier timeout in seconds: a worker stuck at a "
          "barrier raises a clear rank-tagged error instead of hanging "
          "the job forever (0 = wait indefinitely)")
+register("MXNET_IO_WORKERS", int, 0,
+         "Multi-process decode service (io.decode_service): worker "
+         "PROCESSES behind ImageRecordIter(workers=) and the bench io/"
+         "e2e configs — GIL-free decode over sharded RecordIO readers "
+         "into a shared-memory slab ring. 0 = disabled (the legacy "
+         "threaded/native pipeline)")
+register("MXNET_IO_RING_SLOTS", int, 0,
+         "Decode-service shared-memory ring size in batch slabs "
+         "(shared by all workers; each slab is one full batch). "
+         "0 = auto (2*workers + 2)")
+register("MXNET_IO_MP_START", str, "fork",
+         "Decode-service process start method. 'fork' is the fast "
+         "default (workers are jax-free by design, so forking a "
+         "jax-initialized parent is safe); 'spawn' pays a fresh "
+         "interpreter + package import per worker",
+         choices=("fork", "spawn", "forkserver"))
 register("MXNET_FEED_DEPTH", int, 2,
          "DeviceFeed (io.device_feed) prefetch depth: batches in flight "
          "between the background transfer thread and the consumer "

@@ -1,0 +1,824 @@
+"""Multi-process decode service: the shared-memory data plane (ISSUE 6
+tentpole).
+
+BENCH_r05 measured the north-star ResNet-50 at 2261 im/s/chip synthetic
+but 134 im/s input-fed — `resnet50_e2e_fraction_of_synthetic` = 0.059 —
+with ALL decode on a single host core.  PR 2's DeviceFeed overlapped
+the H2D transfer; the decode side stayed a single-threaded Python
+pipeline (the GIL serializes the PIL threadpool, and the native C++
+reader is an optional build).  This module is the production decode
+plane underneath `ImageRecordIter(workers=N)` and `DeviceFeed`:
+
+1. **True processes.**  A `DecodeService` pool of N worker PROCESSES —
+   GIL-free parallel decode even without the native reader.  Workers
+   are STRICTLY jax-free (numpy + PIL + the recordio framing only;
+   `_resize_linear` exists because the gluon `_resize_np` goes through
+   jax.image): a forked child that calls into the parent's initialized
+   XLA runtime deadlocks in backend_compile — measured, not
+   hypothetical.  A startup handshake backstops the residual
+   fork-with-threads risk: a pool whose workers never report ready is
+   declared unavailable and the caller degrades, it does not hang.
+2. **Sharded readers.**  Each worker owns a disjoint, deterministic
+   shard of the record keyspace per epoch (`shard_records`): every
+   worker computes the SAME seeded permutation for (seed, epoch) and
+   takes a strided slice of its batch-sized BLOCKS — exact-once
+   coverage per epoch with zero coordination, and at most one partial
+   batch per epoch pool-wide (steps-per-epoch do not depend on the
+   worker count).  Indexed (.idx) and plain .rec files partition the
+   same way: the parent resolves a byte offset per record
+   (`recordio.list_record_offsets` for plain files) and workers seek
+   independently on their own file handles.
+3. **Shared-memory slab ring.**  Batches land in pre-allocated
+   uint8/float32 slabs inside ONE `multiprocessing.shared_memory`
+   segment.  The queues carry slot numbers, never pixels: the hot
+   path does zero per-batch pickling and zero copies — the consumer
+   hands the slab view straight to `DeviceFeed`'s `device_put`
+   (uint8 stays the wire format end-to-end; mean/std + cast run on
+   device via `set_input_transform` / `make_normalizer`).
+
+Slab lifetime: `DecodeService.__next__` recycles the PREVIOUS batch's
+slot when it is called — by which point every consumer in this repo
+(the feed worker places batch N before pulling N+1; the sync path
+copies into an NDArray immediately) is done with the view.  Holders
+that need a slab longer call `SlabBatch.release()` explicitly when
+done (idempotent) and copy what they keep.
+
+Observability (`monitor.events` + the flight-recorder ring):
+
+    io.decode.batches / records / bytes    volume
+    io.decode.wait_us                      consumer wait on the ring
+    io.decode.queue_depth                  ready-batch gauge (observe)
+    io.decode.epochs                       epochs announced
+
+A consumer wait above 1 ms lands a `("io", "stall")` event with the
+queue depth in the black-box ring, so a dump attributes starvation to
+decode (depth 0 here) vs wire/H2D (`feed.stall` with depth 0 there).
+
+Degradation: hosts where shared memory or process spawn is unavailable
+(sandboxes) raise `DecodeServiceUnavailable` from the constructor;
+`ImageRecordIter` catches it, warns ONCE, and continues on the legacy
+threaded pipeline — an existing call site never crashes.
+"""
+from __future__ import annotations
+
+import os
+import queue as _queue
+import threading
+import time
+import warnings
+
+import numpy as _np
+
+from .. import config as _cfg
+from ..monitor import events
+from .recordio import (idx_sidecar_path, list_record_offsets,
+                       read_record, unpack_img)
+
+__all__ = ["DecodeService", "DecodeServiceUnavailable", "SlabBatch",
+           "shard_records", "decode_record", "service_available"]
+
+#: consumer waits above this land in the flight-recorder ring (same
+#: threshold as DeviceFeed's feed.stall events)
+_STALL_RECORD_US = 1000
+
+#: parent-side timeout ceiling for a wedged pool (a worker that dies
+#: without a sentinel must surface as an error, not a hang)
+_DRAIN_TIMEOUT_S = 30.0
+
+#: steady-state pull deadline: seconds without ANY worker message
+#: before the consumer declares the pool wedged — a child that posted
+#: "ready" but then deadlocked (inherited-lock fork hazard, module
+#: docstring) is alive, so the dead-worker check never fires; generous
+#: because one slot may legitimately take seconds (cold page cache,
+#: network filesystems)
+_PULL_TIMEOUT_S = 120.0
+
+#: seconds each worker gets to post its startup-handshake "ready" —
+#: past this the pool is declared unavailable (→ threaded fallback)
+_READY_TIMEOUT_S = 20.0
+
+
+class DecodeServiceUnavailable(RuntimeError):
+    """Shared memory / process spawn unavailable on this host; callers
+    fall back to the threaded pipeline."""
+
+
+# ---------------------------------------------------------------------------
+# shard partitioning — pure, deterministic, coordination-free
+# ---------------------------------------------------------------------------
+
+def shard_records(n, num_shards, shard_id, epoch=0, shuffle=False,
+                  seed=0, batch_size=None):
+    """Indices (into the canonical record order) owned by `shard_id`
+    for `epoch`.
+
+    Every shard computes the SAME global permutation for
+    (seed, epoch) — `RandomState` shuffle is bit-deterministic across
+    platforms — then takes its slice with no inter-worker
+    communication: the shards are disjoint and their union is exactly
+    `range(n)`.  `shuffle=False` keeps the identity order.
+
+    `batch_size=None` slices record-strided (`order[shard_id::N]`).
+    With `batch_size=B` the permutation is cut into contiguous
+    B-sized blocks and the BLOCKS are strided across shards, so every
+    worker emits whole batches and only the worker owning the final
+    (short) block emits a partial one — at most ONE ragged batch per
+    epoch pool-wide, matching the single-reader pipelines, instead of
+    one per worker.  Steps-per-epoch therefore do not change with the
+    worker count."""
+    if not 0 <= shard_id < num_shards:
+        raise ValueError("shard_id %d not in [0, %d)"
+                         % (shard_id, num_shards))
+    order = _np.arange(n, dtype=_np.int64)
+    if shuffle:
+        rs = _np.random.RandomState(
+            (int(seed) * 1000003 + int(epoch)) % (2 ** 31 - 1))
+        rs.shuffle(order)
+    if batch_size is None:
+        return order[shard_id::num_shards]
+    b = int(batch_size)
+    if b <= 0:
+        raise ValueError("batch_size must be positive")
+    blocks = [order[s:s + b]
+              for s in range(shard_id * b, n, num_shards * b)]
+    return _np.concatenate(blocks) if blocks else order[:0]
+
+
+# ---------------------------------------------------------------------------
+# decode + augment — shared by the worker processes and the threaded
+# ImageRecordIter path (one decode semantics, two execution engines)
+# ---------------------------------------------------------------------------
+
+def _axis_resize(a, n_out, axis):
+    """Triangle-filter resample of one axis (the jax.image.resize
+    'linear' semantics: half-pixel centers, antialiased when
+    downscaling, edge weights renormalized) as a banded gather —
+    a few vectorized adds, NO BLAS: a tensordot here fans out into
+    the multithreaded BLAS pool, and one worker quietly eating every
+    host core defeats the whole point of worker scaling."""
+    n_in = a.shape[axis]
+    scale = n_out / n_in
+    k = min(scale, 1.0)             # widen the kernel on downscale
+    taps = int(_np.ceil(2.0 / k)) + 1
+    centers = (_np.arange(n_out) + 0.5) / scale - 0.5
+    idx = _np.floor(centers - (taps - 1) / 2.0).astype(_np.int64)
+    idx = idx[:, None] + _np.arange(taps)[None, :]      # (n_out, taps)
+    w = _np.clip(1.0 - _np.abs((idx - centers[:, None]) * k),
+                 0.0, None)
+    w *= (idx >= 0) & (idx < n_in)  # out-of-range taps drop, then the
+    w /= w.sum(axis=1, keepdims=True)   # row renormalizes (edge rule)
+    w = w.astype(_np.float32)
+    idx = _np.clip(idx, 0, n_in - 1)
+    a = _np.moveaxis(_np.asarray(a, _np.float32), axis, 0)
+    bshape = (-1,) + (1,) * (a.ndim - 1)
+    out = _np.zeros((n_out,) + a.shape[1:], _np.float32)
+    for t in range(taps):
+        out += a[idx[:, t]] * w[:, t].reshape(bshape)
+    return _np.moveaxis(out, 0, axis)
+
+
+def _resize_linear(img, size):
+    """Bilinear (w, h) resize of an HWC image in pure numpy — NO jax:
+    decode-service workers must stay jax-free (a forked child that
+    touches the parent's initialized XLA runtime deadlocks in
+    backend_compile; module docstring)."""
+    w_out, h_out = size
+    a = _np.asarray(img, _np.float32)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    return _axis_resize(_axis_resize(a, h_out, 0), w_out, 1)
+
+
+def decode_record(raw, data_shape, resize, rand_crop, rand_mirror, rng,
+                  mean=None, std=None, dtype="uint8", out=None):
+    """Decode one packed image record to CHW and return (pixels, label).
+
+    Mirrors the reference augment order (resize short side → crop →
+    mirror).  `dtype="uint8"` ships raw pixels (normalize on device);
+    `"float32"` applies `mean`/`std` host-side (shape (3,1,1) or None).
+    `out` is an optional preallocated CHW array (a shared-memory slab
+    row) the pixels are written into."""
+    header, img = unpack_img(raw)               # HWC uint8
+    c, h, w = data_shape
+    if resize > 0:
+        short = min(img.shape[:2])
+        scale = resize / short
+        img = _resize_linear(img, (int(round(img.shape[1] * scale)),
+                                   int(round(img.shape[0] * scale))))
+    H, W = img.shape[:2]
+    if rand_crop and H > h and W > w:
+        y0 = rng.randint(0, H - h + 1)
+        x0 = rng.randint(0, W - w + 1)
+    else:
+        y0, x0 = max(0, (H - h) // 2), max(0, (W - w) // 2)
+    if H < h or W < w:
+        img = _resize_linear(img, (w, h))
+        y0 = x0 = 0
+    img = img[y0:y0 + h, x0:x0 + w]
+    if rand_mirror and rng.rand() < 0.5:
+        img = img[:, ::-1]
+    label = header.label if hasattr(header.label, "__len__") else \
+        _np.float32(header.label)
+    chw = img.transpose(2, 0, 1)
+    if dtype == "uint8":            # raw pixels on the wire
+        if chw.dtype != _np.uint8:  # resize goes through float32
+            chw = chw.astype(_np.uint8)
+        if out is not None:
+            out[:] = chw
+            return out, label
+        return _np.ascontiguousarray(chw), label
+    chw = chw.astype(_np.float32)
+    if mean is not None:
+        chw = chw - mean
+    if std is not None:
+        chw = chw / std
+    chw = chw.astype(dtype, copy=False)
+    if out is not None:
+        out[:] = chw
+        return out, label
+    return _np.ascontiguousarray(chw), label
+
+
+def _write_label(row, label):
+    """Scalar or vector label into a float32 (label_width,) slab row."""
+    row[:] = 0.0
+    if hasattr(label, "__len__"):
+        k = min(len(label), row.shape[0])
+        row[:k] = _np.asarray(label, _np.float32)[:k]
+    else:
+        row[0] = float(label)
+
+
+# ---------------------------------------------------------------------------
+# availability probe
+# ---------------------------------------------------------------------------
+
+_AVAILABLE = None
+
+
+def service_available():
+    """Whether this host can run the multi-process service: shared
+    memory allocates and the configured start method exists.  Probed
+    once (tiny segment, immediately unlinked)."""
+    global _AVAILABLE
+    if _AVAILABLE is None:
+        try:
+            import multiprocessing as mp
+            from multiprocessing import shared_memory
+            method = _start_method()
+            if method not in mp.get_all_start_methods():
+                raise RuntimeError("start method %r unavailable" % method)
+            seg = shared_memory.SharedMemory(create=True, size=16)
+            seg.close()
+            seg.unlink()
+            _AVAILABLE = True
+        except Exception:           # noqa: BLE001 — any failure means
+            _AVAILABLE = False      # "use the threaded pipeline"
+    return _AVAILABLE
+
+
+def _start_method():
+    return _cfg.get("MXNET_IO_MP_START", "fork")
+
+
+# ---------------------------------------------------------------------------
+# worker process
+# ---------------------------------------------------------------------------
+
+def _attach_shm(name):
+    """Attach the parent's segment.  Workers share the parent's
+    resource-tracker process (fork and spawn both inherit its fd), and
+    its cache is a per-name set — the attach-side register dedupes and
+    the parent's single unlink unregisters, so no child-side tracker
+    bookkeeping is needed (an explicit child unregister would race the
+    siblings' and spam KeyError tracebacks from the tracker)."""
+    from multiprocessing import shared_memory
+    return shared_memory.SharedMemory(name=name)
+
+
+def _slot_views(buf, spec):
+    """Per-slot (data, label) numpy views over the shared segment."""
+    batch = spec["batch"]
+    shape = (batch,) + tuple(spec["data_shape"])
+    ddt = _np.dtype(spec["dtype"])
+    dbytes = int(_np.prod(shape)) * ddt.itemsize
+    lbytes = batch * spec["label_width"] * 4
+    stride = dbytes + lbytes
+    views = []
+    for s in range(spec["slots"]):
+        off = s * stride
+        data = _np.ndarray(shape, dtype=ddt, buffer=buf,
+                           offset=off)
+        label = _np.ndarray((batch, spec["label_width"]),
+                            dtype=_np.float32, buffer=buf,
+                            offset=off + dbytes)
+        views.append((data, label))
+    return views, stride
+
+
+def _worker_main(wid, spec, ctrl_q, free_q, out_q, cur_epoch):
+    """Worker process entry: decode this worker's shard of each
+    announced epoch into free slab slots.  jax-free by design — only
+    numpy/PIL/recordio run here."""
+    seg = None
+    fh = None
+    if os.environ.get("MXNET_IO_WORKER_DEBUG"):
+        import faulthandler
+        faulthandler.dump_traceback_later(
+            20, exit=True,
+            file=open("/tmp/decode_worker_%d.trace" % os.getpid(), "w"))
+    try:
+        seg = _attach_shm(spec["shm"])
+        views, _ = _slot_views(seg.buf, spec)
+        fh = open(spec["path"], "rb")
+        # startup handshake: the parent refuses to trust a pool until
+        # every worker proves it came up (a wedged fork must degrade
+        # to the threaded pipeline, never hang the consumer)
+        out_q.put(("ready", -1, wid))
+        offsets = spec["offsets"]
+        n = len(offsets)
+        workers = spec["workers"]
+        batch = spec["batch"]
+        mean = spec["mean"]
+        std = spec["std"]
+        while True:
+            cmd = ctrl_q.get()
+            if cmd[0] == "stop":
+                return
+            epoch = cmd[1]
+            # batch-block-aligned shard: every worker's slice is a
+            # whole number of batches except the one owning the final
+            # short block — at most ONE partial batch per epoch
+            order = shard_records(n, workers, wid, epoch=epoch,
+                                  shuffle=spec["shuffle"],
+                                  seed=spec["seed"], batch_size=batch)
+            # per-(worker, epoch) augment stream — deterministic, and
+            # decoupled from the shard permutation's RNG
+            rng = _np.random.RandomState(
+                (spec["seed"] * 2654435761 + epoch * 97 + wid + 1)
+                % (2 ** 31 - 1))
+            seq = 0
+            aborted = False
+            slot = None
+            try:
+                for start in range(0, len(order), batch):
+                    idxs = order[start:start + batch]
+                    slot = _acquire_slot(free_q, cur_epoch, epoch)
+                    if slot is None:        # epoch aborted (reset)
+                        aborted = True
+                        break
+                    dview, lview = views[slot]
+                    for j, ri in enumerate(idxs):
+                        fh.seek(offsets[ri])
+                        raw = read_record(fh)
+                        _, label = decode_record(
+                            raw, spec["data_shape"], spec["resize"],
+                            spec["rand_crop"], spec["rand_mirror"],
+                            rng, mean=mean, std=std,
+                            dtype=spec["dtype"], out=dview[j])
+                        _write_label(lview[j], label)
+                    out_q.put(("batch", epoch, slot, len(idxs),
+                               wid, seq))
+                    slot = None             # ownership passed on
+                    seq += 1
+                    if cur_epoch.value != epoch:
+                        aborted = True
+                        break
+            except Exception as e:          # noqa: BLE001 — surfaced
+                if slot is not None:        # half-filled slot: return
+                    free_q.put(slot)        # it, don't shrink the ring
+                out_q.put(("error", epoch, wid,                # to the
+                           "%s: %s" % (type(e).__name__, e)))  # parent
+                continue
+            out_q.put(("eoe", epoch, wid, seq if not aborted else -1))
+    except (KeyboardInterrupt, BrokenPipeError, EOFError):
+        pass                        # parent went away; exit quietly
+    finally:
+        try:
+            if fh is not None:
+                fh.close()
+            if seg is not None:
+                seg.close()
+        except Exception:           # noqa: BLE001
+            pass
+
+
+def _acquire_slot(free_q, cur_epoch, epoch):
+    """Blocking free-slot take that notices an epoch abort (reset):
+    returns a slot id, or None when the epoch moved on."""
+    while True:
+        if cur_epoch.value != epoch:
+            return None
+        try:
+            return free_q.get(timeout=0.05)
+        except _queue.Empty:
+            continue
+
+
+# ---------------------------------------------------------------------------
+# consumer side
+# ---------------------------------------------------------------------------
+
+class SlabBatch:
+    """One decoded batch living in a shared-memory slot.
+
+    `data` is the (count, C, H, W) slab view (uint8 or float32),
+    `label` the (count, label_width) float32 view.  The views stay
+    valid until the slot is recycled — which happens at the NEXT
+    `DecodeService.__next__` (or an explicit `release()`).  `wid`/`seq`
+    identify the producing worker and its batch ordinal, so a batch
+    stream is attributable (and bit-reproducibility testable)."""
+
+    __slots__ = ("data", "label", "count", "wid", "seq", "_svc",
+                 "_slot")
+
+    def __init__(self, data, label, count, wid, seq, svc, slot):
+        self.data = data
+        self.label = label
+        self.count = count
+        self.wid = wid
+        self.seq = seq
+        self._svc = svc
+        self._slot = slot
+
+    def release(self):
+        """Return the slot to the ring (idempotent).  After this the
+        `data`/`label` views may be overwritten by a worker."""
+        svc, self._svc = self._svc, None
+        if svc is not None:
+            svc._recycle(self._slot, self)
+
+
+class DecodeService:
+    """Worker-process pool decoding a RecordIO file into a
+    shared-memory slab ring (module docstring has the architecture).
+
+    Iteration yields one epoch of `SlabBatch`es; `reset()` advances to
+    a fresh epoch (discarding any in-flight batches); re-entering
+    `iter()` after exhaustion re-arms the next epoch automatically.
+    Batches arrive in worker-completion order — per-epoch record
+    coverage (exactly once, disjoint shards) is deterministic, the
+    interleaving across workers is not.
+
+    Raises `DecodeServiceUnavailable` when the host cannot run it
+    (no shared memory / process spawn) — callers degrade to the
+    threaded pipeline."""
+
+    def __init__(self, path_imgrec, batch_size, data_shape, workers=None,
+                 label_width=1, shuffle=False, seed=0, resize=-1,
+                 rand_crop=False, rand_mirror=False, dtype="uint8",
+                 mean=None, std=None, ring_slots=None):
+        if dtype not in ("uint8", "float32"):
+            raise ValueError("dtype must be 'uint8' or 'float32'")
+        if len(data_shape) != 3 or data_shape[0] != 3:
+            raise ValueError("data_shape must be (3, H, W)")
+        if not service_available():
+            raise DecodeServiceUnavailable(
+                "shared memory / process spawn unavailable on this host")
+        workers = int(workers if workers is not None
+                      else _cfg.get("MXNET_IO_WORKERS"))
+        if workers < 1:
+            raise ValueError("workers must be >= 1")
+        self._path = path_imgrec
+        self._batch = int(batch_size)
+        self._data_shape = tuple(data_shape)
+        self._workers_n = workers
+        self._label_width = int(label_width)
+        self._shuffle = bool(shuffle)
+        self._seed = int(seed)
+        self._dtype = dtype
+        self._offsets = self._resolve_offsets(path_imgrec)
+        slots = int(ring_slots if ring_slots is not None
+                    else _cfg.get("MXNET_IO_RING_SLOTS"))
+        if slots <= 0:
+            slots = 2 * workers + 2
+        self._slots_n = max(slots, workers + 1)
+        self._spec = {
+            "path": path_imgrec, "offsets": self._offsets,
+            "batch": self._batch, "data_shape": self._data_shape,
+            "label_width": self._label_width, "workers": workers,
+            "shuffle": self._shuffle, "seed": self._seed,
+            "resize": int(resize), "rand_crop": bool(rand_crop),
+            "rand_mirror": bool(rand_mirror), "dtype": dtype,
+            "mean": None if mean is None else
+            _np.asarray(mean, _np.float32).reshape(3, 1, 1),
+            "std": None if std is None else
+            _np.asarray(std, _np.float32).reshape(3, 1, 1),
+            "slots": self._slots_n, "shm": None,
+        }
+        dbytes = int(_np.prod((self._batch,) + self._data_shape)) * \
+            _np.dtype(dtype).itemsize
+        self._slot_stride = dbytes + self._batch * self._label_width * 4
+        self._started = False
+        self._closed = False
+        self._exhausted = False
+        self._consumed = False      # anything pulled from this epoch?
+        self._epoch = -1
+        self._eoe_wids = set()      # workers done with this epoch
+        self._current = None        # SlabBatch the consumer holds
+        self._shm = None
+        self._procs = []
+        self._ctrl = []
+        self._free_q = None
+        self._out_q = None
+        self._cur_epoch = None      # mp.Value workers poll for aborts
+        self._lock = threading.Lock()   # slot recycle is cross-thread
+
+    @property
+    def num_records(self):
+        return len(self._offsets)
+
+    @property
+    def workers(self):
+        return self._workers_n
+
+    @staticmethod
+    def _resolve_offsets(path):
+        """One byte offset per record, in canonical order: the .idx
+        sidecar's key order when present, else a sequential header
+        scan (`list_record_offsets`)."""
+        idx_path = idx_sidecar_path(path)
+        if os.path.exists(idx_path):
+            offsets = []
+            with open(idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) >= 2:
+                        offsets.append(int(parts[1]))
+            if offsets:
+                return _np.asarray(offsets, _np.int64)
+        # compact int64 array: under spawn the spec is pickled per
+        # worker, and a million-record list would ship as python ints
+        return _np.asarray(list_record_offsets(path), _np.int64)
+
+    # -- lifecycle -----------------------------------------------------
+    def _start(self):
+        import multiprocessing as mp
+        from multiprocessing import shared_memory
+        ctx = mp.get_context(_start_method())
+        try:
+            self._shm = shared_memory.SharedMemory(
+                create=True, size=self._slots_n * self._slot_stride)
+        except Exception as e:
+            raise DecodeServiceUnavailable(
+                "cannot allocate %d-byte shared ring: %s"
+                % (self._slots_n * self._slot_stride, e)) from e
+        self._spec["shm"] = self._shm.name
+        self._views, _ = _slot_views(self._shm.buf, self._spec)
+        self._free_q = ctx.Queue()
+        self._out_q = ctx.Queue()
+        self._cur_epoch = ctx.Value("l", -1, lock=False)
+        for s in range(self._slots_n):
+            self._free_q.put(s)
+        try:
+            with warnings.catch_warnings():
+                # workers are jax-free by design (module docstring);
+                # jax's blanket fork warning does not apply to them
+                warnings.filterwarnings(
+                    "ignore", message=".*os.fork.*",
+                    category=RuntimeWarning)
+                warnings.filterwarnings(
+                    "ignore", message=".*fork.*",
+                    category=DeprecationWarning)
+                for wid in range(self._workers_n):
+                    cq = ctx.Queue()
+                    p = ctx.Process(
+                        target=_worker_main,
+                        args=(wid, self._spec, cq, self._free_q,
+                              self._out_q, self._cur_epoch),
+                        daemon=True, name="DecodeWorker-%d" % wid)
+                    p.start()
+                    self._ctrl.append(cq)
+                    self._procs.append(p)
+        except Exception as e:
+            self.close()
+            raise DecodeServiceUnavailable(
+                "cannot start decode workers: %s" % e) from e
+        # startup handshake: every worker must post "ready" before the
+        # pool is trusted — a fork that wedged (inherited lock, broken
+        # sandbox) degrades to the threaded pipeline instead of
+        # hanging the first next()
+        ready = set()
+        deadline = time.monotonic() + _READY_TIMEOUT_S
+        while len(ready) < self._workers_n:
+            try:
+                msg = self._out_q.get(
+                    timeout=min(0.5, max(0.01,
+                                         deadline - time.monotonic())))
+                if msg[0] == "ready":
+                    ready.add(msg[2])
+                continue
+            except _queue.Empty:
+                pass
+            dead = [p.name for p in self._procs if not p.is_alive()]
+            if dead or time.monotonic() > deadline:
+                self.close()
+                raise DecodeServiceUnavailable(
+                    "decode workers failed to start (%d/%d ready; "
+                    "dead: %s)" % (len(ready), self._workers_n,
+                                   dead or "none, timed out"))
+        self._started = True
+
+    def close(self):
+        """Stop the pool and free the shared ring.  Idempotent; the
+        service cannot be restarted after close."""
+        if self._closed:
+            return
+        self._closed = True
+        self._exhausted = True
+        if self._cur_epoch is not None:
+            self._cur_epoch.value = -2      # abort any in-flight epoch
+        for cq in self._ctrl:
+            try:
+                cq.put(("stop",))
+            except Exception:       # noqa: BLE001
+                pass
+        for p in self._procs:
+            p.join(timeout=2.0)
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=1.0)
+        for q in [self._free_q, self._out_q] + self._ctrl:
+            try:
+                q.cancel_join_thread()
+                q.close()
+            except Exception:       # noqa: BLE001
+                pass
+        self._procs = []
+        self._ctrl = []
+        self._views = None
+        self._current = None
+        if self._shm is not None:
+            try:                    # unlink FIRST: a consumer still
+                self._shm.unlink()  # holding a slab view makes close()
+            except Exception:       # raise BufferError, and the name
+                pass                # must not leak in /dev/shm
+            try:
+                self._shm.close()
+            except Exception:       # noqa: BLE001
+                pass
+            self._shm = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:           # noqa: BLE001
+            pass
+
+    # -- slot recycling ------------------------------------------------
+    def _recycle(self, slot, sb):
+        with self._lock:
+            if self._current is sb:
+                self._current = None
+        if not self._closed and self._free_q is not None:
+            try:
+                self._free_q.put(slot)
+            except Exception:       # noqa: BLE001
+                pass
+
+    def _release_current(self):
+        cur = self._current
+        if cur is not None:
+            cur.release()
+
+    # -- epoch control -------------------------------------------------
+    def reset(self):
+        """Advance to a fresh epoch.  In-flight batches of the old one
+        are drained and their slots recycled; a no-op when the current
+        epoch is freshly announced and nothing was consumed yet (so
+        `reset()` followed by `iter()` advances exactly once)."""
+        if self._closed:
+            raise RuntimeError("DecodeService is closed")
+        if not self._started:
+            self._start()
+        elif not self._consumed and not self._exhausted \
+                and self._epoch >= 0:
+            return                  # current epoch is still untouched
+        self._release_current()
+        if self._epoch >= 0 and self._outstanding_alive():
+            self._drain_epoch()
+        self._epoch += 1
+        self._eoe_wids = set()
+        self._exhausted = False
+        self._consumed = False
+        self._cur_epoch.value = self._epoch
+        for cq in self._ctrl:
+            cq.put(("epoch", self._epoch))
+        events.incr("io.decode.epochs")
+
+    def _outstanding_alive(self):
+        """Live workers that have not posted this epoch's sentinel."""
+        return [wid for wid in range(self._workers_n)
+                if wid not in self._eoe_wids
+                and self._procs[wid].is_alive()]
+
+    def _drain_epoch(self):
+        """After aborting an epoch (reset mid-epoch), absorb every
+        straggler message and recycle its slot until each live worker
+        posted its end-of-epoch sentinel — so the next epoch starts
+        with a clean ring and an empty queue."""
+        self._cur_epoch.value = -2          # != any announced epoch
+        deadline = time.monotonic() + _DRAIN_TIMEOUT_S
+        while self._outstanding_alive():
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "decode service: drain timed out (%d/%d workers "
+                    "reported)"
+                    % (len(self._eoe_wids), self._workers_n))
+            try:
+                msg = self._out_q.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            if msg[0] == "batch":
+                self._free_q.put(msg[2])
+            elif msg[0] in ("eoe", "error") and msg[1] == self._epoch:
+                self._eoe_wids.add(msg[2])
+
+    # -- iteration -----------------------------------------------------
+    def __iter__(self):
+        if not self._started or self._exhausted or self._consumed:
+            self.reset()
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        if not self._started or self._epoch < 0:
+            self.reset()
+        if self._exhausted:
+            raise StopIteration
+        from .. import fault
+        fault.maybe_slow("io.slow")
+        fault.maybe_raise("io.read", exc_type=fault.InjectedIOError)
+        self._consumed = True
+        self._release_current()
+        t0 = time.perf_counter()
+        while True:
+            try:
+                depth = self._out_q.qsize()
+            except (NotImplementedError, OSError):
+                depth = -1
+            try:
+                msg = self._out_q.get(timeout=0.5)
+            except _queue.Empty:
+                outstanding = [wid for wid in range(self._workers_n)
+                               if wid not in self._eoe_wids]
+                if outstanding and not self._outstanding_alive():
+                    # every worker still owing batches is dead: their
+                    # shard is lost — an error, not a quiet epoch end
+                    self._exhausted = True
+                    raise RuntimeError(
+                        "decode worker(s) %s died mid-epoch"
+                        % outstanding)
+                if not outstanding:         # all sentinels seen (can
+                    self._exhausted = True  # only happen via races)
+                    raise StopIteration
+                if time.perf_counter() - t0 > _PULL_TIMEOUT_S:
+                    # alive-but-wedged pool (a child deadlocked after
+                    # its handshake): surface, don't hang the step loop
+                    self._exhausted = True
+                    raise RuntimeError(
+                        "decode service: no batch from worker(s) %s "
+                        "for %.0fs — pool wedged (alive but not "
+                        "producing)" % (outstanding, _PULL_TIMEOUT_S))
+                continue
+            tag = msg[0]
+            if tag == "ready":      # handshake straggler (restarted
+                continue            # pools); consumed in _start
+            if tag == "batch" and msg[1] != self._epoch:
+                self._free_q.put(msg[2])    # stale (pre-reset straggler)
+                continue
+            if tag in ("eoe", "error") and msg[1] != self._epoch:
+                continue
+            if tag == "eoe":
+                self._eoe_wids.add(msg[2])
+                if len(self._eoe_wids) >= self._workers_n:
+                    self._exhausted = True
+                    raise StopIteration
+                continue
+            if tag == "error":
+                self._eoe_wids.add(msg[2])  # the worker left the epoch
+                self._exhausted = True
+                raise RuntimeError("decode worker %d failed: %s"
+                                   % (msg[2], msg[3]))
+            break
+        _, _, slot, count, wid, seq = msg
+        wait_s = time.perf_counter() - t0
+        events.add_time("io.decode.wait_us", wait_s)
+        if depth >= 0:
+            events.observe("io.decode.queue_depth", depth)
+        wait_us = int(wait_s * 1e6)
+        if wait_us > _STALL_RECORD_US:
+            from ..telemetry import flightrec as _bb
+            _bb.record("io", "stall", us=wait_us,
+                       qdepth=max(depth, 0))
+        dview, lview = self._views[slot]
+        sb = SlabBatch(dview[:count], lview[:count], count, wid, seq,
+                       self, slot)
+        with self._lock:
+            self._current = sb
+        events.incr("io.decode.batches")
+        events.incr("io.decode.records", count)
+        events.incr("io.decode.bytes",
+                    int(sb.data.nbytes) + int(sb.label.nbytes))
+        return sb

@@ -33,7 +33,14 @@ microseconds / bytes), so the feed/compute balance is observable:
     feed.stall_us     consumer wait — compute starved by the feed
     feed.step_us      consumer wall between batches — the step side
     feed.bytes        bytes shipped on the wire
+    feed.queue_depth  ready batches when the consumer arrived (gauge,
+                      observed per batch; p50/p99 via percentiles)
     feed.batches / feed.epochs
+
+Stalls above 1 ms additionally land a ("feed", "stall") event in the
+flight-recorder ring with the queue depth at that moment, so a
+black-box dump separates decode-starved (depth 0 upstream) from
+transfer-bound starvation.
 
 `feed_counters()` snapshots them (bench.py includes the snapshot in
 its JSON line).
@@ -167,10 +174,31 @@ class DeviceFeed:
         self._exhausted = False
         self._started = False
         self._last_t = None
+        self._alias = None          # does device_put alias host bufs?
 
     # -- placement -----------------------------------------------------
     def _target_device(self):
         return self._ctx.jax_device
+
+    def _host_aliasing(self):
+        """Whether device_put to this feed's target ALIASES host numpy
+        buffers instead of copying: the CPU backend's placement is
+        zero-copy (mutating the source after block_until_ready mutates
+        the placed array — verified), so sources that recycle their
+        buffers (the decode service's shared-memory slab ring) must be
+        copied first.  Real accelerators do a true H2D copy."""
+        if self._alias is None:
+            import jax
+            if self._sharding is not None:
+                is_sh = lambda s: isinstance(s, jax.sharding.Sharding)
+                plats = {d.platform
+                         for s in jax.tree_util.tree_leaves(
+                             self._sharding, is_leaf=is_sh)
+                         for d in s.device_set}
+            else:
+                plats = {self._target_device().platform}
+            self._alias = "cpu" in plats
+        return self._alias
 
     def _place(self, batch):
         """ONE batched device_put for the whole pytree; returns
@@ -178,13 +206,15 @@ class DeviceFeed:
         worker thread, so the consumer never waits on H2D."""
         import jax
         from ..ndarray.ndarray import NDArray
+        alias = self._host_aliasing()
 
         def host(leaf):
             if isinstance(leaf, NDArray):
                 return leaf._data
-            if isinstance(leaf, (jax.Array, _np.ndarray)):
+            if isinstance(leaf, jax.Array):
                 return leaf
-            return _np.asarray(leaf)
+            arr = _np.asarray(leaf)
+            return arr.copy() if alias else arr
 
         hb = jax.tree_util.tree_map(host, batch)
         nbytes = sum(int(getattr(l, "nbytes", 0))
@@ -323,14 +353,21 @@ class DeviceFeed:
         if not self._async:
             out = self._next_sync(t0)
         else:
+            # ready-batch gauge BEFORE the get: depth 0 here plus a
+            # stall means the worker (read/decode or H2D) is behind;
+            # depth > 0 means the consumer arrived to a full buffer
+            depth = self._q.qsize()
+            events.observe("feed.queue_depth", depth)
             kind, val = self._q.get()
             stall_s = time.perf_counter() - t0
             events.add_time("feed.stall_us", stall_s)
             stall_us = int(stall_s * 1e6)
             if stall_us > _STALL_RECORD_US:
                 # compute starved by the feed: one timeline event per
-                # real stall (buffered sub-ms gets are just poll cost)
-                _bb.record("feed", "stall", us=stall_us)
+                # real stall (buffered sub-ms gets are just poll cost);
+                # qdepth attributes it — 0 = upstream (decode/wire)
+                # starved the worker, >0 = transfer completion lagged
+                _bb.record("feed", "stall", us=stall_us, qdepth=depth)
             if kind == "eoe":
                 self._exhausted = True
                 raise StopIteration

@@ -250,6 +250,23 @@ class LibSVMIter(DataIter):
         return [nd.array(lab)]
 
 
+_NO_SERVICE_WARNED = [False]
+
+
+def _warn_no_decode_service(why):
+    """One-time degradation notice (ISSUE 6 satellite): a sandboxed
+    host without shared memory / process spawn must keep every
+    existing ImageRecordIter call site working on the threaded
+    pipeline, not crash."""
+    if _NO_SERVICE_WARNED[0]:
+        return
+    _NO_SERVICE_WARNED[0] = True
+    import warnings
+    warnings.warn("multi-process decode service unavailable (%s); "
+                  "falling back to the threaded input pipeline — "
+                  "decode will be slower" % (why,), RuntimeWarning)
+
+
 class ImageRecordIter(DataIter):
     """ref: src/io/iter_image_recordio_2.cc ImageRecordIOParser2.
 
@@ -262,6 +279,11 @@ class ImageRecordIter(DataIter):
     upload with an async `io.device_feed.DeviceFeed`: batches arrive
     as device NDArrays, the NEXT batch's transfer overlapped with the
     consumer's step (`feed_depth` buffers, default MXNET_FEED_DEPTH).
+    `workers=N` (N ≥ 1; default `MXNET_IO_WORKERS`) decodes on the
+    multi-process shared-memory service (`io.decode_service`) — true
+    GIL-free parallelism with zero per-batch pickling; unavailable
+    hosts (no shared memory / process spawn) warn ONCE and degrade to
+    the threaded pipeline below.
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
@@ -270,11 +292,11 @@ class ImageRecordIter(DataIter):
                  rand_mirror=False, preprocess_threads=4, prefetch_buffer=2,
                  round_batch=True, seed=0, resize=-1, data_name="data",
                  label_name="softmax_label", dtype="float32", ctx=None,
-                 feed_depth=None, **kwargs):
+                 feed_depth=None, workers=None, **kwargs):
         super().__init__(batch_size)
         import collections
-        from .recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
-        self._unpack_img = unpack_img
+        from .recordio import (MXIndexedRecordIO, MXRecordIO,
+                               idx_sidecar_path)
         self.data_shape = tuple(data_shape)           # (C, H, W)
         self.label_width = label_width
         self._shuffle = shuffle
@@ -296,11 +318,61 @@ class ImageRecordIter(DataIter):
         self._ctx_feed = None
         self._pads = collections.deque()   # FIFO, parallel to the feed
 
+        # multi-process decode service (io/decode_service.py): worker
+        # PROCESSES over sharded readers into a shared-memory slab
+        # ring — preferred when the caller asks for workers, because
+        # it parallelizes decode without the GIL or the optional C++
+        # build.  Unavailable hosts degrade to native/threaded below.
+        self._service = None
+        self._native = None
+        self._nat_fut = None
+        if workers is None:
+            from .. import config as _config
+            workers = _config.get("MXNET_IO_WORKERS")
+        want_workers = int(workers or 0)
+        if want_workers >= 1 and not (dtype in ("float32", "uint8")
+                                      and self.data_shape[0] == 3):
+            # requested but ineligible: say so — a silent drop to the
+            # threaded path misattributes the resulting throughput
+            import warnings
+            warnings.warn(
+                "workers=%d ignored: the decode service handles "
+                "3-channel float32/uint8 batches only (got dtype=%r, "
+                "data_shape=%r); using the threaded pipeline"
+                % (want_workers, dtype, self.data_shape),
+                RuntimeWarning)
+        if want_workers >= 1 and dtype in ("float32", "uint8") \
+                and self.data_shape[0] == 3:
+            from . import decode_service as _dsvc
+            try:
+                svc = _dsvc.DecodeService(
+                    path_imgrec, batch_size, self.data_shape,
+                    workers=int(workers), label_width=label_width,
+                    shuffle=shuffle, seed=seed, resize=resize,
+                    rand_crop=rand_crop, rand_mirror=rand_mirror,
+                    dtype="uint8" if dtype == "uint8" else "float32",
+                    mean=None if dtype == "uint8"
+                    else (mean_r, mean_g, mean_b),
+                    std=None if dtype == "uint8"
+                    else (std_r, std_g, std_b))
+                # start the pool NOW, on the calling thread: a host
+                # that cannot bring workers up (startup handshake)
+                # falls back HERE, where the threaded pipeline is
+                # still constructible — not at first next()
+                svc.reset()
+                self._service = svc
+            except _dsvc.DecodeServiceUnavailable as e:
+                _warn_no_decode_service(e)
+        if self._service is not None:
+            if ctx is not None:
+                self._make_feed(ctx, feed_depth)
+                return
+            self.reset()
+            return
+
         # native C++ pipeline (src/io/recordio_pipeline.cc — the
         # ImageRecordIOParser2 equivalent): GIL-free decode+augment.
         # PIL threadpool below is the always-available fallback.
-        self._native = None
-        self._nat_fut = None
         if dtype in ("float32", "uint8") and self.data_shape[0] == 3:
             from . import native as _native
             if _native.available():
@@ -327,7 +399,7 @@ class ImageRecordIter(DataIter):
             self.reset()
             return
 
-        idx_path = path_imgrec[:path_imgrec.rfind(".")] + ".idx"
+        idx_path = idx_sidecar_path(path_imgrec)
         if os.path.exists(idx_path):
             self._rec = MXIndexedRecordIO(idx_path, path_imgrec, "r")
             self._keys = list(self._rec.keys)
@@ -363,11 +435,46 @@ class ImageRecordIter(DataIter):
                 label[-1:], pad, axis=0)])
         return data, label, pad
 
+    @property
+    def io_workers(self):
+        """Decode parallelism actually in effect: service worker
+        PROCESSES, or 0 on the native/threaded paths (bench reports
+        this instead of os.cpu_count(), which lied about what the
+        pipeline used)."""
+        return self._service.workers if self._service is not None else 0
+
+    def close(self):
+        """Release this iterator's resources — decode-service pool,
+        device feed, native reader, decode thread pool, and the record
+        file handle, whichever path is active (idempotent).  The
+        iterator cannot be used afterwards."""
+        if self._ctx_feed is not None:
+            self._ctx_feed.close()
+        if self._service is not None:
+            self._service.close()
+        if self._native is not None:
+            self._native.close()
+        if getattr(self, "_pool", None) is not None:
+            self._pool.shutdown(wait=False)
+        if getattr(self, "_rec", None) is not None:
+            self._rec.close()
+
     def _host_batches(self):
         """One epoch of padded host (data, label) batches — the feed's
         source.  Runs on the feed worker thread; pads are queued on a
         FIFO the consumer pops in the same order."""
         self._pads.clear()
+        if self._service is not None:
+            # shared-memory slabs straight into the feed's device_put:
+            # the slab view stays valid until the service's next pull,
+            # which the feed only makes AFTER placing this batch (and
+            # _place copies first on CPU targets, where device_put
+            # aliases host buffers instead of copying)
+            for sb in self._service:
+                data, label, pad = self._pad_batch(sb.data, sb.label)
+                self._pads.append(pad)
+                yield data, label
+            return
         if self._native is not None:
             self._native.reset()
             while True:
@@ -409,6 +516,9 @@ class ImageRecordIter(DataIter):
         if self._ctx_feed is not None:
             self._ctx_feed.reset()
             return
+        if self._service is not None:
+            self._service.reset()
+            return
         if self._native is not None:
             # drain the in-flight prefetch first: Pipeline::Reset must
             # not race mxio_next, and an orphaned future would consume
@@ -439,35 +549,14 @@ class ImageRecordIter(DataIter):
         return self._rec.read()
 
     def _process(self, raw):
-        header, img = self._unpack_img(raw)     # HWC uint8
-        c, h, w = self.data_shape
-        if self._resize > 0:
-            from ..gluon.data.vision.transforms import _resize_np
-            short = min(img.shape[:2])
-            scale = self._resize / short
-            img = _resize_np(img, (int(round(img.shape[1] * scale)),
-                                   int(round(img.shape[0] * scale))))
-        H, W = img.shape[:2]
-        if self._rand_crop and H > h and W > w:
-            y0 = self._rng.randint(0, H - h + 1)
-            x0 = self._rng.randint(0, W - w + 1)
-        else:
-            y0, x0 = max(0, (H - h) // 2), max(0, (W - w) // 2)
-        if H < h or W < w:
-            from ..gluon.data.vision.transforms import _resize_np
-            img = _resize_np(img, (w, h))
-            y0 = x0 = 0
-        img = img[y0:y0 + h, x0:x0 + w]
-        if self._rand_mirror and self._rng.rand() < 0.5:
-            img = img[:, ::-1]
-        chw = _np.ascontiguousarray(
-            _np.asarray(img, dtype=_np.float32).transpose(2, 0, 1))
-        label = header.label if hasattr(header.label, "__len__") else \
-            _np.float32(header.label)
-        if self._dtype == "uint8":      # raw pixels on the wire;
-            return chw.astype(_np.uint8), label     # normalize on device
-        chw = (chw - self._mean) / self._std
-        return chw.astype(self._dtype), label
+        # ONE decode+augment implementation for the threaded pool and
+        # the decode-service workers (io/decode_service.py) — the two
+        # execution engines cannot drift numerically
+        from .decode_service import decode_record
+        return decode_record(raw, self.data_shape, self._resize,
+                             self._rand_crop, self._rand_mirror,
+                             self._rng, mean=self._mean, std=self._std,
+                             dtype=self._dtype)
 
     def _fill(self):
         while len(self._pending) < self._prefetch:
@@ -488,6 +577,16 @@ class ImageRecordIter(DataIter):
             data, label = next(self._ctx_feed)      # device NDArrays;
             pad = self._pads.popleft() if self._pads else 0
             return DataBatch([data], [label], pad=pad)
+        if self._service is not None:
+            sb = next(self._service)    # StopIteration = epoch end
+            data, label, pad = self._pad_batch(sb.data, sb.label)
+            if not pad:                 # padding already copied; else
+                data = data.copy()      # copy OUT of the slab — CPU-
+                label = label.copy()    # backend nd.array aliases host
+                                        # buffers, and the slot recycles
+                                        # on the service's next pull
+            return DataBatch([nd.array(data)], [nd.array(label)],
+                             pad=pad)
         if self._native is not None:
             batch = self._nat_fut.result()
             if batch is None:

@@ -182,10 +182,14 @@ class NativeImageRecordReader:
                 return
             yield b
 
+    def close(self):
+        """Release the native reader handle (idempotent)."""
+        if getattr(self, "_h_ptr", None):
+            self._lib.mxio_destroy(self._h_ptr)
+            self._h_ptr = None
+
     def __del__(self):
         try:
-            if getattr(self, "_h_ptr", None):
-                self._lib.mxio_destroy(self._h_ptr)
-                self._h_ptr = None
+            self.close()
         except Exception:
             pass

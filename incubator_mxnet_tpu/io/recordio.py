@@ -24,11 +24,84 @@ import struct
 import numpy as _np
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IndexedRecordIO", "IRHeader",
-           "pack", "unpack", "pack_img", "unpack_img"]
+           "pack", "unpack", "pack_img", "unpack_img", "read_record",
+           "list_record_offsets", "idx_sidecar_path"]
 
 _MAGIC = 0xced7230a
 _CFLAG_BITS = 29
 _LEN_MASK = (1 << _CFLAG_BITS) - 1
+
+
+def read_record(fh):
+    """Read one framed record from a binary file object positioned at a
+    record boundary; returns the payload bytes, or None at EOF.  The
+    standalone framing parser — `MXRecordIO.read` adds the fault hooks
+    on top, and decode-service workers call this directly on their own
+    file handles (no shared state, no fault registry)."""
+    header = fh.read(8)
+    if len(header) < 8:
+        return None
+    magic, lrec = struct.unpack("<II", header)
+    if magic != _MAGIC:
+        raise IOError("invalid RecordIO magic at offset %d"
+                      % (fh.tell() - 8))
+    cflag = lrec >> _CFLAG_BITS
+    length = lrec & _LEN_MASK
+    buf = fh.read(length)
+    fh.read((-length) % 4)
+    if cflag == 0:
+        return buf
+    # split record: keep reading continuation chunks
+    parts = [buf]
+    while cflag not in (0, 3):
+        header = fh.read(8)
+        if len(header) < 8:
+            raise IOError("truncated RecordIO: EOF inside a split "
+                          "record at offset %d"
+                          % (fh.tell() - len(header)))
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise IOError("invalid RecordIO magic at offset %d"
+                          % (fh.tell() - 8))
+        cflag = lrec >> _CFLAG_BITS
+        length = lrec & _LEN_MASK
+        parts.append(fh.read(length))
+        fh.read((-length) % 4)
+    return b"".join(parts)
+
+
+def list_record_offsets(uri):
+    """Byte offset of every record in a .rec file, in file order — the
+    non-indexed analogue of the .idx sidecar.  One sequential header
+    scan (payloads are seek()ed over, not read), so sharded readers
+    (io.decode_service) can partition a plain .rec keyspace exactly the
+    way an indexed one partitions its keys.  Continuation chunks of a
+    split record do not get their own offset."""
+    offsets = []
+    with open(uri, "rb") as fh:
+        while True:
+            pos = fh.tell()
+            header = fh.read(8)
+            if len(header) < 8:
+                break
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _MAGIC:
+                raise IOError("invalid RecordIO magic at offset %d" % pos)
+            cflag = lrec >> _CFLAG_BITS
+            length = lrec & _LEN_MASK
+            fh.seek(length + ((-length) % 4), 1)
+            if cflag in (0, 1):     # whole record, or head of a split
+                offsets.append(pos)
+    return offsets
+
+
+def idx_sidecar_path(uri):
+    """Path of the .idx sidecar for a .rec file: the extension swapped
+    for '.idx', or appended when the file has none ('/data/train' →
+    '/data/train.idx' — a bare rfind('.') would corrupt the name, or
+    match a dot in a parent directory)."""
+    base, ext = os.path.splitext(uri)
+    return (base if ext else uri) + ".idx"
 
 
 class MXRecordIO:
@@ -93,29 +166,13 @@ class MXRecordIO:
         from .. import fault
         fault.maybe_slow("io.slow")
         fault.maybe_raise("io.read", exc_type=fault.InjectedIOError)
-        header = self.handle.read(8)
-        if len(header) < 8:
-            return None
-        magic, lrec = struct.unpack("<II", header)
-        if magic != _MAGIC:
-            raise IOError("invalid RecordIO magic at offset %d"
-                          % (self.handle.tell() - 8))
-        cflag = lrec >> _CFLAG_BITS
-        length = lrec & _LEN_MASK
-        buf = self.handle.read(length)
-        self.handle.read((-length) % 4)
-        if cflag == 0:
-            return buf
-        # split record: keep reading continuation chunks
-        parts = [buf]
-        while cflag not in (0, 3):
-            header = self.handle.read(8)
-            magic, lrec = struct.unpack("<II", header)
-            cflag = lrec >> _CFLAG_BITS
-            length = lrec & _LEN_MASK
-            parts.append(self.handle.read(length))
-            self.handle.read((-length) % 4)
-        return b"".join(parts)
+        return read_record(self.handle)
+
+    def read_at(self, offset):
+        """Seek to a byte offset (from `list_record_offsets` or an .idx
+        entry) and read the record there."""
+        self.handle.seek(offset)
+        return self.read()
 
 
 class MXIndexedRecordIO(MXRecordIO):

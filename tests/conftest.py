@@ -70,6 +70,13 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "blackbox: flight-recorder forensics tests "
         "(CPU-fast, run in tier-1 by default)")
+    # the input-pipeline suite (multi-process decode service, shard
+    # partitioning, shared-memory ring, device feed) is CPU-fast and
+    # runs in tier-1 by default; the marker lets it be selected or
+    # excluded explicitly (pytest -m io / -m 'not io')
+    config.addinivalue_line(
+        "markers", "io: input-pipeline / decode-service tests "
+        "(CPU-fast, run in tier-1 by default)")
 
 
 @pytest.fixture(autouse=True)

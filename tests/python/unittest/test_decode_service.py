@@ -1,0 +1,500 @@
+"""Multi-process decode service (io/decode_service.py): shard
+partitioning, shared-memory slab ring, ImageRecordIter(workers=N)
+integration, graceful degradation, and the feed/decode queue-depth
+telemetry (ISSUE 6)."""
+import os
+import warnings
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.io import recordio
+from incubator_mxnet_tpu.io.decode_service import (
+    DecodeService, DecodeServiceUnavailable, service_available,
+    shard_records)
+
+pytestmark = pytest.mark.io
+
+N_REC = 40
+
+needs_service = pytest.mark.skipif(
+    not service_available(),
+    reason="shared memory / process spawn unavailable on this host")
+
+
+@pytest.fixture(scope="module")
+def rec_file(tmp_path_factory):
+    """Plain (non-indexed) .rec with the record id in the label."""
+    path = str(tmp_path_factory.mktemp("decsvc") / "data.rec")
+    rs = onp.random.RandomState(7)
+    rec = recordio.MXRecordIO(path, "w")
+    for i in range(N_REC):
+        img = rs.randint(0, 255, (40, 50, 3), dtype=onp.uint8)
+        rec.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, quality=92))
+    rec.close()
+    return path
+
+
+@pytest.fixture(scope="module")
+def indexed_rec_file(tmp_path_factory):
+    """Indexed .rec (+ .idx sidecar), non-contiguous keys."""
+    d = tmp_path_factory.mktemp("decsvc_idx")
+    path = str(d / "data.rec")
+    idx = str(d / "data.idx")
+    rs = onp.random.RandomState(9)
+    rec = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(N_REC):
+        img = rs.randint(0, 255, (36, 44, 3), dtype=onp.uint8)
+        rec.write_idx(i * 3, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, quality=92))
+    rec.close()
+    return path
+
+
+# ---------------------------------------------------------------------------
+# shard partitioning — the satellite contract: exact-once per epoch,
+# disjoint across workers, bit-deterministic under shuffle + seed
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,workers", [(10, 1), (10, 3), (37, 2),
+                                       (37, 5), (40, 4)])
+def test_shard_exact_cover_disjoint(n, workers):
+    for epoch in (0, 1, 5):
+        shards = [shard_records(n, workers, w, epoch=epoch,
+                                shuffle=True, seed=3)
+                  for w in range(workers)]
+        merged = sorted(onp.concatenate(shards).tolist())
+        assert merged == list(range(n))     # exact-once AND disjoint
+        for a in range(workers):
+            for b in range(a + 1, workers):
+                assert not set(shards[a]) & set(shards[b])
+
+
+def test_shard_deterministic_and_epoch_varying():
+    a = shard_records(100, 4, 2, epoch=3, shuffle=True, seed=11)
+    b = shard_records(100, 4, 2, epoch=3, shuffle=True, seed=11)
+    onp.testing.assert_array_equal(a, b)    # bit-deterministic
+    c = shard_records(100, 4, 2, epoch=4, shuffle=True, seed=11)
+    assert not onp.array_equal(a, c)        # epochs reshuffle
+    d = shard_records(100, 4, 2, epoch=3, shuffle=True, seed=12)
+    assert not onp.array_equal(a, d)        # seeds differ
+
+
+def test_shard_no_shuffle_is_strided_identity():
+    got = shard_records(10, 3, 1, epoch=9, shuffle=False, seed=5)
+    onp.testing.assert_array_equal(got, [1, 4, 7])
+
+
+@pytest.mark.parametrize("n,workers,batch", [(40, 3, 16), (37, 2, 8),
+                                             (10, 4, 3), (5, 3, 8)])
+def test_shard_batch_aligned_one_partial_poolwide(n, workers, batch):
+    """batch_size= mode (what the workers run): exact-once cover,
+    whole batches everywhere except ONE short tail pool-wide, so
+    steps-per-epoch do not depend on the worker count."""
+    shards = [shard_records(n, workers, w, epoch=2, shuffle=True,
+                            seed=3, batch_size=batch)
+              for w in range(workers)]
+    merged = sorted(onp.concatenate(shards).tolist())
+    assert merged == list(range(n))         # exact-once AND disjoint
+    tails = [len(s) % batch for s in shards]
+    assert sum(1 for t in tails if t) <= 1  # <= one ragged batch total
+    # deterministic: same args -> bit-identical slices
+    again = [shard_records(n, workers, w, epoch=2, shuffle=True,
+                           seed=3, batch_size=batch)
+             for w in range(workers)]
+    for a, b in zip(shards, again):
+        onp.testing.assert_array_equal(a, b)
+
+
+def test_shard_bad_shard_id():
+    with pytest.raises(ValueError):
+        shard_records(10, 3, 3)
+
+
+# ---------------------------------------------------------------------------
+# recordio offset helpers — the non-indexed shard path
+# ---------------------------------------------------------------------------
+
+def test_idx_sidecar_path():
+    assert recordio.idx_sidecar_path("/d/train.rec") == "/d/train.idx"
+    # extensionless file: append, don't eat a trailing char
+    assert recordio.idx_sidecar_path("/d/train") == "/d/train.idx"
+    # a dot in a PARENT directory must not be mistaken for an extension
+    assert recordio.idx_sidecar_path("/d.v2/train") == "/d.v2/train.idx"
+
+
+def test_read_record_truncated_raises_ioerror(tmp_path):
+    """A .rec truncated mid split-record raises IOError, not a raw
+    struct.error (workers seek to arbitrary offsets)."""
+    import struct
+    path = str(tmp_path / "trunc.rec")
+    with open(path, "wb") as f:        # cflag=1 head chunk, then EOF
+        f.write(struct.pack("<II", 0xced7230a, (1 << 29) | 4) + b"abcd")
+    with open(path, "rb") as fh:
+        with pytest.raises(IOError):
+            recordio.read_record(fh)
+
+
+def test_list_record_offsets_and_read_at(tmp_path):
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [b"hello", b"x" * 1000, b"", b"abc\x00def"]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    offsets = recordio.list_record_offsets(path)
+    assert len(offsets) == len(payloads)
+    r = recordio.MXRecordIO(path, "r")
+    # random access via offsets, any order
+    for i in (2, 0, 3, 1):
+        assert r.read_at(offsets[i]) == payloads[i]
+    r.close()
+
+
+# ---------------------------------------------------------------------------
+# the service itself
+# ---------------------------------------------------------------------------
+
+def _collect_ids(svc):
+    return [int(lab) for sb in svc for lab in sb.label[:, 0]]
+
+
+@needs_service
+def test_service_epoch_coverage_plain(rec_file):
+    """2 workers x 3 epochs over a non-indexed .rec: every record
+    exactly once per epoch."""
+    svc = DecodeService(rec_file, 8, (3, 32, 32), workers=2,
+                        shuffle=True, seed=1, dtype="uint8")
+    try:
+        assert svc.num_records == N_REC
+        for _ in range(3):
+            assert sorted(_collect_ids(svc)) == list(range(N_REC))
+    finally:
+        svc.close()
+
+
+@needs_service
+def test_service_epoch_coverage_indexed(indexed_rec_file):
+    """Same exact-once contract on the .idx keyspace."""
+    svc = DecodeService(indexed_rec_file, 8, (3, 32, 32), workers=3,
+                        shuffle=True, seed=2, dtype="uint8")
+    try:
+        assert svc.num_records == N_REC
+        for _ in range(2):
+            assert sorted(_collect_ids(svc)) == list(range(N_REC))
+    finally:
+        svc.close()
+
+
+@needs_service
+def test_service_bit_deterministic(rec_file):
+    """Same seed -> the same (worker, seq) batch stream, down to the
+    augmented pixel bytes (shuffle + rand_crop + rand_mirror all on)."""
+    def run():
+        svc = DecodeService(rec_file, 8, (3, 24, 24), workers=2,
+                            shuffle=True, seed=5, rand_crop=True,
+                            rand_mirror=True, dtype="uint8")
+        try:
+            return {(sb.wid, sb.seq): (sb.data.copy(), sb.label.copy())
+                    for sb in svc}
+        finally:
+            svc.close()
+    a, b = run(), run()
+    assert a.keys() == b.keys()
+    for k in a:
+        onp.testing.assert_array_equal(a[k][0], b[k][0])
+        onp.testing.assert_array_equal(a[k][1], b[k][1])
+
+
+@needs_service
+def test_service_partial_batches_and_counts(rec_file):
+    """batch=16 over a 40-record file, 3 workers: block-aligned shards
+    (16/16/8) yield exactly ONE partial tail batch pool-wide; counts
+    must still sum to 40."""
+    svc = DecodeService(rec_file, 16, (3, 16, 16), workers=3,
+                        dtype="uint8")
+    try:
+        counts = [sb.count for sb in svc]
+        assert sum(counts) == N_REC
+        assert sorted(counts) == [8, 16, 16]
+    finally:
+        svc.close()
+
+
+@needs_service
+def test_service_mid_epoch_reset(rec_file):
+    """reset() mid-epoch drains in-flight slabs and the next epoch
+    still covers every record exactly once."""
+    svc = DecodeService(rec_file, 8, (3, 16, 16), workers=2,
+                        shuffle=True, seed=3, dtype="uint8")
+    try:
+        it = iter(svc)
+        next(it)
+        next(it)
+        svc.reset()
+        assert sorted(_collect_ids(svc)) == list(range(N_REC))
+    finally:
+        svc.close()
+
+
+@needs_service
+def test_service_float32_matches_threaded(rec_file):
+    """float32 + mean/std slabs equal the threaded ImageRecordIter
+    decode per record (same decode_record underneath)."""
+    svc = DecodeService(rec_file, 8, (3, 28, 28), workers=2,
+                        dtype="float32", mean=(10.0, 0.0, 0.0),
+                        std=(2.0, 1.0, 1.0))
+    got = {}
+    try:
+        for sb in svc:
+            for j in range(sb.count):
+                got[int(sb.label[j, 0])] = sb.data[j].copy()
+    finally:
+        svc.close()
+    it = mx.io.ImageRecordIter(path_imgrec=rec_file,
+                               data_shape=(3, 28, 28), batch_size=8,
+                               mean_r=10.0, std_r=2.0)
+    ref = {}
+    for b in it:
+        k = b.data[0].shape[0] - b.pad
+        lab = b.label[0].asnumpy()
+        arr = b.data[0].asnumpy()
+        for j in range(k):
+            ref[int(lab[j])] = arr[j]
+    assert got.keys() == ref.keys()
+    for k in ref:
+        onp.testing.assert_array_equal(got[k], ref[k])
+
+
+@needs_service
+def test_service_close_idempotent_and_final(rec_file):
+    svc = DecodeService(rec_file, 8, (3, 16, 16), workers=2,
+                        dtype="uint8")
+    assert len(_collect_ids(svc)) == N_REC
+    svc.close()
+    svc.close()                     # idempotent
+    with pytest.raises(StopIteration):
+        next(svc)
+    with pytest.raises(RuntimeError):
+        svc.reset()
+
+
+def test_service_rejects_bad_args(rec_file):
+    with pytest.raises(ValueError):
+        DecodeService(rec_file, 8, (1, 16, 16), workers=2)
+    with pytest.raises(ValueError):
+        DecodeService(rec_file, 8, (3, 16, 16), workers=2,
+                      dtype="float16")
+
+
+# ---------------------------------------------------------------------------
+# ImageRecordIter(workers=N) integration + degradation
+# ---------------------------------------------------------------------------
+
+@needs_service
+def test_image_record_iter_workers(rec_file):
+    it = mx.io.ImageRecordIter(path_imgrec=rec_file,
+                               data_shape=(3, 24, 24), batch_size=16,
+                               workers=2, dtype="uint8", shuffle=True)
+    try:
+        assert it.io_workers == 2
+        for _ in range(2):          # two epochs through reset()
+            n, labels = 0, []
+            while True:
+                try:
+                    b = it.next()
+                except StopIteration:
+                    break
+                assert b.data[0].shape == (16, 3, 24, 24)
+                k = b.data[0].shape[0] - b.pad
+                labels.extend(b.label[0].asnumpy()[:k].tolist())
+                n += k
+            assert n == N_REC
+            assert sorted(labels) == [float(i) for i in range(N_REC)]
+            it.reset()
+    finally:
+        it.close()
+
+
+@needs_service
+def test_image_record_iter_workers_ctx_feed(rec_file):
+    """workers= + ctx=: slabs flow through DeviceFeed, batches arrive
+    as device NDArrays (uint8 wire), pads line up."""
+    it = mx.io.ImageRecordIter(path_imgrec=rec_file,
+                               data_shape=(3, 24, 24), batch_size=16,
+                               workers=2, dtype="uint8", ctx=mx.cpu())
+    try:
+        n = 0
+        for b in it:
+            assert b.data[0].dtype == onp.uint8
+            assert b.data[0].context == mx.cpu()
+            n += b.data[0].shape[0] - b.pad
+        assert n == N_REC
+        it.reset()
+        assert it.next().data[0].shape == (16, 3, 24, 24)
+    finally:
+        it.close()
+
+
+def test_image_record_iter_fallback_warns_once(rec_file, monkeypatch):
+    """Hosts without the service warn ONCE and keep the threaded
+    pipeline working (never crash an existing call site)."""
+    from incubator_mxnet_tpu.io import decode_service as dsvc
+    import incubator_mxnet_tpu.io.io as ioio
+    monkeypatch.setattr(dsvc, "_AVAILABLE", False)
+    monkeypatch.setattr(ioio, "_NO_SERVICE_WARNED", [False])
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        it = mx.io.ImageRecordIter(path_imgrec=rec_file,
+                                   data_shape=(3, 16, 16),
+                                   batch_size=8, workers=4)
+        it2 = mx.io.ImageRecordIter(path_imgrec=rec_file,
+                                    data_shape=(3, 16, 16),
+                                    batch_size=8, workers=4)
+    msgs = [x for x in w
+            if "decode service unavailable" in str(x.message)]
+    assert len(msgs) == 1           # once, not per call site
+    assert it.io_workers == 0 and it2.io_workers == 0
+    n = sum(b.data[0].shape[0] - b.pad for b in it)
+    assert n == N_REC
+
+
+@needs_service
+@pytest.mark.parametrize("use_ctx", [False, True])
+def test_batches_immune_to_slot_recycling(rec_file, use_ctx):
+    """A delivered batch must never mutate when its slab slot recycles:
+    CPU-backend device_put/nd.array zero-copy ALIAS host buffers, so
+    both consumer paths copy out of the ring (on real accelerators the
+    H2D transfer is the copy)."""
+    kw = {"ctx": mx.cpu()} if use_ctx else {}
+    it = mx.io.ImageRecordIter(path_imgrec=rec_file,
+                               data_shape=(3, 16, 16), batch_size=4,
+                               workers=2, dtype="uint8", shuffle=True,
+                               **kw)
+    try:
+        b0 = it.next()
+        snap = b0.data[0].asnumpy().copy()
+        for _ in range(8):          # ring is 2*2+2=6 slots: slot 0's
+            it.next()               # slab is overwritten by now
+        onp.testing.assert_array_equal(b0.data[0].asnumpy(), snap)
+    finally:
+        it.close()
+
+
+@needs_service
+def test_image_record_iter_single_worker(rec_file):
+    """workers=1 runs the service too (the bench enables it at 1; the
+    training path must not silently diverge to the threaded pipeline
+    under the same knob value)."""
+    it = mx.io.ImageRecordIter(path_imgrec=rec_file,
+                               data_shape=(3, 16, 16), batch_size=8,
+                               workers=1, dtype="uint8")
+    try:
+        assert it.io_workers == 1
+        n = sum(b.data[0].shape[0] - b.pad for b in it)
+        assert n == N_REC
+    finally:
+        it.close()
+
+
+def test_workers_zero_keeps_legacy_path(rec_file):
+    """workers unset/0 must not touch the service at all (seed
+    behavior preserved)."""
+    it = mx.io.ImageRecordIter(path_imgrec=rec_file,
+                               data_shape=(3, 16, 16), batch_size=8)
+    assert it._service is None
+    assert it.io_workers == 0
+
+
+def test_workers_ineligible_dtype_warns(rec_file):
+    """workers= on a dtype/shape the service cannot handle must say so
+    (a silent drop to the threaded path misattributes throughput)."""
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        it = mx.io.ImageRecordIter(path_imgrec=rec_file,
+                                   data_shape=(3, 16, 16), batch_size=8,
+                                   workers=2, dtype="float16")
+    assert any("ignored" in str(x.message) for x in w)
+    assert it.io_workers == 0
+
+
+def test_close_releases_threaded_resources(rec_file, monkeypatch):
+    """close() on the legacy threaded path shuts the decode pool and
+    the record file handle (long-lived jobs build iterators per epoch —
+    they must not accumulate threads/fds)."""
+    from incubator_mxnet_tpu.io import native
+    monkeypatch.setattr(native, "available", lambda: False)
+    it = mx.io.ImageRecordIter(path_imgrec=rec_file,
+                               data_shape=(3, 16, 16), batch_size=8)
+    it.next()
+    it.close()
+    assert not it._rec.is_open
+    assert it._pool._shutdown
+
+
+# ---------------------------------------------------------------------------
+# telemetry: queue-depth gauges + flight-recorder stall events
+# ---------------------------------------------------------------------------
+
+@needs_service
+def test_decode_queue_depth_gauge(rec_file):
+    from incubator_mxnet_tpu.monitor import events
+    svc = DecodeService(rec_file, 8, (3, 16, 16), workers=2,
+                        dtype="uint8")
+    try:
+        before = events.get("io.decode.queue_depth.n")
+        b0 = events.get("io.decode.batches")
+        _collect_ids(svc)
+        assert events.get("io.decode.queue_depth.n") > before
+        assert events.get("io.decode.batches") > b0
+        assert events.percentiles("io.decode.queue_depth")["n"] > 0
+    finally:
+        svc.close()
+
+
+def test_feed_stall_event_carries_queue_depth():
+    """A starved DeviceFeed consumer lands a ("feed", "stall") ring
+    event tagged with the queue depth, so a black-box dump attributes
+    starvation (satellite: decode vs wire vs H2D)."""
+    import time as _time
+    from incubator_mxnet_tpu.io.device_feed import DeviceFeed
+    from incubator_mxnet_tpu.monitor import events
+    from incubator_mxnet_tpu.telemetry import flightrec
+
+    flightrec.configure(256)        # fresh ring
+
+    def slow_source():
+        for i in range(3):
+            _time.sleep(0.005)      # 5ms decode -> guaranteed stall
+            yield onp.full((4, 2), i, onp.float32)
+
+    before = events.get("feed.queue_depth.n")
+    feed = DeviceFeed(slow_source, ctx=mx.cpu())
+    out = list(feed)
+    assert len(out) == 3
+    assert events.get("feed.queue_depth.n") > before
+    stalls = [e for e in flightrec.ring_snapshot()
+              if e["kind"] == "feed" and e["name"] == "stall"]
+    assert stalls and all("qdepth" in e for e in stalls)
+
+
+# ---------------------------------------------------------------------------
+# CI gate (slow): worker scaling on a multi-core host
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_check_feed_gate():
+    """tools/check_feed.py: 1 -> N decode workers must scale >= 1.5x
+    on a multi-core host (slow: excluded from tier-1; SKIPs cleanly on
+    single-core / no-shm hosts)."""
+    import subprocess
+    import sys
+    script = os.path.join(os.path.dirname(__file__), "..", "..", "..",
+                          "tools", "check_feed.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(script), "--repeats", "2"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stdout + res.stderr

@@ -1,0 +1,213 @@
+"""check_feed — CI gate for decode-service worker scaling.
+
+The multi-process decode service (io/decode_service.py) exists to beat
+the single-threaded pipeline; this script proves it still does.  It
+runs the synthetic io pipeline at 1 worker and at N workers over the
+same RecordIO corpus and fails when the measured speedup falls short.
+
+The pass bar is calibrated against the HOST, not a wish: a direct
+probe first measures what N independent decode processes (no service,
+no ring — just forked workers chewing shards of the corpus) gain over
+one, which is the parallelism this machine can actually deliver —
+shared/throttled VMs routinely expose N vCPUs but schedule ~1.3 of
+them.  The service must then achieve `--frac` (default 0.75) of that
+ceiling, capped at `--threshold` (default 1.5x, the ISSUE 6
+acceptance bar a real multi-core host clears easily).  Hosts whose
+ceiling is < 1.25x SKIP with rc 0 — nothing parallel can be
+demonstrated there — as do single-core hosts and hosts without shared
+memory / process spawn (where the service itself already degrades
+gracefully).
+
+    JAX_PLATFORMS=cpu python tools/check_feed.py
+    python tools/check_feed.py --workers 4 --threshold 1.5
+
+Methodology (check_overhead.py's discipline): modes run INTERLEAVED
+(direct-1, direct-N, service-1, service-N per round) — on shared VMs
+the deliverable CPU drifts minute to minute, and measuring all of one
+mode then all of the other lets that drift masquerade as
+(anti-)scaling.  The BEST rate per mode across --repeats rounds is
+compared: best-of-k is the noise-robust estimator for "what does the
+pipeline do when the machine isn't doing something else".  Wired as a
+`slow`+`io`-marked test (tests/python/unittest/test_decode_service.py),
+so tier-1 skips it but CI can run it.  Importing the package pulls in
+jax (package __init__) but this script never touches a device, and it
+forces single-process mode below so `ensure_jax_distributed` cannot
+initialize an XLA runtime before the probes fork (the fork-after-init
+deadlock decode_service.py documents).
+"""
+from __future__ import annotations
+
+import argparse
+import multiprocessing as mp
+import os
+import sys
+import time
+
+# runnable as `python tools/check_feed.py` from anywhere: the repo
+# root (this file's parent's parent) must be importable
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+# the probes fork from THIS process, so it must never initialize an
+# XLA runtime first: a DMLC_* cluster env would make the package
+# __init__ call jax.distributed.initialize (docstring above) — the
+# gate measures local decode scaling only, force single-process mode
+os.environ["DMLC_NUM_WORKER"] = "1"
+
+_REC = os.path.join("/tmp", "check_feed_256.rec")
+_SHAPE = (3, 96, 96)
+_RESIZE = 112
+
+
+def _ensure_rec(n=256, path=_REC):
+    import numpy as np
+    from incubator_mxnet_tpu.io import recordio
+    if os.path.exists(path):
+        return path
+    rs = np.random.RandomState(0)
+    tmp = path + ".tmp"
+    rec = recordio.MXRecordIO(tmp, "w")
+    for i in range(n):
+        img = rs.randint(0, 255, (120, 160, 3), dtype=np.uint8)
+        rec.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, quality=90))
+    rec.close()
+    os.replace(tmp, path)
+    return path
+
+
+def _decode_shard(path, shard, nshards, barrier=None):
+    """One process's share of a direct (service-free) corpus decode.
+    `barrier` separates process startup (interpreter + imports — whole
+    seconds under spawn) from the decode work being timed."""
+    import numpy as np
+    from incubator_mxnet_tpu.io.decode_service import (decode_record,
+                                                       shard_records)
+    from incubator_mxnet_tpu.io.recordio import (list_record_offsets,
+                                                 read_record)
+    offs = list_record_offsets(path)
+    rng = np.random.RandomState(shard)
+    if barrier is not None:
+        barrier.wait()
+    with open(path, "rb") as fh:
+        for i in shard_records(len(offs), nshards, shard):
+            fh.seek(offs[i])
+            decode_record(read_record(fh), _SHAPE, _RESIZE, True, True,
+                          rng, dtype="uint8")
+
+
+def _direct_rate(path, nproc, n_records):
+    """img/s of `nproc` independent decoders (the host's deliverable-
+    parallelism probe — no service machinery at all).  The clock starts
+    at a post-import barrier so the 1-proc (warm parent) and N-proc
+    (cold children) rates compare decode work, not interpreter spin-up
+    — under spawn the startup cost would otherwise sink the measured
+    ceiling below the SKIP bar and make the gate vacuous."""
+    if nproc == 1:
+        t0 = time.perf_counter()
+        _decode_shard(path, 0, 1)
+        return n_records / (time.perf_counter() - t0)
+    ctx = mp.get_context("fork" if "fork" in mp.get_all_start_methods()
+                         else "spawn")
+    barrier = ctx.Barrier(nproc + 1)
+    ps = [ctx.Process(target=_decode_shard,
+                      args=(path, s, nproc, barrier))
+          for s in range(nproc)]
+    for p in ps:
+        p.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for p in ps:
+        p.join()
+    return n_records / (time.perf_counter() - t0)
+
+
+def _service_rate(path, workers, batch, epochs=2):
+    """Best single-epoch rate over a fresh `workers`-wide service."""
+    from incubator_mxnet_tpu.io.decode_service import DecodeService
+    svc = DecodeService(path, batch, _SHAPE, workers=workers,
+                        resize=_RESIZE, rand_crop=True,
+                        rand_mirror=True, shuffle=True, dtype="uint8")
+    try:
+        for _ in svc:           # warm epoch: worker spin-up + page cache
+            pass
+        best = 0.0
+        for _ in range(max(1, epochs)):
+            t0 = time.perf_counter()
+            n = 0
+            for sb in svc:
+                n += sb.count
+            best = max(best, n / (time.perf_counter() - t0))
+        return best
+    finally:
+        svc.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="check_feed",
+        description="fail (rc!=0) when decode-service worker scaling "
+        "falls short of what this host's cores can deliver")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="parallel worker count to compare against 1 "
+                    "(0 = min(4, host cores))")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="interleaved measurement rounds; best rate "
+                    "per mode is compared")
+    ap.add_argument("--threshold", type=float, default=1.5,
+                    help="max speedup demanded (the multi-core "
+                    "acceptance bar)")
+    ap.add_argument("--frac", type=float, default=0.75,
+                    help="fraction of the host's measured direct-"
+                    "process ceiling the service must deliver")
+    args = ap.parse_args(argv)
+
+    cpu = os.cpu_count() or 1
+    if cpu < 2:
+        print("SKIP: single-core host (nothing to scale with)")
+        return 0
+    from incubator_mxnet_tpu.io.decode_service import service_available
+    if not service_available():
+        print("SKIP: decode service unavailable on this host "
+              "(no shared memory / process spawn)")
+        return 0
+    workers = args.workers or min(4, cpu)
+    path = _ensure_rec()
+    n_rec = 256
+    best = {"d1": 0.0, "dN": 0.0, "s1": 0.0, "sN": 0.0}
+    for r in range(max(1, args.repeats)):
+        for key, fn in (("d1", lambda: _direct_rate(path, 1, n_rec)),
+                        ("dN", lambda: _direct_rate(path, workers,
+                                                    n_rec)),
+                        ("s1", lambda: _service_rate(path, 1,
+                                                     args.batch)),
+                        ("sN", lambda: _service_rate(path, workers,
+                                                     args.batch))):
+            best[key] = max(best[key], fn())
+        print("round %d  direct 1/%d: %.1f / %.1f   service 1/%d: "
+              "%.1f / %.1f img/s"
+              % (r, workers, best["d1"], best["dN"], workers,
+                 best["s1"], best["sN"]))
+    ceiling = best["dN"] / max(best["d1"], 1e-9)
+    scaling = best["sN"] / max(best["s1"], 1e-9)
+    required = min(args.threshold, args.frac * ceiling)
+    print("host ceiling (direct %d-proc): %.2fx   service scaling: "
+          "%.2fx   required: %.2fx"
+          % (workers, ceiling, scaling, required))
+    if ceiling < 1.25:
+        print("SKIP: host delivers no usable parallelism (%.2fx from "
+              "%d processes on %d cores) — shared/throttled VM"
+              % (ceiling, workers, cpu))
+        return 0
+    if scaling < required:
+        print("FAIL: decode-service worker scaling below threshold",
+              file=sys.stderr)
+        return 1
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
