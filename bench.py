@@ -768,6 +768,170 @@ def run_sharded(batch=256, warmup=2, iters=16):
     return batch * iters / (time.perf_counter() - t0)
 
 
+_ELASTIC_CHILD_MARK = "_BENCH_ELASTIC_CHILD"
+
+
+def run_elastic(n_devices=8, kill_at=6, steps=16, steps_per_epoch=8):
+    """MULTICHIP elastic scenario (ISSUE 7): kill a replica at step K
+    on the n-way virtual mesh, re-admit it at the next epoch boundary;
+    report steps lost + recovery wall-time.  Self-bootstrapping child
+    process (dryrun_multichip's recipe): the virtual CPU platform is
+    forced before jax backend init, so the caller's jax state — a real
+    chip, a different device count — is never disturbed."""
+    if os.environ.get(_ELASTIC_CHILD_MARK) != "1":
+        import re
+        import subprocess
+        env = dict(os.environ)
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       "", env.get("XLA_FLAGS", ""))
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d"
+            % n_devices).strip()
+        env["JAX_PLATFORMS"] = "cpu"
+        env[_ELASTIC_CHILD_MARK] = "1"
+        # the scenario's mesh-shrink black box is a real dump (the
+        # trigger fires for real): scratch dir, not the checkout
+        env.setdefault("MXNET_BLACKBOX_DIR", "/tmp")
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--elastic-child", str(n_devices), str(kill_at),
+               str(steps), str(steps_per_epoch)]
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=420, env=env,
+                             cwd=os.path.dirname(os.path.abspath(__file__)))
+        for line in reversed((res.stdout or "").strip().splitlines()
+                             or [""]):
+            if line.startswith("{"):
+                return json.loads(line)
+        tail = (res.stderr or res.stdout or "").strip().splitlines()
+        raise RuntimeError("elastic child failed (rc=%d): %s"
+                           % (res.returncode,
+                              tail[-1] if tail else "no output"))
+    return _elastic_scenario(n_devices, kill_at, steps,
+                             steps_per_epoch)
+
+
+def _elastic_scenario(n_devices, kill_at, steps, steps_per_epoch):
+    """Child-side body of run_elastic: runs on the virtual mesh."""
+    import math
+    import tempfile
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    # the persistent compilation cache (enabled at module import for
+    # every other config) must be OFF here: a warm-cache HIT for a
+    # multi-device donated executable crashes this jaxlib's CPU
+    # backend (verified: identical elastic runs pass cold and segfault
+    # mid-step warm), and the elastic rebuild is the one path that
+    # compiles the same sharded step repeatedly
+    jax.config.update("jax_enable_compilation_cache", False)
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import config as _ecfg, fault, gluon, nd, \
+        parallel
+    from incubator_mxnet_tpu.monitor import events
+
+    in_dim, classes = 32, 8
+    # batch divisible by every mesh width a single-replica loss visits
+    batch = n_devices * (n_devices - 1) \
+        // math.gcd(n_devices, n_devices - 1)
+
+    def build(mesh, lr_factor):
+        mx.random.seed(11)
+        net = gluon.nn.HybridSequential(prefix="bel_")
+        net.add(gluon.nn.Dense(64, in_units=in_dim, activation="relu",
+                               prefix="bel_d1_"),
+                gluon.nn.Dense(classes, in_units=64, prefix="bel_d2_"))
+        net.initialize(force_reinit=True)
+        net(nd.ones((2, in_dim)))
+        return parallel.ShardedTrainer(net, optimizer="adam",
+                                       lr=1e-2 * lr_factor, mesh=mesh)
+
+    def data_fn(step, n_replicas):
+        rs = np.random.RandomState(1000 + step)
+        return (rs.randn(batch, in_dim).astype(np.float32),
+                rs.randint(0, classes, batch))
+
+    ck = tempfile.mkdtemp(prefix="bench_elastic_ck_")
+    _ecfg.set("MXNET_FAULT_PLAN", "mesh.replica_down@%d" % kill_at)
+    fault.reset_from_config()
+    t0 = time.perf_counter()
+    try:
+        et = parallel.ElasticTrainer(
+            build, ckpt_dir=ck, steps_per_epoch=steps_per_epoch,
+            ckpt_interval=2, seed=5, handle_sigterm=False)
+        losses = et.run(data_fn, steps)
+    finally:
+        fault.clear()
+        _ecfg.unset("MXNET_FAULT_PLAN")
+    wall = time.perf_counter() - t0
+
+    shrinks = [t for t in et.transitions if t["kind"] == "shrink"]
+    grows = [t for t in et.transitions if t["kind"] == "grow"]
+    out = {
+        "elastic_devices": n_devices,
+        "elastic_kill_step": kill_at,
+        "elastic_steps_total": steps,
+        "elastic_final_replicas": et.n_replicas,
+        "elastic_wall_s": round(wall, 2),
+        "elastic_shrinks": events.get("mesh.shrinks"),
+        "elastic_grows": events.get("mesh.grows"),
+        "elastic_losses_finite": bool(
+            all(np.isfinite(v) for v in losses.values())),
+    }
+    if shrinks:
+        s = shrinks[0]
+        out.update({
+            "elastic_shrink_step": s["step"],
+            "elastic_lost_replica": s["lost"][0],
+            # the acceptance numbers: work re-done and wall-clock from
+            # detection to training again on the smaller mesh
+            "elastic_steps_lost": s["steps_lost"],
+            "elastic_recovery_s": s["wall_s"],
+        })
+    if grows:
+        g = grows[0]
+        out.update({"elastic_readmit_step": g["step"],
+                    "elastic_regrow_s": g["wall_s"]})
+    if et.last_blackbox:
+        out["elastic_blackbox"] = os.path.basename(et.last_blackbox)
+    print(json.dumps(out))
+    return out
+
+
+def _write_multichip_elastic(parsed, rc=0):
+    """MULTICHIP_elastic.json in the MULTICHIP_r* schema
+    ({n_devices, rc, ok, skipped, tail}) so the multichip trajectory
+    tooling picks the elastic scenario up alongside the scaling runs."""
+    # ok only when the scenario actually EXERCISED elasticity: a clean
+    # rc with no shrink/grow means the fault never fired (heartbeat
+    # regression, kill_at >= steps) — reporting that as a pass would be
+    # a trajectory lie, not a robustness proof
+    exercised = (parsed.get("elastic_shrink_step") is not None
+                 and parsed.get("elastic_readmit_step") is not None)
+    if exercised:
+        tail = ("elastic ok: %d->%d@step%s (lost r%s, %s step(s) lost, "
+                "recovery %.2fs) regrow@step%s (%.2fs) final=%d "
+                "replicas\n"
+                % (parsed.get("elastic_devices", 0),
+                   parsed.get("elastic_devices", 1) - 1,
+                   parsed.get("elastic_shrink_step", "?"),
+                   parsed.get("elastic_lost_replica", "?"),
+                   parsed.get("elastic_steps_lost", "?"),
+                   parsed.get("elastic_recovery_s", 0.0),
+                   parsed.get("elastic_readmit_step", "?"),
+                   parsed.get("elastic_regrow_s", 0.0),
+                   parsed.get("elastic_final_replicas", 0)))
+    else:
+        tail = ("elastic FAILED: scenario completed (rc=%d) but the "
+                "mesh never shrank/regrew — fault plan did not fire\n"
+                % rc)
+    blob = {"n_devices": parsed.get("elastic_devices", 0), "rc": rc,
+            "ok": rc == 0 and exercised, "skipped": False, "tail": tail,
+            "parsed": parsed}
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "MULTICHIP_elastic.json"), "w") as fh:
+        json.dump(blob, fh, indent=2)
+
+
 def run_int8_infer(batch=64, warmup=3, iters=20):
     """Optional extra: post-training-quantized (int8, naive calib)
     ResNet-50 inference, images/sec — the deploy-side MXU int8 story
@@ -1036,6 +1200,7 @@ _CONFIGS = {
         "resnet50_int8_infer_images_per_sec", run_int8_infer, (64, 32)),
     "quality": lambda b=None: run_quality(),
     "serve": lambda b=None: _cfg_serve(),
+    "elastic": lambda b=None: _cfg_elastic(),
 }
 
 # batch ladders main() walks one-subprocess-per-attempt (first success
@@ -1123,6 +1288,15 @@ def _cfg_serve():
     return parsed
 
 
+def _cfg_elastic():
+    parsed = run_elastic()
+    try:
+        _write_multichip_elastic(parsed)    # trajectory file rides along
+    except Exception:
+        pass
+    return parsed
+
+
 def _run_config_subprocess(name, timeout_s, batch=None):
     import subprocess
     cmd = [sys.executable, os.path.abspath(__file__), "--config", name]
@@ -1158,13 +1332,13 @@ def main():
     times = {}
     required = ("resnet", "bert", "ssd512", "rcnn", "gnmt",
                 "transformer_nmt", "wide_deep")
-    optional = ("io", "serve", "sharded", "quality", "int8")
+    optional = ("io", "serve", "sharded", "elastic", "quality", "int8")
 
     # optional configs need this much budget left to be worth starting
     # (below it they'd time out AT the budget edge instead of skipping
     # cleanly — int8's quantization calibration alone needs ~4 min cold)
     optional_min = {"io": 30, "serve": 90, "sharded": 90,
-                    "quality": 120, "int8": 250}
+                    "elastic": 60, "quality": 120, "int8": 250}
 
     for name in required + optional:
         remaining = budget - (time.perf_counter() - t_start)
@@ -1254,6 +1428,12 @@ if __name__ == "__main__":
                 pass
         print(_write_bench_serve(parsed, rc=rc))
         sys.exit(rc)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--elastic-child":
+        # marked child of run_elastic: the n-device virtual CPU
+        # platform is already forced in XLA_FLAGS by the parent
+        _n, _k, _s, _spe = (int(a) for a in sys.argv[2:6])
+        _elastic_scenario(_n, _k, _s, _spe)
+        sys.exit(0)
     if len(sys.argv) >= 3 and sys.argv[1] == "--config":
         name = sys.argv[2]
         batch = sys.argv[3] if len(sys.argv) >= 4 else None

@@ -221,11 +221,26 @@ register("MXNET_RETRY_MAX", int, 3,
          "(exponential backoff between attempts)")
 register("MXNET_RETRY_BACKOFF", float, 0.05,
          "Initial backoff seconds for resilience retries (doubles per "
-         "attempt)")
+         "attempt, jittered — see MXNET_RETRY_BACKOFF_MS)")
+register("MXNET_RETRY_BACKOFF_MS", float, 0.0,
+         "Initial retry backoff in MILLISECONDS; when > 0 it overrides "
+         "MXNET_RETRY_BACKOFF.  Each attempt doubles the window and "
+         "sleeps a uniform-jittered interval in [window/2, window] so "
+         "a fleet of workers hitting the same storage/collective blip "
+         "does not retry in lockstep (thundering herd)")
 register("MXNET_KVSTORE_BARRIER_TIMEOUT", float, 300.0,
          "DistKVStore barrier timeout in seconds: a worker stuck at a "
          "barrier raises a clear rank-tagged error instead of hanging "
          "the job forever (0 = wait indefinitely)")
+register("MXNET_IO_WORKER_RESTARTS", int, 2,
+         "DecodeService: dead decode-worker auto-respawns allowed per "
+         "service (pool-wide).  A respawned worker resumes its "
+         "(wid, epoch) shard slice at the first undelivered batch — "
+         "per-batch RNG derivation keeps the stream bit-identical to "
+         "an uninterrupted run.  Respawns are counted on "
+         "io.decode.worker_restarts; past the budget a dead worker is "
+         "a hard mid-epoch error (the pre-elastic behaviour).  0 "
+         "disables respawn")
 register("MXNET_IO_WORKERS", int, 0,
          "Multi-process decode service (io.decode_service): worker "
          "PROCESSES behind ImageRecordIter(workers=) and the bench io/"
@@ -272,6 +287,29 @@ register("MXNET_SERVE_BUCKETS", str, "",
          "MXNET_SERVE_MAX_BATCH. The bucket set is CLOSED: every "
          "request batch is padded up to a bucket, so the compiled "
          "executable set is fixed after warmup()")
+register("MXNET_SERVE_REPLICA_FAILS", int, 3,
+         "InferenceEngine: consecutive terminal dispatch failures on "
+         "ONE replica device before it is marked unhealthy and routed "
+         "around (serve.replica_unhealthy counter + flight-recorder "
+         "event); a healthy dispatch resets the streak")
+register("MXNET_SERVE_REPLICA_COOLDOWN_S", float, 5.0,
+         "InferenceEngine: seconds an unhealthy replica is skipped by "
+         "the round-robin before ONE probe batch is routed back to it "
+         "(success re-admits it — serve.replica_recovered; failure "
+         "restarts the cooldown)")
+register("MXNET_ELASTIC_STALE_STEPS", int, 1,
+         "ElasticTrainer heartbeat health: steps without a kvstore "
+         "heartbeat before a replica is reported SLOW "
+         "(mesh.replica_slow counter; observation only, no shrink)")
+register("MXNET_ELASTIC_DOWN_STEPS", int, 2,
+         "ElasticTrainer heartbeat health: steps without a kvstore "
+         "heartbeat before a replica is declared DOWN — the mesh "
+         "drains, shrinks to the survivors, re-shards ZeRO state from "
+         "the last atomic checkpoint and training continues")
+register("MXNET_ELASTIC_MIN_REPLICAS", int, 1,
+         "ElasticTrainer: smallest mesh the supervisor will shrink to; "
+         "losing a replica below this floor is a hard error (the job "
+         "cannot meaningfully continue)")
 register("MXNET_AOT_CACHE_MAX", int, 0,
          "aot_cache: max on-disk serialized executables; older entries "
          "(by mtime; cache hits refresh it, so this is keep-K LRU) are "

@@ -27,6 +27,16 @@ Sites are plain strings; the built-in ones:
                         `seconds` the dispatch also stalls first, which
                         is how queue-full / deadline-expiry tests hold
                         the dispatcher busy deterministically
+    mesh.replica_down   ElasticTrainer heartbeat layer: the victim
+                        replica (highest active id) STOPS posting
+                        kvstore heartbeats from this step on — the
+                        health poll then detects it slow→down through
+                        the REAL staleness path and the mesh shrinks
+                        (re-admission at the next epoch boundary)
+    mesh.replica_slow   ElasticTrainer heartbeat layer: the victim
+                        skips heartbeats for one staleness window —
+                        reported (mesh.replica_slow counter +
+                        flight-recorder event) but not shrunk
 
 Faults install programmatically::
 

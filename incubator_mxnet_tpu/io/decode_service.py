@@ -43,12 +43,23 @@ copies into an NDArray immediately) is done with the view.  Holders
 that need a slab longer call `SlabBatch.release()` explicitly when
 done (idempotent) and copy what they keep.
 
+Worker death is survivable: a worker that dies mid-epoch is
+AUTO-RESPAWNED (up to ``MXNET_IO_WORKER_RESTARTS`` pool-wide, counted
+on ``io.decode.worker_restarts``).  The replacement resumes the SAME
+(wid, epoch) shard slice at the first undelivered batch — augmentation
+RNG derives per (seed, epoch, wid, seq) batch, so the resumed stream
+is bit-identical to an uninterrupted one and every record is still
+decoded exactly once.  Slots the dead worker held are reclaimed
+through a shared slot-owner table, so the ring never shrinks.  Past
+the respawn budget a dead worker is a hard mid-epoch error, as before.
+
 Observability (`monitor.events` + the flight-recorder ring):
 
     io.decode.batches / records / bytes    volume
     io.decode.wait_us                      consumer wait on the ring
     io.decode.queue_depth                  ready-batch gauge (observe)
     io.decode.epochs                       epochs announced
+    io.decode.worker_restarts              dead workers auto-respawned
 
 A consumer wait above 1 ms lands a `("io", "stall")` event with the
 queue depth in the black-box ring, so a dump attributes starvation to
@@ -239,6 +250,17 @@ def decode_record(raw, data_shape, resize, rand_crop, rand_mirror, rng,
     return _np.ascontiguousarray(chw), label
 
 
+def _batch_rng(seed, epoch, wid, seq):
+    """Augment RNG for ONE batch, derived from (seed, epoch, wid, seq).
+    Per-batch (not per-epoch-stream) derivation is what makes a
+    respawned worker resumable bit-for-bit: batch `seq` draws the same
+    crops/mirrors whether it is decoded by the original worker or by a
+    replacement that skipped straight to it."""
+    return _np.random.RandomState(
+        (int(seed) * 2654435761 + int(epoch) * 1000003 +
+         int(wid) * 8191 + int(seq) * 7919 + 1) % (2 ** 31 - 1))
+
+
 def _write_label(row, label):
     """Scalar or vector label into a float32 (label_width,) slab row."""
     row[:] = 0.0
@@ -316,10 +338,14 @@ def _slot_views(buf, spec):
     return views, stride
 
 
-def _worker_main(wid, spec, ctrl_q, free_q, out_q, cur_epoch):
+def _worker_main(wid, spec, ctrl_q, free_q, out_q, cur_epoch,
+                 owners=None):
     """Worker process entry: decode this worker's shard of each
     announced epoch into free slab slots.  jax-free by design — only
-    numpy/PIL/recordio run here."""
+    numpy/PIL/recordio run here.  `owners` is the shared slot-owner
+    table: a worker writes its wid when it acquires a slot, the PARENT
+    clears it on message receipt — so a slot held by a worker that died
+    is identifiable and reclaimable (auto-respawn)."""
     seg = None
     fh = None
     if os.environ.get("MXNET_IO_WORKER_DEBUG"):
@@ -346,28 +372,32 @@ def _worker_main(wid, spec, ctrl_q, free_q, out_q, cur_epoch):
             if cmd[0] == "stop":
                 return
             epoch = cmd[1]
+            # a respawned replacement resumes its predecessor's slice
+            # at the first UNDELIVERED batch; a fresh epoch starts at 0
+            skip = int(cmd[2]) if len(cmd) > 2 else 0
             # batch-block-aligned shard: every worker's slice is a
             # whole number of batches except the one owning the final
             # short block — at most ONE partial batch per epoch
             order = shard_records(n, workers, wid, epoch=epoch,
                                   shuffle=spec["shuffle"],
                                   seed=spec["seed"], batch_size=batch)
-            # per-(worker, epoch) augment stream — deterministic, and
-            # decoupled from the shard permutation's RNG
-            rng = _np.random.RandomState(
-                (spec["seed"] * 2654435761 + epoch * 97 + wid + 1)
-                % (2 ** 31 - 1))
-            seq = 0
+            seq = skip
             aborted = False
             slot = None
             try:
-                for start in range(0, len(order), batch):
+                for start in range(skip * batch, len(order), batch):
                     idxs = order[start:start + batch]
                     slot = _acquire_slot(free_q, cur_epoch, epoch)
                     if slot is None:        # epoch aborted (reset)
                         aborted = True
                         break
+                    if owners is not None:
+                        owners[slot] = wid
                     dview, lview = views[slot]
+                    # per-batch augment RNG (seed, epoch, wid, seq):
+                    # bit-identical whether this batch is decoded by
+                    # the original worker or a post-crash replacement
+                    rng = _batch_rng(spec["seed"], epoch, wid, seq)
                     for j, ri in enumerate(idxs):
                         fh.seek(offsets[ri])
                         raw = read_record(fh)
@@ -379,14 +409,16 @@ def _worker_main(wid, spec, ctrl_q, free_q, out_q, cur_epoch):
                         _write_label(lview[j], label)
                     out_q.put(("batch", epoch, slot, len(idxs),
                                wid, seq))
-                    slot = None             # ownership passed on
-                    seq += 1
+                    slot = None             # ownership passed on (the
+                    seq += 1                # parent clears owners[])
                     if cur_epoch.value != epoch:
                         aborted = True
                         break
             except Exception as e:          # noqa: BLE001 — surfaced
                 if slot is not None:        # half-filled slot: return
-                    free_q.put(slot)        # it, don't shrink the ring
+                    if owners is not None:  # it, don't shrink the ring
+                        owners[slot] = -1
+                    free_q.put(slot)
                 out_q.put(("error", epoch, wid,                # to the
                            "%s: %s" % (type(e).__name__, e)))  # parent
                 continue
@@ -522,6 +554,9 @@ class DecodeService:
         self._free_q = None
         self._out_q = None
         self._cur_epoch = None      # mp.Value workers poll for aborts
+        self._owners = None         # shared slot-owner table (respawn)
+        self._delivered = {}        # wid -> batches received this epoch
+        self._restarts_left = int(_cfg.get("MXNET_IO_WORKER_RESTARTS"))
         self._lock = threading.Lock()   # slot recycle is cross-thread
 
     @property
@@ -568,6 +603,10 @@ class DecodeService:
         self._free_q = ctx.Queue()
         self._out_q = ctx.Queue()
         self._cur_epoch = ctx.Value("l", -1, lock=False)
+        # slot-owner table: worker writes its wid on slot acquire, the
+        # parent clears on delivery — slots a dead worker held are
+        # identifiable and reclaimed on respawn (ring never shrinks)
+        self._owners = ctx.Array("l", [-1] * self._slots_n, lock=False)
         for s in range(self._slots_n):
             self._free_q.put(s)
         try:
@@ -581,15 +620,9 @@ class DecodeService:
                     "ignore", message=".*fork.*",
                     category=DeprecationWarning)
                 for wid in range(self._workers_n):
-                    cq = ctx.Queue()
-                    p = ctx.Process(
-                        target=_worker_main,
-                        args=(wid, self._spec, cq, self._free_q,
-                              self._out_q, self._cur_epoch),
-                        daemon=True, name="DecodeWorker-%d" % wid)
-                    p.start()
-                    self._ctrl.append(cq)
-                    self._procs.append(p)
+                    self._ctrl.append(None)
+                    self._procs.append(None)
+                    self._spawn_worker(ctx, wid)
         except Exception as e:
             self.close()
             raise DecodeServiceUnavailable(
@@ -610,7 +643,8 @@ class DecodeService:
                 continue
             except _queue.Empty:
                 pass
-            dead = [p.name for p in self._procs if not p.is_alive()]
+            dead = [p.name for p in self._procs
+                    if p is not None and not p.is_alive()]
             if dead or time.monotonic() > deadline:
                 self.close()
                 raise DecodeServiceUnavailable(
@@ -618,6 +652,123 @@ class DecodeService:
                     "dead: %s)" % (len(ready), self._workers_n,
                                    dead or "none, timed out"))
         self._started = True
+
+    def _spawn_worker(self, ctx, wid):
+        """Start (or re-start) worker `wid` on a FRESH control queue —
+        a respawn must not consume the corpse's stale epoch announce
+        (it carries no resume offset)."""
+        old = self._ctrl[wid]
+        if old is not None:
+            try:
+                old.cancel_join_thread()
+                old.close()
+            except Exception:       # noqa: BLE001
+                pass
+        cq = ctx.Queue()
+        p = ctx.Process(
+            target=_worker_main,
+            args=(wid, self._spec, cq, self._free_q,
+                  self._out_q, self._cur_epoch, self._owners),
+            daemon=True, name="DecodeWorker-%d" % wid)
+        p.start()
+        self._ctrl[wid] = cq
+        self._procs[wid] = p
+
+    def _respawn(self, dead_wids, resume=True):
+        """Worker-death recovery: rebuild the WHOLE pool — every
+        worker, on FRESH queues — within the pool-wide restart budget
+        (MXNET_IO_WORKER_RESTARTS).  Returns False when the budget
+        cannot cover the dead set — the caller then hard-errors, the
+        pre-elastic behaviour.
+
+        The rebuild is total because surgical replacement cannot be
+        made kill-safe: a hard-killed worker (segfault, OOM kill) can
+        die HOLDING an mp.Queue lock — free_q's reader lock (a blocked
+        worker spends its life inside ``free_q.get`` holding it) or
+        out_q's writer lock — and every survivor sharing that queue
+        then wedges forever.  Fresh queues sidestep any poisoned lock;
+        the slab ring itself is raw shared memory (lock-free) and
+        carries over, as does the slot the consumer currently holds a
+        view into.
+
+        Determinism: called only once the out queue is drained (the
+        callers detect death from the empty-queue branch), so every
+        batch that reached the parent is counted in `self._delivered`.
+        Each worker — replacement and survivor alike — resumes its
+        (wid, epoch) shard slice at the first undelivered batch;
+        per-batch RNG derivation (seed, epoch, wid, seq) makes the
+        resumed streams bit-identical to an uninterrupted run, with
+        every record still decoded exactly once."""
+        import multiprocessing as mp
+        dead_wids = sorted(dead_wids)
+        if self._restarts_left < len(dead_wids):
+            return False
+        self._restarts_left -= len(dead_wids)
+        ctx = mp.get_context(_start_method())
+        # total teardown: a survivor may be blocked on a lock the
+        # corpse died holding — terminate, then kill the stubborn
+        for p in self._procs:
+            if p is not None and p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            if p is None:
+                continue
+            p.join(timeout=2.0)
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=1.0)
+        for q in (self._free_q, self._out_q):
+            try:
+                q.cancel_join_thread()
+                q.close()
+            except Exception:       # noqa: BLE001
+                pass
+        # fresh data plane: new queues, every slot free again except
+        # the one the consumer is holding a view into right now.  The
+        # held-slot read and the queue swap are ONE critical section
+        # with _recycle (a cross-thread SlabBatch.release racing this
+        # rebuild): a release that lands before the swap clears
+        # _current — its slot joins the rebuilt queue below; one that
+        # lands after targets the NEW queue, whose rebuild excluded
+        # the held slot.  Either way the slot survives exactly once.
+        with self._lock:
+            cur = self._current
+            held = cur._slot if cur is not None else -1
+            self._free_q = ctx.Queue()
+            self._out_q = ctx.Queue()
+            self._cur_epoch = ctx.Value("l", self._epoch, lock=False)
+            reclaimed = 0
+            for s in range(self._slots_n):
+                if self._owners[s] >= 0:
+                    reclaimed += 1
+                self._owners[s] = -1
+                if s != held:
+                    self._free_q.put(s)
+        for wid in range(self._workers_n):
+            self._spawn_worker(ctx, wid)
+            if resume and self._epoch >= 0 \
+                    and wid not in self._eoe_wids:
+                self._ctrl[wid].put(
+                    ("epoch", self._epoch,
+                     int(self._delivered.get(wid, 0))))
+        for wid in dead_wids:
+            events.incr("io.decode.worker_restarts")
+            try:
+                from ..telemetry import flightrec as _bb
+                _bb.record("io", "worker_restart", wid=int(wid),
+                           epoch=int(self._epoch),
+                           skip=int(self._delivered.get(wid, 0)),
+                           slots_reclaimed=reclaimed,
+                           restarts_left=int(self._restarts_left))
+            except Exception:       # noqa: BLE001 — forensics only
+                pass
+        warnings.warn(
+            "decode worker(s) %s died; pool rebuilt on fresh queues "
+            "(epoch %d resumes at each worker's first undelivered "
+            "batch; %d slot(s) reclaimed, %d restart(s) left)"
+            % (dead_wids, self._epoch, reclaimed, self._restarts_left),
+            RuntimeWarning, stacklevel=3)
+        return True
 
     def close(self):
         """Stop the pool and free the shared ring.  Idempotent; the
@@ -634,11 +785,15 @@ class DecodeService:
             except Exception:       # noqa: BLE001
                 pass
         for p in self._procs:
+            if p is None:
+                continue
             p.join(timeout=2.0)
             if p.is_alive():
                 p.terminate()
                 p.join(timeout=1.0)
         for q in [self._free_q, self._out_q] + self._ctrl:
+            if q is None:
+                continue
             try:
                 q.cancel_join_thread()
                 q.close()
@@ -667,12 +822,19 @@ class DecodeService:
 
     # -- slot recycling ------------------------------------------------
     def _recycle(self, slot, sb):
+        # capture the queue ref INSIDE the lock: _respawn swaps
+        # self._free_q under the same lock, so we either target the
+        # old queue (discarded — the rebuild re-frees our slot) or the
+        # new one (which the rebuild withheld our slot from); putting
+        # outside the critical section on a stale ref would leak the
+        # slot and shrink the ring
         with self._lock:
             if self._current is sb:
                 self._current = None
-        if not self._closed and self._free_q is not None:
+            q = None if self._closed else self._free_q
+        if q is not None:
             try:
-                self._free_q.put(slot)
+                q.put(slot)
             except Exception:       # noqa: BLE001
                 pass
 
@@ -697,10 +859,20 @@ class DecodeService:
         self._release_current()
         if self._epoch >= 0 and self._outstanding_alive():
             self._drain_epoch()
+        # a worker that died in a previous epoch must be back before
+        # the announce, or its shard of the new epoch silently stalls
+        dead = [wid for wid in range(self._workers_n)
+                if not self._procs[wid].is_alive()]
+        if dead and not self._respawn(dead, resume=False):
+            self._exhausted = True
+            raise RuntimeError(
+                "decode worker(s) %s died and the restart budget "
+                "(MXNET_IO_WORKER_RESTARTS) is exhausted" % dead)
         self._epoch += 1
         self._eoe_wids = set()
         self._exhausted = False
         self._consumed = False
+        self._delivered = {}
         self._cur_epoch.value = self._epoch
         for cq in self._ctrl:
             cq.put(("epoch", self._epoch))
@@ -730,6 +902,7 @@ class DecodeService:
             except _queue.Empty:
                 continue
             if msg[0] == "batch":
+                self._owners[msg[2]] = -1
                 self._free_q.put(msg[2])
             elif msg[0] in ("eoe", "error") and msg[1] == self._epoch:
                 self._eoe_wids.add(msg[2])
@@ -763,13 +936,23 @@ class DecodeService:
             except _queue.Empty:
                 outstanding = [wid for wid in range(self._workers_n)
                                if wid not in self._eoe_wids]
-                if outstanding and not self._outstanding_alive():
-                    # every worker still owing batches is dead: their
-                    # shard is lost — an error, not a quiet epoch end
+                dead = [wid for wid in outstanding
+                        if not self._procs[wid].is_alive()]
+                if dead:
+                    # a worker owing batches is dead and the queue is
+                    # drained (this branch): respawn it resuming its
+                    # (wid, epoch) slice at the first undelivered
+                    # batch — bit-identical stream, exactly-once
+                    # records — unless the budget ran dry, which is
+                    # the pre-elastic hard mid-epoch error
+                    if self._respawn(dead):
+                        t0 = time.perf_counter()    # fresh deadline
+                        continue
                     self._exhausted = True
                     raise RuntimeError(
-                        "decode worker(s) %s died mid-epoch"
-                        % outstanding)
+                        "decode worker(s) %s died mid-epoch and the "
+                        "restart budget (MXNET_IO_WORKER_RESTARTS) "
+                        "is exhausted" % dead)
                 if not outstanding:         # all sentinels seen (can
                     self._exhausted = True  # only happen via races)
                     raise StopIteration
@@ -786,7 +969,8 @@ class DecodeService:
             if tag == "ready":      # handshake straggler (restarted
                 continue            # pools); consumed in _start
             if tag == "batch" and msg[1] != self._epoch:
-                self._free_q.put(msg[2])    # stale (pre-reset straggler)
+                self._owners[msg[2]] = -1   # stale (pre-reset straggler)
+                self._free_q.put(msg[2])
                 continue
             if tag in ("eoe", "error") and msg[1] != self._epoch:
                 continue
@@ -803,6 +987,11 @@ class DecodeService:
                                    % (msg[2], msg[3]))
             break
         _, _, slot, count, wid, seq = msg
+        # delivery: the slot's owner mark clears (a respawn must not
+        # reclaim a slot the consumer holds) and the worker's resume
+        # point advances to the batch after this one
+        self._owners[slot] = -1
+        self._delivered[wid] = int(seq) + 1
         wait_s = time.perf_counter() - t0
         events.add_time("io.decode.wait_us", wait_s)
         if depth >= 0:

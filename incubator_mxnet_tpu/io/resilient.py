@@ -11,7 +11,11 @@ through untouched.
     buf = reader.read()          # survives a flaky NFS mount
 
 Retries are counted on `monitor.events` (``io.retry``); budgets come
-from MXNET_RETRY_MAX / MXNET_RETRY_BACKOFF unless overridden.
+from MXNET_RETRY_MAX / MXNET_RETRY_BACKOFF (or MXNET_RETRY_BACKOFF_MS)
+unless overridden, and the backoff is jittered-exponential — many
+readers tripped by the same storage blip must not hammer it back in
+lockstep (``retry_transient``'s policy; pass ``jitter=False`` for a
+deterministic full-window sleep).
 """
 from __future__ import annotations
 
@@ -26,7 +30,8 @@ __all__ = ["RetryingReader", "retry_io"]
 _RETRIED = ("read", "read_idx", "next_batch", "next", "__next__")
 
 
-def retry_io(fn, retries=None, backoff=None, what="io operation"):
+def retry_io(fn, retries=None, backoff=None, what="io operation",
+             jitter=True):
     """Run `fn()` under the transient-I/O retry policy.  Injected
     faults fire INSIDE the reader (fault sites io.read / io.slow at the
     actual I/O boundary), so what is retried here is exactly what a
@@ -35,7 +40,7 @@ def retry_io(fn, retries=None, backoff=None, what="io operation"):
     return retry_transient(fn, retries=retries, backoff=backoff,
                            what=what,
                            retryable=(fault.TransientFault, OSError),
-                           event="io.retry")
+                           event="io.retry", jitter=jitter)
 
 
 class RetryingReader:
@@ -46,10 +51,11 @@ class RetryingReader:
     sequential `read` keeps failing, the caller still owns recovery
     semantics — this wrapper never silently skips records."""
 
-    def __init__(self, reader, retries=None, backoff=None):
+    def __init__(self, reader, retries=None, backoff=None, jitter=True):
         self._reader = reader
         self._retries = retries
         self._backoff = backoff
+        self._jitter = jitter
 
     def __getattr__(self, name):
         attr = getattr(self._reader, name)
@@ -74,6 +80,7 @@ class RetryingReader:
                 return retry_io(attempt,
                                 retries=self._retries,
                                 backoff=self._backoff,
+                                jitter=self._jitter,
                                 what="%s.%s" % (
                                     type(self._reader).__name__, name))
             return wrapped
@@ -86,6 +93,7 @@ class RetryingReader:
                 yield retry_io(lambda: next(it),
                                retries=self._retries,
                                backoff=self._backoff,
+                               jitter=self._jitter,
                                what="%s iteration" % (
                                    type(self._reader).__name__,))
             except StopIteration:
@@ -94,4 +102,5 @@ class RetryingReader:
     def __next__(self):
         return retry_io(lambda: next(self._reader),
                         retries=self._retries, backoff=self._backoff,
+                        jitter=self._jitter,
                         what="%s next" % (type(self._reader).__name__,))

@@ -1,4 +1,4 @@
 """mx.kvstore namespace (ref: python/mxnet/kvstore/)."""
-from .kvstore import KVStore, create
+from .kvstore import KVStore, StaleMembership, create
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "StaleMembership", "create"]

@@ -35,7 +35,18 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from ..optimizer import Optimizer, get_updater
 
-__all__ = ["KVStore", "DistKVStore", "create"]
+__all__ = ["KVStore", "DistKVStore", "StaleMembership", "create"]
+
+
+class StaleMembership(MXNetError):
+    """A rank presented a membership generation older than the store's
+    current one — it belongs to a PREVIOUS mesh (it was declared down
+    and the survivors re-formed without it).  A stale rank must NOT be
+    allowed into a barrier/collective of the new generation: its
+    arrival would unbalance the collective and corrupt or deadlock the
+    reformed mesh.  The rank should exit and rejoin through the
+    elastic re-admission path (`parallel.elastic`), which hands it the
+    current generation."""
 
 
 def _is_list(x):
@@ -51,6 +62,48 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._compression = {}
+        # membership epoch (elastic mesh): bumped on every mesh
+        # shrink/grow so a rank from a previous mesh generation can be
+        # rejected at the barrier instead of corrupting a collective
+        self._generation = 0
+
+    # -- membership epochs (elastic mesh) -------------------------------
+    @property
+    def generation(self) -> int:
+        """Current membership generation.  Ranks tag their barrier
+        entries (and heartbeats) with the generation they joined under;
+        a mismatch means the mesh re-formed without them."""
+        return self._generation
+
+    def advance_generation(self, reason: str = "membership-change") -> int:
+        """Bump the membership epoch (elastic shrink/grow).  Every
+        in-flight credential from the previous generation — barrier
+        entries, heartbeats — becomes invalid atomically."""
+        self._generation += 1
+        from ..monitor import events
+        events.incr("kvstore.generation_advanced")
+        try:
+            from ..telemetry import flightrec as _bb
+            _bb.record("mesh", "generation", gen=self._generation,
+                       reason=reason)
+        except Exception:           # noqa: BLE001 — forensics must not
+            pass                    # change membership semantics
+        return self._generation
+
+    def check_generation(self, generation) -> None:
+        """Validate a rank's membership generation (None = unchecked,
+        the pre-elastic callers).  Raises `StaleMembership` on
+        mismatch and counts it (`kvstore.stale_rank`)."""
+        if generation is None:
+            return
+        if int(generation) != self._generation:
+            from ..monitor import events
+            events.incr("kvstore.stale_rank")
+            raise StaleMembership(
+                "rank presented membership generation %d but the "
+                "store is at generation %d — this rank belongs to a "
+                "previous mesh; exit and rejoin via elastic "
+                "re-admission" % (int(generation), self._generation))
 
     # ------------------------------------------------------------------
     def _is_dist(self):
@@ -213,8 +266,10 @@ class KVStore:
         with open(fname, "rb") as f:
             self._updater.set_states(f.read())
 
-    def _barrier(self):
-        pass
+    def _barrier(self, timeout=None, generation=None):
+        # in-process store: nothing to wait on, but membership is still
+        # enforced — a stale rank must not believe it passed a barrier
+        self.check_generation(generation)
 
     def _set_updater(self, updater):
         self._updater = updater
@@ -332,18 +387,24 @@ class DistKVStore(KVStore):
                 _np.asarray(data)))
         return self._retry(run, "kvstore broadcast (rank %d)" % self.rank)
 
-    def _barrier(self, timeout=None):
+    def _barrier(self, timeout=None, generation=None):
         """Barrier with a deadline: a worker that never arrives (hung
         host, dead process) turns into a clear rank-tagged error on the
         waiting workers instead of an indefinite hang.  `timeout` in
         seconds (default MXNET_KVSTORE_BARRIER_TIMEOUT; 0 = wait
-        forever, the reference behaviour).
+        forever, the reference behaviour).  `generation` is the
+        caller's membership epoch: a rank from a previous mesh
+        generation (declared down, mesh re-formed without it) is
+        rejected with `StaleMembership` BEFORE it can enter — an
+        unbalanced barrier entry would wedge or corrupt the reformed
+        collective.
 
         On timeout the waiter thread is abandoned mid-collective, so
         the process must be treated as wedged: the error is terminal —
         exit and let the scheduler restart the worker; do not issue
         further kvstore ops from this process."""
         from .. import config, fault as _fault
+        self.check_generation(generation)
         if timeout is None:
             timeout = float(config.get("MXNET_KVSTORE_BARRIER_TIMEOUT"))
         hang = _fault.should_fire("kvstore.barrier_hang")

@@ -4,11 +4,12 @@ reference's kvstore/NCCL/ps-lite stack (SURVEY §2.3, §5.8); the KVStore
 facade remains for API parity while this is the performance path.
 """
 from .mesh import make_mesh, Mesh, NamedSharding, P, replicated, \
-    batch_sharded, default_dp_mesh
+    batch_sharded, default_dp_mesh, mesh_devices, surviving_mesh
 from .functional import functionalize, extract_params, load_params
 from .trainer import (ShardedTrainer, softmax_ce_loss, sgd_momentum_tree,
                       adam_tree)
 from .resilience import ResilientTrainer, retry_transient
+from .elastic import ElasticTrainer, ReplicaHealth
 from .pipeline import (pipeline_apply, split_microbatches,
                        stack_stage_params)
 from .moe import switch_route, moe_apply, moe_ffn
@@ -18,8 +19,10 @@ from .ring_attention import (ring_attention, ulysses_attention,
 __all__ = ["make_mesh", "Mesh", "NamedSharding", "P", "replicated",
            "pipeline_apply", "split_microbatches", "stack_stage_params",
            "switch_route", "moe_apply", "moe_ffn",
-           "batch_sharded", "default_dp_mesh", "functionalize",
+           "batch_sharded", "default_dp_mesh", "mesh_devices",
+           "surviving_mesh", "functionalize",
            "extract_params", "load_params", "ShardedTrainer",
-           "ResilientTrainer", "retry_transient",
+           "ResilientTrainer", "ElasticTrainer", "ReplicaHealth",
+           "retry_transient",
            "softmax_ce_loss", "sgd_momentum_tree", "adam_tree",
            "ring_attention", "ulysses_attention", "local_attention"]

@@ -15,7 +15,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_mesh", "Mesh", "NamedSharding", "P", "replicated",
-           "batch_sharded", "default_dp_mesh", "replica_contexts"]
+           "batch_sharded", "default_dp_mesh", "replica_contexts",
+           "mesh_devices", "surviving_mesh"]
 
 
 def make_mesh(shape: Sequence[int] = None,
@@ -35,6 +36,28 @@ def make_mesh(shape: Sequence[int] = None,
 
 def default_dp_mesh() -> Mesh:
     return make_mesh()
+
+
+def mesh_devices(mesh: Mesh):
+    """The mesh's devices as a flat list (replica order: the order
+    `make_mesh` laid them out in)."""
+    return list(mesh.devices.flat)
+
+
+def surviving_mesh(devices, lost=(), axis_names=("data",)) -> Mesh:
+    """Re-form a 1-d data mesh from `devices` minus the replicas in
+    `lost` (indices into `devices`) — the elastic shrink/grow path.
+    Delegates to `make_mesh` so mesh construction stays in one place;
+    survivor ORDER is preserved, which is what keeps a re-formed mesh
+    deterministic: the same survivor set always yields the same device
+    layout (and therefore the same shardings and the same compiled
+    step)."""
+    lost = set(int(i) for i in lost)
+    keep = [d for i, d in enumerate(devices) if i not in lost]
+    if not keep:
+        raise ValueError("no surviving devices (lost=%s of %d)"
+                         % (sorted(lost), len(list(devices))))
+    return make_mesh((len(keep),), axis_names, devices=keep)
 
 
 def replica_contexts(mesh: Optional[Mesh] = None):

@@ -68,16 +68,26 @@ _TMP_PREFIX = ".tmp_"
 
 def retry_transient(fn, retries=None, backoff=None, what="operation",
                     retryable=(fault.TransientFault, OSError),
-                    event="resilience.retry"):
-    """Call `fn()`, retrying `retries` times with exponential backoff on
-    transient failures.  Each retry increments `event` on
-    monitor.events (callers pick their own counter so concurrent
+                    event="resilience.retry", jitter=True):
+    """Call `fn()`, retrying `retries` times with JITTERED exponential
+    backoff on transient failures: the window doubles per attempt and
+    each sleep is drawn uniformly from [window/2, window], so a fleet
+    of workers tripped by the same storage/collective blip does not
+    retry in lockstep (the thundering herd that turns one blip into
+    three).  `backoff` seeds the window; when None it comes from
+    MXNET_RETRY_BACKOFF_MS (milliseconds, when > 0) else
+    MXNET_RETRY_BACKOFF (seconds).  `jitter=False` sleeps the full
+    window deterministically (tests).  Each retry increments `event`
+    on monitor.events (callers pick their own counter so concurrent
     retries in different subsystems don't pollute each other)."""
+    import random
     from .. import config
     if retries is None:
         retries = int(config.get("MXNET_RETRY_MAX"))
     if backoff is None:
-        backoff = float(config.get("MXNET_RETRY_BACKOFF"))
+        ms = float(config.get("MXNET_RETRY_BACKOFF_MS"))
+        backoff = ms / 1e3 if ms > 0 else \
+            float(config.get("MXNET_RETRY_BACKOFF"))
     attempt = 0
     while True:
         try:
@@ -87,9 +97,11 @@ def retry_transient(fn, retries=None, backoff=None, what="operation",
             if attempt > retries:
                 raise
             events.incr(event)
+            delay = backoff if not jitter else \
+                random.uniform(backoff / 2.0, backoff)
             log.warning("%s failed (%s); retry %d/%d in %.3fs",
-                        what, e, attempt, retries, backoff)
-            time.sleep(backoff)
+                        what, e, attempt, retries, delay)
+            time.sleep(delay)
             backoff *= 2.0
 
 
@@ -412,7 +424,11 @@ class ResilientTrainer:
                     "loss_ema": self.loss_ema,
                     "loss_scale": self.scaler.loss_scale,
                     "scaler_unskipped": self.scaler._unskipped,
-                    "bad_steps": self.bad_steps}
+                    "bad_steps": self.bad_steps,
+                    # forensics for elastic restores: which mesh wrote
+                    # this (load_checkpoint re-places onto ANY mesh;
+                    # the size delta is logged, not rejected)
+                    "mesh_devices": len(list(t.mesh.devices.flat))}
             with open(os.path.join(tmp, _META), "w") as f:
                 json.dump(meta, f)
             os.replace(tmp, final)
@@ -513,6 +529,15 @@ class ResilientTrainer:
                 "checkpoint %s was written with RNG seed %s but this "
                 "trainer uses seed %d — resume would not be "
                 "deterministic" % (name, meta.get("seed"), self.seed))
+        here = len(list(self.trainer.mesh.devices.flat))
+        wrote = meta.get("mesh_devices")
+        if wrote is not None and int(wrote) != here:
+            # elastic shrink/grow: state saved on an N-way mesh lands
+            # re-placed (and, under zero=1, re-SHARDED) on this one
+            events.incr("resilience.mesh_resize_restore")
+            log.info("checkpoint %s written on a %s-device mesh, "
+                     "restored onto %d devices (state re-sharded)",
+                     name, wrote, here)
         self.loss_ema = meta.get("loss_ema")
         self.scaler.loss_scale = float(meta.get("loss_scale", 1.0))
         self.scaler._unskipped = int(meta.get("scaler_unskipped", 0))

@@ -177,6 +177,18 @@ class ShardedTrainer:
             arr = _np.asarray(value)
             return jax.make_array_from_callback(
                 arr.shape, sharding, lambda idx: arr[idx])
+        # CPU backend only: its device_put zero-copy ALIASES the source
+        # buffer (verified in the decode-service hardening), so placing
+        # the block's own param array and then DONATING it in the fused
+        # step would free the block's buffer out from under it — fatal
+        # the moment anything re-reads the block (a second trainer on
+        # the same net, an elastic mesh rebuild).  One host copy per
+        # param per trainer build is the price there.  Real
+        # accelerators H2D-copy anyway — forcing _np.array() on them
+        # would turn a device-resident `value` into a D2H round trip
+        # per param on every (elastic re)build.
+        if any(d.platform == "cpu" for d in sharding.device_set):
+            return jax.device_put(_np.array(value, copy=True), sharding)
         return jax.device_put(jnp.asarray(value), sharding)
 
     def _zero_spec(self, name, shape):
@@ -337,6 +349,25 @@ class ShardedTrainer:
         # batch pytree by DeviceFeed._place_sharded
         return DeviceFeed(source, sharding=self._batch_sharding,
                           depth=depth, transform=transform)
+
+    @property
+    def data_parallel_size(self) -> int:
+        """Replicas along the batch axis (the elastic supervisor's
+        batch/LR scaling denominator)."""
+        return int(self.mesh.shape[self.batch_axis])
+
+    def release(self):
+        """Drop this trainer's device state — params, optimizer state,
+        compiled step.  An elastic supervisor calls this on the OLD
+        trainer before materializing its successor on a different
+        mesh, so the old copies free before the new ones allocate (at
+        pod scale, holding both generations of a ZeRO-sharded state
+        doubles the HBM bill exactly when a replica just died).  The
+        trainer is unusable afterwards; the state lives on in the
+        checkpoint the successor restores."""
+        self.params = {}
+        self.opt_state = None
+        self._step = None
 
     def sync_to_block(self):
         """Write trained params back into the Gluon block."""

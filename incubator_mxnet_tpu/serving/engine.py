@@ -50,6 +50,15 @@ center on).  The engine closes the executable set instead:
 Multi-device replica dispatch: pass `devices=[ctx, ...]` (or build via
 `ShardedTrainer.serve()` / `parallel.mesh.replica_contexts`) and the
 dispatcher round-robins buckets across per-device parameter replicas.
+The round-robin is HEALTH-AWARE (the serving twin of the elastic
+training mesh, ISSUE 7): `MXNET_SERVE_REPLICA_FAILS` consecutive
+terminal dispatch failures on one replica mark it unhealthy
+(`serve.replica_unhealthy` counter + a flight-recorder event naming
+the device) and traffic routes around it; after
+`MXNET_SERVE_REPLICA_COOLDOWN_S` ONE probe batch is routed back —
+success re-admits it (`serve.replica_recovered`), failure restarts the
+cooldown.  With every replica unhealthy the engine fails OPEN (soonest
+cooldown first): degraded service beats refused service.
 
 The uint8 wire contract matches PR 2's training path: with
 `HybridBlock.set_input_transform(normalize_transform(...))` installed,
@@ -203,6 +212,12 @@ class InferenceEngine:
         self._n_batches = 0
         self._dev_batches = [0] * len(self._ctxs)
         self._n_inflight = 0
+        # replica health (round-robin routes around a failing device)
+        self._max_fails = int(_cfg.get("MXNET_SERVE_REPLICA_FAILS"))
+        self._cooldown = float(
+            _cfg.get("MXNET_SERVE_REPLICA_COOLDOWN_S"))
+        self._fail_streak = [0] * len(self._ctxs)
+        self._unhealthy_until = [0.0] * len(self._ctxs)  # 0 = healthy
         if len(self._ctxs) > 1:
             # replica overlap: one single-thread worker per device so
             # device k+1 executes while device k is still busy; the
@@ -557,8 +572,7 @@ class InferenceEngine:
         # counters (totals) cannot reconstruct
         _bb.record("serve", "queue", depth=self._q.qsize(),
                    bucket=bucket, n=total)
-        dev_i = self._rr % len(self._ctxs)
-        self._rr += 1
+        dev_i = self._pick_replica()
         if self._pools is None:
             self._run_and_fan(live, total, bucket, dev_i)
             return
@@ -581,6 +595,79 @@ class InferenceEngine:
             for r in live:              # resolve here, never strand
                 self._finish(r, exc=EngineClosed(
                     "engine closed before dispatch"))
+
+    # -- replica health ------------------------------------------------
+    def _pick_replica(self):
+        """Health-aware round-robin: skip replicas inside their
+        unhealthy cooldown; a replica whose cooldown expired gets ONE
+        probe batch (its window re-arms immediately, so a second batch
+        does not pile onto an unproven device before the probe's
+        verdict).  All-unhealthy fails OPEN to the soonest-recovering
+        replica — degraded service beats refused service."""
+        n = len(self._ctxs)
+        if n == 1:
+            self._rr += 1
+            return 0
+        now = time.monotonic()
+        with self._lock:
+            for _ in range(n):
+                i = self._rr % n
+                self._rr += 1
+                until = self._unhealthy_until[i]
+                if until == 0.0:
+                    return i
+                if now >= until:
+                    # probe: one batch back onto the cooled-down
+                    # replica; success re-admits it (_replica_ok),
+                    # failure restarts the cooldown (_replica_failed)
+                    self._unhealthy_until[i] = now + self._cooldown
+                    events.incr("serve.replica_probes")
+                    return i
+            i = min(range(n), key=lambda k: self._unhealthy_until[k])
+        events.incr("serve.all_replicas_unhealthy")
+        return i
+
+    def _replica_failed(self, dev_i, exc):
+        """A terminal dispatch failure (the retry budget is already
+        spent by the time this is called) on replica `dev_i`."""
+        newly = False
+        with self._lock:
+            self._fail_streak[dev_i] += 1
+            streak = self._fail_streak[dev_i]
+            if streak >= self._max_fails or \
+                    self._unhealthy_until[dev_i] > 0.0:
+                newly = self._unhealthy_until[dev_i] == 0.0
+                self._unhealthy_until[dev_i] = \
+                    time.monotonic() + self._cooldown
+        if newly:
+            events.incr("serve.replica_unhealthy")
+            _bb.record("serve", "replica_unhealthy",
+                       replica=int(dev_i),
+                       device=repr(self._ctxs[dev_i]),
+                       consecutive_fails=int(streak),
+                       error=type(exc).__name__,
+                       cooldown_s=self._cooldown)
+            import logging
+            logging.getLogger(__name__).warning(
+                "serving replica %d (%r) marked unhealthy after %d "
+                "consecutive failures (%s); routing around it for "
+                "%.1fs", dev_i, self._ctxs[dev_i], streak,
+                type(exc).__name__, self._cooldown)
+
+    def _replica_ok(self, dev_i):
+        """A successful dispatch: the streak resets, and an unhealthy
+        replica (this was its probe) is re-admitted."""
+        recovered = False
+        with self._lock:
+            self._fail_streak[dev_i] = 0
+            if self._unhealthy_until[dev_i] > 0.0:
+                self._unhealthy_until[dev_i] = 0.0
+                recovered = True
+        if recovered:
+            events.incr("serve.replica_recovered")
+            _bb.record("serve", "replica_recovered",
+                       replica=int(dev_i),
+                       device=repr(self._ctxs[dev_i]))
 
     def _run_and_fan(self, live, total, bucket, dev_i):
         """Pad→execute→fan-out for one coalesced batch — inline on a
@@ -613,9 +700,11 @@ class InferenceEngine:
                         event="serve.retries")
             except Exception as e:      # noqa: BLE001 — fan the failure
                 events.incr("serve.failed")
+                self._replica_failed(dev_i, e)
                 for r in live:          # out to every caller's future
                     self._finish(r, exc=e)
                 return
+            self._replica_ok(dev_i)
             events.observe_time("serve.infer_us",
                                 time.monotonic() - t0)
             events.incr("serve.batches")
@@ -820,10 +909,15 @@ class InferenceEngine:
     def stats(self):
         """Engine + process-wide `serve.*` counter snapshot, including
         latency percentiles (p50/p90/p99) for the observed series."""
+        now = time.monotonic()
         return {"counters": serve_counters(),
                 "latency": events.latency_snapshot("serve."),
                 "buckets": list(self._buckets),
                 "devices": [repr(c) for c in self._ctxs],
                 "device_batches": list(self._dev_batches),
+                "replica_health": [
+                    "unhealthy" if u > now else
+                    ("probing" if u > 0.0 else "healthy")
+                    for u in self._unhealthy_until],
                 "queue_depth": self._q.qsize(),
                 "warm": self._warm}

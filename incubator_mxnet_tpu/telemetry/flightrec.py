@@ -22,6 +22,8 @@ read.
 Dump triggers (all end in `dump_blackbox()`):
 
 - NaN-rollback and preemption in `ResilientTrainer`
+- a mesh shrink in `parallel/elastic.py` (a replica died: the dump
+  names it and carries the health timeline that condemned it)
 - the serving dispatcher's error backstop (`serving/engine.py`)
 - uncaught exceptions: `sys.excepthook` + `threading.excepthook`
   (a raising feed/dispatcher worker leaves a dump, not silence)
@@ -48,10 +50,11 @@ from collections import deque
 from .. import config as _cfg
 from ..monitor import events
 
-__all__ = ["enabled", "enable", "record", "ring_snapshot", "clear",
-           "configure", "hbm_sample", "hbm_peaks", "sample_counters",
-           "dump_blackbox", "crash_dump", "install_crash_hooks",
-           "uninstall_crash_hooks", "last_dump_path"]
+__all__ = ["enabled", "enable", "record", "record_mesh", "ring_snapshot",
+           "clear", "configure", "hbm_sample", "hbm_peaks",
+           "sample_counters", "dump_blackbox", "crash_dump",
+           "install_crash_hooks", "uninstall_crash_hooks",
+           "last_dump_path"]
 
 SCHEMA = "mxtpu-blackbox/1"
 
@@ -124,6 +127,17 @@ def record(kind: str, name: str, **data):
         # re-read under the lock: a concurrent configure() swaps the
         # ring, and appending to the discarded deque loses the event
         _RING.append(ev)
+
+
+def record_mesh(phase: str, **data):
+    """Mesh-transition marker (the elastic trainer's forensic trail):
+    one ring event under kind ``mesh`` — ``replica_down`` /
+    ``replica_slow`` / ``shrink`` / ``grow`` / ``generation`` — with
+    the replica ids, device labels and step in `data`.  A mesh-shrink
+    black-box dump is read by exactly these events: the dump NAMES the
+    lost replica because this marker landed in the ring before
+    `crash_dump("mesh.shrink")` snapshotted it."""
+    record("mesh", phase, **data)
 
 
 def clear():

@@ -77,6 +77,13 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "io: input-pipeline / decode-service tests "
         "(CPU-fast, run in tier-1 by default)")
+    # the elastic-mesh suite (heartbeat health, membership epochs,
+    # shrink/re-admission on the virtual mesh) is CPU-fast and runs in
+    # tier-1 by default; the marker lets it be selected or excluded
+    # explicitly (pytest -m elastic)
+    config.addinivalue_line(
+        "markers", "elastic: elastic-mesh replica loss/re-admission "
+        "tests (CPU-fast, run in tier-1 by default)")
 
 
 @pytest.fixture(autouse=True)

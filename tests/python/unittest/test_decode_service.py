@@ -498,3 +498,105 @@ def test_check_feed_gate():
         [sys.executable, os.path.abspath(script), "--repeats", "2"],
         capture_output=True, text=True, timeout=600, env=env)
     assert res.returncode == 0, res.stdout + res.stderr
+
+
+# ---------------------------------------------------------------------------
+# worker death -> auto-respawn (ISSUE 7 satellite): the replacement
+# resumes the corpse's (wid, epoch) slice at the first undelivered
+# batch, so the stream stays bit-identical and exactly-once
+# ---------------------------------------------------------------------------
+
+def _stream_map(svc):
+    """One epoch as {(wid, seq): (data, label)} copies."""
+    return {(sb.wid, sb.seq): (sb.data.copy(), sb.label.copy())
+            for sb in svc}
+
+
+@needs_service
+def test_worker_death_respawns_bit_identical(rec_file):
+    """SIGKILL one worker mid-epoch: the pool respawns it, the epoch
+    still delivers every (wid, seq) batch with byte-identical pixels
+    (per-batch RNG derivation), and the restart is counted."""
+    import time as _time
+    from incubator_mxnet_tpu.monitor import events
+
+    def make():
+        # batch=2 -> 10 batches/worker shard, ring of 6: a worker can
+        # NEVER finish its shard before the consumer pulls, so the
+        # victim is guaranteed to still owe batches when it dies
+        return DecodeService(rec_file, 2, (3, 16, 16), workers=2,
+                             shuffle=True, seed=13, rand_crop=True,
+                             rand_mirror=True, dtype="uint8")
+
+    ref_svc = make()
+    try:
+        ref = _stream_map(ref_svc)
+    finally:
+        ref_svc.close()
+
+    svc = make()
+    try:
+        it = iter(svc)
+        first = next(it)                # epoch announced, pool running
+        got = {(first.wid, first.seq): (first.data.copy(),
+                                        first.label.copy())}
+        _time.sleep(0.3)                # let the ring fill / workers block
+        restarts0 = events.get("io.decode.worker_restarts")
+        svc._procs[0].kill()
+        while True:                     # NOT `for sb in it`: a second
+            try:                        # __iter__ would reset() the
+                sb = next(it)           # half-consumed epoch away
+            except StopIteration:
+                break
+            got[(sb.wid, sb.seq)] = (sb.data.copy(), sb.label.copy())
+        assert events.get("io.decode.worker_restarts") == restarts0 + 1
+    finally:
+        svc.close()
+
+    assert got.keys() == ref.keys()
+    for k in ref:
+        onp.testing.assert_array_equal(got[k][0], ref[k][0])
+        onp.testing.assert_array_equal(got[k][1], ref[k][1])
+
+
+@needs_service
+def test_worker_death_budget_exhausted_is_hard_error(rec_file):
+    """MXNET_IO_WORKER_RESTARTS=0 keeps the pre-elastic contract: a
+    dead worker is a hard mid-epoch error naming the budget."""
+    import time as _time
+    from incubator_mxnet_tpu import config
+    config.set("MXNET_IO_WORKER_RESTARTS", 0)
+    try:
+        svc = DecodeService(rec_file, 2, (3, 16, 16), workers=2,
+                            shuffle=True, seed=13, dtype="uint8")
+        try:
+            it = iter(svc)
+            next(it)
+            _time.sleep(0.3)
+            svc._procs[0].kill()
+            with pytest.raises(RuntimeError, match="restart budget"):
+                while True:
+                    next(it)
+        finally:
+            svc.close()
+    finally:
+        config.unset("MXNET_IO_WORKER_RESTARTS")
+
+
+@needs_service
+def test_worker_death_between_epochs_respawned_at_reset(rec_file):
+    """A worker that dies BETWEEN epochs (idle, waiting for the next
+    announce) is respawned before the announce, and the new epoch
+    still covers every record exactly once."""
+    from incubator_mxnet_tpu.monitor import events
+    svc = DecodeService(rec_file, 8, (3, 16, 16), workers=2,
+                        shuffle=True, seed=4, dtype="uint8")
+    try:
+        assert sorted(_collect_ids(svc)) == list(range(N_REC))
+        svc._procs[1].kill()
+        svc._procs[1].join(timeout=5.0)
+        restarts0 = events.get("io.decode.worker_restarts")
+        assert sorted(_collect_ids(svc)) == list(range(N_REC))
+        assert events.get("io.decode.worker_restarts") == restarts0 + 1
+    finally:
+        svc.close()

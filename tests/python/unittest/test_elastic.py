@@ -1,0 +1,269 @@
+"""Elastic mesh (parallel/elastic.py): replica loss and re-admission
+on the 8-way virtual CPU mesh, driven end to end through the REAL
+mechanisms — kvstore heartbeats, staleness detection, membership
+generations, atomic-checkpoint restore — with failures injected only
+at the heartbeat source (MXNET_FAULT_PLAN mesh.replica_down /
+mesh.replica_slow suppress the victim's beats; everything downstream
+is the production path)."""
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import config, fault, gluon, nd, parallel
+from incubator_mxnet_tpu.kvstore import StaleMembership, create as kv_create
+from incubator_mxnet_tpu.monitor import events
+from incubator_mxnet_tpu.parallel.elastic import ReplicaHealth
+
+import jax
+
+pytestmark = pytest.mark.elastic
+
+# batch divisible by every mesh size a shrink can visit (8 and 7)
+BATCH = 8 * 7
+
+
+def _factory(seed=11):
+    """ElasticTrainer build_trainer: pure in (mesh, lr_factor) — the
+    net re-initializes from a fixed seed and all trained state comes
+    from the checkpoint restore, which is what makes a post-shrink
+    rebuild bit-deterministic."""
+    def build(mesh, lr_factor):
+        mx.random.seed(seed)
+        net = gluon.nn.HybridSequential(prefix="el_")
+        net.add(gluon.nn.Dense(16, in_units=8, activation="relu",
+                               prefix="el_d1_"),
+                gluon.nn.Dense(4, in_units=16, prefix="el_d2_"))
+        net.initialize(force_reinit=True)
+        net(nd.ones((2, 8)))
+        return parallel.ShardedTrainer(net, optimizer="adam",
+                                       lr=1e-2 * lr_factor, mesh=mesh)
+    return build
+
+
+def _data_fn(step, n_replicas):
+    """Pure (step, n_replicas) -> batch: the elastic replay contract."""
+    rs = np.random.RandomState(1000 + step)
+    x = rs.randn(BATCH, 8).astype(np.float32)
+    y = rs.randint(0, 4, BATCH)
+    return x, y
+
+
+def _plan(spec):
+    config.set("MXNET_FAULT_PLAN", spec)
+    fault.reset_from_config()
+
+
+def _clear_plan():
+    fault.clear()
+    config.unset("MXNET_FAULT_PLAN")
+
+
+# ---------------------------------------------------------------------------
+# mesh re-formation
+# ---------------------------------------------------------------------------
+
+def test_surviving_mesh_preserves_order():
+    devs = jax.devices()
+    m = parallel.surviving_mesh(devs, lost=[3])
+    kept = parallel.mesh_devices(m)
+    assert len(kept) == len(devs) - 1
+    assert kept == [d for i, d in enumerate(devs) if i != 3]
+    # same survivor set -> same layout (deterministic re-form)
+    m2 = parallel.surviving_mesh(devs, lost=[3])
+    assert parallel.mesh_devices(m2) == kept
+
+
+def test_surviving_mesh_no_survivors_raises():
+    devs = jax.devices()
+    with pytest.raises(ValueError):
+        parallel.surviving_mesh(devs, lost=range(len(devs)))
+
+
+# ---------------------------------------------------------------------------
+# kvstore membership generations
+# ---------------------------------------------------------------------------
+
+def test_kvstore_generation_rejects_stale_rank():
+    kv = kv_create("local")
+    assert kv.generation == 0
+    kv._barrier(generation=0)           # current generation passes
+    kv._barrier(generation=None)        # pre-elastic callers unchecked
+    gen0 = kv.generation
+    assert kv.advance_generation("test") == gen0 + 1
+    stale0 = events.get("kvstore.stale_rank")
+    with pytest.raises(StaleMembership):
+        kv._barrier(generation=gen0)
+    assert events.get("kvstore.stale_rank") == stale0 + 1
+    kv._barrier(generation=kv.generation)
+
+
+# ---------------------------------------------------------------------------
+# heartbeat health layer
+# ---------------------------------------------------------------------------
+
+def test_replica_health_staleness_verdicts():
+    kv = kv_create("local")
+    h = ReplicaHealth(kv, 4, stale_steps=1, down_steps=2)
+    active = range(4)
+    h.beat_all(0, active)
+    assert h.poll(0, active) == {r: "healthy" for r in active}
+    h.suppress(3)                       # replica 3 dies at step 1
+    down0 = events.get("mesh.replica_down")
+    slow0 = events.get("mesh.replica_slow")
+    h.beat_all(1, active)
+    assert h.poll(1, active)[3] == "slow"
+    h.beat_all(2, active)
+    v = h.poll(2, active)
+    assert v[3] == "down"
+    assert all(v[r] == "healthy" for r in range(3))
+    # transitions counted ONCE, not per poll
+    h.poll(2, active)
+    assert events.get("mesh.replica_down") == down0 + 1
+    assert events.get("mesh.replica_slow") == slow0 + 1
+
+
+def test_replica_health_rejects_stale_generation_beat():
+    kv = kv_create("local")
+    h = ReplicaHealth(kv, 2, stale_steps=1, down_steps=2)
+    assert h.beat(0, step=0)
+    kv.advance_generation("shrink")
+    h.set_generation(kv.generation)
+    stale0 = events.get("mesh.stale_rank_beat")
+    # a rank still tagging beats with the OLD generation is rejected:
+    # re-admission is the supervisor's explicit decision
+    assert not h.beat(1, step=1, generation=0)
+    assert events.get("mesh.stale_rank_beat") == stale0 + 1
+    assert h.poll(1, [1])[1] != "healthy"
+    assert h.beat(1, step=1)            # current generation: accepted
+
+
+# ---------------------------------------------------------------------------
+# the elastic supervisor
+# ---------------------------------------------------------------------------
+
+def test_replica_slow_is_observed_not_shrunk(tmp_path):
+    """Observation-only contract under the DEFAULT staleness knobs
+    (stale=1, down=2): a slow replica misses exactly `stale` beats —
+    reported, never shrunk (the window must stay strictly below the
+    down threshold)."""
+    _plan("mesh.replica_slow@2")
+    try:
+        et = parallel.ElasticTrainer(
+            _factory(), ckpt_dir=str(tmp_path / "ck"), ckpt_interval=3,
+            seed=5, handle_sigterm=False)
+        slow0 = events.get("mesh.replica_slow")
+        down0 = events.get("mesh.replica_down")
+        et.run(_data_fn, 6)
+        assert events.get("mesh.replica_slow") == slow0 + 1
+        assert events.get("mesh.replica_down") == down0
+        assert et.n_replicas == 8 and not et.transitions
+        assert et.state == "healthy"
+    finally:
+        _clear_plan()
+
+
+def test_shrink_below_min_replicas_raises(tmp_path):
+    _plan("mesh.replica_down@1")
+    try:
+        et = parallel.ElasticTrainer(
+            _factory(), ckpt_dir=str(tmp_path / "ck"), ckpt_interval=2,
+            seed=5, min_replicas=8, handle_sigterm=False)
+        with pytest.raises(RuntimeError, match="min_replicas"):
+            et.run(_data_fn, 8)
+    finally:
+        _clear_plan()
+
+
+def test_elastic_shrink_matches_from_checkpoint_run_bitwise(tmp_path):
+    """The acceptance contract: replica_down@K on the 8-way mesh
+    shrinks to 7, training continues with re-sharded state, and the
+    post-shrink losses equal a from-checkpoint 7-way run BIT FOR BIT;
+    the shrink leaves a black-box dump naming the lost replica."""
+    ck = str(tmp_path / "ck")
+    n_steps = 8
+    _plan("mesh.replica_down@2")
+    try:
+        et = parallel.ElasticTrainer(
+            _factory(), ckpt_dir=ck, ckpt_interval=2, keep=50, seed=5,
+            steps_per_epoch=None, handle_sigterm=False)
+        assert et.n_replicas == 8
+        shrinks0 = events.get("mesh.shrinks")
+        losses = et.run(_data_fn, n_steps)
+    finally:
+        _clear_plan()
+
+    assert et.n_replicas == 7
+    assert events.get("mesh.shrinks") == shrinks0 + 1
+    [tr] = [t for t in et.transitions if t["kind"] == "shrink"]
+    lost = tr["lost"]
+    assert lost == [7]                  # victim: highest active rid
+    resumed = tr["resumed_step"]
+    assert tr["steps_lost"] == tr["step"] - resumed >= 0
+
+    # -- forensics: the dump names the lost replica and its device
+    assert et.last_blackbox and os.path.exists(et.last_blackbox)
+    dump = json.load(open(et.last_blackbox))
+    assert dump["reason"] == "mesh.shrink"
+    mesh_ev = {e["name"]: e for e in dump["events"]
+               if e.get("kind") == "mesh"}
+    assert mesh_ev["shrink"]["lost"] == lost
+    assert mesh_ev["shrink"]["survivors"] == 7
+    assert "CpuDevice(id=7)" in mesh_ev["shrink"]["devices"][0]
+    assert mesh_ev["replica_down"]["replica"] == 7
+
+    # -- membership epoch advanced: a stale rank cannot re-enter
+    assert et.kv.generation == 1
+    with pytest.raises(StaleMembership):
+        et.kv._barrier(generation=0)
+
+    # -- bit-determinism: a control run built directly on the 7-way
+    # surviving mesh, restored from the SAME checkpoint the shrink
+    # resumed from, replays steps [resumed, n_steps) identically
+    control = _factory()(parallel.surviving_mesh(jax.devices(),
+                                                 lost=lost), 7.0 / 8.0)
+    rc = parallel.ResilientTrainer(control, ckpt_dir=ck, seed=5,
+                                   ckpt_interval=0,
+                                   handle_sigterm=False)
+    assert rc._restore_from(rc._ckpt_name(resumed))
+    assert control._n_step == resumed
+    for s in range(resumed, n_steps):
+        x, y = _data_fn(s, 7)
+        loss, ok = rc.step(x, y)
+        assert ok
+        assert float(loss) == losses[s], \
+            "step %d: elastic %r != control %r" % (s, losses[s],
+                                                   float(loss))
+
+
+def test_elastic_readmission_at_epoch_boundary(tmp_path):
+    """Lost replica re-admitted at the next epoch boundary: the mesh
+    grows back to 8, generation advances again, no steps are lost on
+    the grow, and the transition lands in counters + the ring."""
+    from incubator_mxnet_tpu.telemetry import flightrec as _bb
+    _plan("mesh.replica_down@2")
+    try:
+        et = parallel.ElasticTrainer(
+            _factory(), ckpt_dir=str(tmp_path / "ck"), ckpt_interval=2,
+            seed=5, steps_per_epoch=6, handle_sigterm=False)
+        grows0 = events.get("mesh.grows")
+        readmit0 = events.get("mesh.replica_readmitted")
+        et.run(_data_fn, 10)
+    finally:
+        _clear_plan()
+    kinds = [t["kind"] for t in et.transitions]
+    assert kinds == ["shrink", "grow"]
+    grow = et.transitions[1]
+    assert grow["step"] % 6 == 0        # the epoch boundary
+    assert grow["readmitted"] == [7]
+    assert et.n_replicas == 8 and et.state == "healthy"
+    assert events.get("mesh.grows") == grows0 + 1
+    assert events.get("mesh.replica_readmitted") == readmit0 + 1
+    # two membership epochs: shrink + grow
+    assert et.kv.generation == 2
+    ring = [e for e in _bb.ring_snapshot() if e.get("kind") == "mesh"]
+    assert any(e["name"] == "grow" and e.get("readmitted") == [7]
+               for e in ring)

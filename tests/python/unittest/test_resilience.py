@@ -409,3 +409,183 @@ def test_event_counters_snapshot(tmp_path):
     assert snap["resilience.checkpoint_written"] >= 2   # initial + step 2
     assert snap["resilience.step_skipped"] == 1
     assert snap["fault.injected"] == 1
+
+
+# ---------------------------------------------------------------------------
+# jittered exponential backoff (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+def _failing_then_ok(n_failures):
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] <= n_failures:
+            raise fault.TransientFault("blip %d" % calls["n"])
+        return "ok"
+    return fn
+
+
+def test_retry_backoff_jitter_window(monkeypatch):
+    """Each retry sleeps a uniform draw from [window/2, window], the
+    window doubling per attempt — the anti-thundering-herd contract."""
+    import time as _time
+    sleeps = []
+    monkeypatch.setattr(_time, "sleep", lambda s: sleeps.append(s))
+    out = parallel.retry_transient(_failing_then_ok(3), retries=3,
+                                   backoff=0.1, what="jitter-test")
+    assert out == "ok"
+    assert len(sleeps) == 3
+    for i, s in enumerate(sleeps):
+        window = 0.1 * (2 ** i)
+        assert window / 2.0 <= s <= window, (i, s)
+
+
+def test_retry_backoff_no_jitter_is_deterministic(monkeypatch):
+    import time as _time
+    sleeps = []
+    monkeypatch.setattr(_time, "sleep", lambda s: sleeps.append(s))
+    parallel.retry_transient(_failing_then_ok(3), retries=3,
+                             backoff=0.1, what="nojitter-test",
+                             jitter=False)
+    assert sleeps == [0.1, 0.2, 0.4]
+
+
+def test_retry_backoff_ms_knob_overrides(monkeypatch):
+    """MXNET_RETRY_BACKOFF_MS > 0 seeds the window in milliseconds,
+    overriding MXNET_RETRY_BACKOFF."""
+    import time as _time
+    from incubator_mxnet_tpu import config
+    sleeps = []
+    monkeypatch.setattr(_time, "sleep", lambda s: sleeps.append(s))
+    config.set("MXNET_RETRY_BACKOFF_MS", 40.0)
+    try:
+        parallel.retry_transient(_failing_then_ok(2), retries=2,
+                                 what="ms-knob-test", jitter=False)
+    finally:
+        config.unset("MXNET_RETRY_BACKOFF_MS")
+    assert sleeps == [0.04, 0.08]
+
+
+def test_retrying_reader_backoff_is_jittered(monkeypatch):
+    """The jittered policy threads through io.RetryingReader."""
+    import time as _time
+    from incubator_mxnet_tpu.io.resilient import RetryingReader
+
+    class FlakyReader:
+        def __init__(self):
+            self.calls = 0
+
+        def read(self):
+            self.calls += 1
+            if self.calls == 1:
+                raise OSError("nfs blip")
+            return b"payload"
+
+    sleeps = []
+    monkeypatch.setattr(_time, "sleep", lambda s: sleeps.append(s))
+    r = RetryingReader(FlakyReader(), retries=2, backoff=0.2)
+    assert r.read() == b"payload"
+    assert len(sleeps) == 1 and 0.1 <= sleeps[0] <= 0.2
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM / death during an atomic checkpoint write (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+def test_sigterm_during_checkpoint_write_stays_atomic(tmp_path):
+    """SIGTERM landing DURING a checkpoint write: the flag-only
+    handler must let the in-flight write publish atomically; the
+    preemption then fires at the next step boundary, and the keep-K
+    set + LATEST marker stay consistent (no temp remnants), so resume
+    loads a good checkpoint."""
+    import signal as _signal
+    import time as _time
+    ck = str(tmp_path / "ck")
+    xs, ys = _data(6)
+    rt = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                   ckpt_interval=2, seed=123)
+    try:
+        orig = rt.trainer.save_checkpoint
+        fired = []
+
+        def save_with_sigterm(path):
+            # target the step-2 periodic write (an initial protective
+            # checkpoint lands earlier and must stay undisturbed)
+            if "step_00000002" in path and not fired:
+                fired.append(1)
+                os.kill(os.getpid(), _signal.SIGTERM)
+                _time.sleep(0.01)      # handler runs here (flag-only)
+            return orig(path)
+
+        rt.trainer.save_checkpoint = save_with_sigterm
+        preempted_at = None
+        try:
+            for i in range(6):
+                rt.step(xs[i], ys[i])
+        except fault.Preempted as e:
+            preempted_at = e.step
+        # SIGTERM hit inside the step-2 periodic write; that write
+        # completed, step 2 (the next one) ran, preemption checkpoint
+        # landed at its boundary
+        assert preempted_at == 3
+    finally:
+        rt.uninstall_sigterm()
+
+    names = sorted(os.listdir(ck))
+    assert not any(n.startswith(".tmp_") for n in names), names
+    assert "step_00000002" in names and "step_00000003" in names
+    with open(os.path.join(ck, "LATEST")) as f:
+        latest = f.read().strip()
+    assert latest == "step_00000003"
+    assert os.path.isdir(os.path.join(ck, latest))
+    assert parallel.ResilientTrainer.was_preempted(ck)
+
+    rt2 = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                    seed=123, handle_sigterm=False)
+    assert rt2.resume()
+    assert rt2.step_number == 3
+    assert not parallel.ResilientTrainer.was_preempted(ck)
+
+
+def test_death_mid_checkpoint_write_keeps_previous_good(tmp_path):
+    """A write that DIES midway (crash/kill -9 semantics: partial temp
+    dir, terminal error) must leave the published keep-K set and the
+    LATEST marker untouched — resume loads the previous good
+    checkpoint, never the partial one."""
+    ck = str(tmp_path / "ck")
+    xs, ys = _data(6)
+    rt = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                   ckpt_interval=2, keep=2, seed=123,
+                                   handle_sigterm=False)
+    for i in range(5):
+        rt.step(xs[i], ys[i])          # published: step_2, step_4
+    assert rt.step_number == 5
+
+    orig = rt.trainer.save_checkpoint
+
+    def dying_save(path):
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "partial.bin"), "wb") as f:
+            f.write(b"\x00" * 64)      # half-written state...
+        raise RuntimeError("process died mid-write")
+
+    rt.trainer.save_checkpoint = dying_save
+    with pytest.raises(RuntimeError, match="mid-write"):
+        rt.checkpoint()
+    rt.trainer.save_checkpoint = orig
+
+    published = sorted(n for n in os.listdir(ck)
+                       if n.startswith("step_"))
+    assert published == ["step_00000002", "step_00000004"]
+    with open(os.path.join(ck, "LATEST")) as f:
+        assert f.read().strip() == "step_00000004"
+
+    # fresh process state: resume must load the previous good ckpt
+    # (the .tmp_ partial is invisible to checkpoint listing)
+    rt2 = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                    seed=123, handle_sigterm=False)
+    assert rt2.resume()
+    assert rt2.step_number == 4
+    loss, ok = rt2.step(xs[4], ys[4])
+    assert ok and np.isfinite(loss)

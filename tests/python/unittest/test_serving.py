@@ -434,3 +434,108 @@ def test_event_percentiles_helper():
     assert ec.percentiles("nothing") == {}
     ec.reset()
     assert ec.percentiles("lat_us") == {}
+
+
+# ---------------------------------------------------------------------------
+# replica health (ISSUE 7 satellite): route around a failing replica,
+# probe it back in after the cooldown
+# ---------------------------------------------------------------------------
+
+def _flaky_run(eng, broken):
+    """Wrap eng._run to fail terminally (non-retryable RuntimeError)
+    on the replica ids in `broken`."""
+    orig = eng._run
+
+    def run(dev_i, batch_np):
+        if dev_i in broken:
+            raise RuntimeError("injected replica failure")
+        return orig(dev_i, batch_np)
+
+    eng._run = run
+
+
+def test_replica_unhealthy_routes_around_then_probe_readmits():
+    from incubator_mxnet_tpu.telemetry import flightrec as _bb
+    cfg.set("MXNET_SERVE_REPLICA_FAILS", 2)
+    cfg.set("MXNET_SERVE_REPLICA_COOLDOWN_S", 1.0)
+    net = _dense_net(seed=23)
+    x = _data(1, seed=29)
+    try:
+        eng = InferenceEngine(net, devices=[mx.cpu(0), mx.cpu(1)],
+                              max_batch=1, max_wait_us=100)
+        try:
+            eng.warmup(example_shape=(8,), wire_dtype="float32")
+            broken = {1}
+            _flaky_run(eng, broken)
+            un0 = events.get("serve.replica_unhealthy")
+            rec0 = events.get("serve.replica_recovered")
+            failures = 0
+            for _ in range(12):         # round-robin feeds replica 1
+                try:                    # until its streak trips
+                    eng.submit(x[0]).result(timeout=30)
+                except RuntimeError:
+                    failures += 1
+                if failures >= 2:
+                    break
+            assert failures == 2
+            assert events.get("serve.replica_unhealthy") == un0 + 1
+            assert eng.stats()["replica_health"][1] == "unhealthy"
+            # routed around: every request now lands on replica 0
+            d0 = eng._dev_batches[0]
+            for _ in range(4):
+                eng.submit(x[0]).result(timeout=30)
+            assert eng._dev_batches[0] >= d0 + 4
+            # heal the device and wait out the cooldown: ONE probe
+            # batch re-admits it
+            broken.clear()
+            time.sleep(1.1)
+            d1 = eng._dev_batches[1]
+            for _ in range(4):
+                eng.submit(x[0]).result(timeout=30)
+            assert events.get("serve.replica_recovered") == rec0 + 1
+            assert eng.stats()["replica_health"][1] == "healthy"
+            assert eng._dev_batches[1] > d1        # taking traffic again
+            ring = [e for e in _bb.ring_snapshot()
+                    if e.get("kind") == "serve"]
+            assert any(e["name"] == "replica_unhealthy"
+                       and e.get("replica") == 1 for e in ring)
+            assert any(e["name"] == "replica_recovered"
+                       and e.get("replica") == 1 for e in ring)
+        finally:
+            eng.close()
+    finally:
+        cfg.unset("MXNET_SERVE_REPLICA_FAILS")
+        cfg.unset("MXNET_SERVE_REPLICA_COOLDOWN_S")
+
+
+def test_all_replicas_unhealthy_fails_open():
+    """With every replica unhealthy the engine degrades, not refuses:
+    dispatch falls through to the soonest-recovering replica (and a
+    success there re-admits it)."""
+    cfg.set("MXNET_SERVE_REPLICA_FAILS", 1)
+    cfg.set("MXNET_SERVE_REPLICA_COOLDOWN_S", 30.0)
+    net = _dense_net(seed=31)
+    x = _data(1, seed=37)
+    try:
+        eng = InferenceEngine(net, devices=[mx.cpu(0), mx.cpu(1)],
+                              max_batch=1, max_wait_us=100)
+        try:
+            eng.warmup(example_shape=(8,), wire_dtype="float32")
+            broken = {0, 1}
+            _flaky_run(eng, broken)
+            for _ in range(2):          # one strike each: both out
+                with pytest.raises(RuntimeError):
+                    eng.submit(x[0]).result(timeout=30)
+            assert eng.stats()["replica_health"] == ["unhealthy"] * 2
+            open0 = events.get("serve.all_replicas_unhealthy")
+            broken.clear()              # devices healed; cooldown 30s
+            out = eng.submit(x[0]).result(timeout=30)
+            assert out is not None
+            assert events.get("serve.all_replicas_unhealthy") > open0
+            # the fail-open success re-admitted that replica
+            assert "healthy" in eng.stats()["replica_health"]
+        finally:
+            eng.close()
+    finally:
+        cfg.unset("MXNET_SERVE_REPLICA_FAILS")
+        cfg.unset("MXNET_SERVE_REPLICA_COOLDOWN_S")
