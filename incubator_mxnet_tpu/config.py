@@ -287,6 +287,49 @@ register("MXNET_SERVE_BUCKETS", str, "",
          "MXNET_SERVE_MAX_BATCH. The bucket set is CLOSED: every "
          "request batch is padded up to a bucket, so the compiled "
          "executable set is fixed after warmup()")
+register("MXNET_SERVE_LANES", str, "high,normal,low",
+         "InferenceEngine priority lanes, highest first (comma-"
+         "separated names).  The dispatcher drains lanes in strict "
+         "priority order, earliest-deadline-first within a lane; "
+         "submits default to the FIRST lane, so single-lane callers "
+         "see the pre-lane behavior unchanged")
+register("MXNET_SERVE_LANE_QUOTAS", str, "",
+         "Per-lane queue-occupancy quotas as comma-separated fractions "
+         "of MXNET_SERVE_QUEUE_CAP, positionally matching "
+         "MXNET_SERVE_LANES (short lists repeat the last value). "
+         "Empty = auto: 1.0 for the top lane, then 0.75, 0.5, ... "
+         "floor 0.25.  A submit that would push its lane past quota "
+         "is SHED with the typed Shed error while higher lanes still "
+         "have headroom — graceful degradation instead of uniform "
+         "queueing collapse")
+register("MXNET_SERVE_TENANT_QUOTA", int, 0,
+         "InferenceEngine: max queued requests per tenant (submit "
+         "tenant=...); a submit beyond it is shed (typed Shed error, "
+         "serve.shed counter labeled by tenant) so one tenant's burst "
+         "cannot starve the queue for everyone. 0 = no per-tenant "
+         "bound")
+register("MXNET_SERVE_HBM_BUDGET", int, 0,
+         "ModelRegistry: per-device HBM budget in bytes for serving "
+         "admission control. 0 = auto (the device's PJRT bytes_limit "
+         "where the backend reports one, else unbudgeted); a model "
+         "whose projected footprint does not fit the budget on enough "
+         "devices is refused with AdmissionDenied")
+register("MXNET_SERVE_HBM_TEMP_FACTOR", float, 2.0,
+         "ModelRegistry footprint projection: multiplier applied to "
+         "the (input + output) activation bytes of the largest bucket "
+         "to cover XLA temp buffers before a measured "
+         "memory_analysis row exists in the cost registry")
+register("MXNET_SERVE_BREAKER_FAILS", int, 5,
+         "ModelRegistry circuit breaker: consecutive terminal request "
+         "failures on ONE model backend before its breaker OPENS "
+         "(submits fail fast with CircuitOpen instead of queueing "
+         "onto a dead backend) — the whole-model generalization of "
+         "MXNET_SERVE_REPLICA_FAILS")
+register("MXNET_SERVE_BREAKER_COOLDOWN_S", float, 10.0,
+         "ModelRegistry circuit breaker: seconds an OPEN breaker "
+         "rejects before letting ONE probe request through "
+         "(half-open); probe success re-closes it, failure restarts "
+         "the cooldown")
 register("MXNET_SERVE_REPLICA_FAILS", int, 3,
          "InferenceEngine: consecutive terminal dispatch failures on "
          "ONE replica device before it is marked unhealthy and routed "

@@ -33,6 +33,19 @@ class EventCounters:
     and additionally `observe()`s per-request latency samples so p50/p99
     are recoverable (`percentiles`/`latency_snapshot`) — counters alone
     only give means, and serving SLOs are tail-defined.
+
+    **Labeled splits (ISSUE 8).**  `incr`/`observe` take an optional
+    `labels=` dict: the sample lands in a PER-LABELSET ring (and the
+    count on a per-labelset counter) next to — never instead of — the
+    unlabeled aggregate the caller maintains, so multi-tenant serving
+    can answer "p99 for tenant A on the low lane" without forking the
+    counter namespace.  Cardinality is bounded: at most `MAX_LABELSETS`
+    distinct labelsets per name; overflow folds into a reserved
+    `{"overflow": "true"}` set (a tenant explosion must not OOM the
+    ledger it exists to protect).  `labeled_snapshot` /
+    `labeled_latency_snapshot` render the splits for /metrics and the
+    black-box dump.
+
     Thread-safe; process-local (each worker reports its own counts,
     matching per-worker ps-lite server stats in the reference).
     """
@@ -41,14 +54,37 @@ class EventCounters:
     #: on long-lived serving hosts while keeping p99 over a recent
     #: window meaningful
     MAX_SAMPLES = 4096
+    #: distinct labelsets retained per name — tenant/lane splits are
+    #: useful at dashboard cardinality, not at unbounded-userbase
+    #: cardinality; excess folds into {"overflow": "true"}
+    MAX_LABELSETS = 64
+    _OVERFLOW = (("overflow", "true"),)
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counts = {}
         self._samples = {}
+        self._lcounts = {}      # name -> {labelkey: int}
+        self._lsamples = {}     # name -> {labelkey: deque}
 
-    def incr(self, name: str, n: int = 1) -> int:
+    @staticmethod
+    def _labelkey(labels):
+        return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+    def _fold(self, per_name, key):
+        """Bound labelset cardinality: a NEW key past MAX_LABELSETS
+        lands on the reserved overflow set (callers hold self._lock)."""
+        if key in per_name or len(per_name) < self.MAX_LABELSETS:
+            return key
+        return self._OVERFLOW
+
+    def incr(self, name: str, n: int = 1, labels: dict = None) -> int:
         with self._lock:
+            if labels:
+                per = self._lcounts.setdefault(name, {})
+                key = self._fold(per, self._labelkey(labels))
+                per[key] = per.get(key, 0) + int(n)
+                return per[key]
             self._counts[name] = self._counts.get(name, 0) + int(n)
             return self._counts[name]
 
@@ -62,12 +98,26 @@ class EventCounters:
             return self._counts.get(name, 0)
 
     # -- latency samples / percentiles ---------------------------------
-    def observe(self, name: str, value: float) -> None:
+    def observe(self, name: str, value: float, labels: dict = None) \
+            -> None:
         """Record one sample (convention: microseconds, name ends in
         `_us`) into a bounded per-name ring buffer; `incr`s the
         companion counter `<name>.n` so sample flow is visible in plain
-        snapshots too."""
+        snapshots too.  With `labels=` the sample lands in that
+        labelset's OWN ring (and `<name>.n` counter) instead — callers
+        keep the unlabeled aggregate themselves, so a labeled observe
+        is a split, not a double-count."""
         with self._lock:
+            if labels:
+                per = self._lsamples.setdefault(name, {})
+                key = self._fold(per, self._labelkey(labels))
+                dq = per.get(key)
+                if dq is None:
+                    dq = per[key] = deque(maxlen=self.MAX_SAMPLES)
+                dq.append(float(value))
+                cper = self._lcounts.setdefault(name + ".n", {})
+                cper[key] = cper.get(key, 0) + 1
+                return
             dq = self._samples.get(name)
             if dq is None:
                 dq = self._samples[name] = deque(maxlen=self.MAX_SAMPLES)
@@ -75,29 +125,70 @@ class EventCounters:
             self._counts[name + ".n"] = \
                 self._counts.get(name + ".n", 0) + 1
 
-    def observe_time(self, name: str, seconds: float) -> None:
+    def observe_time(self, name: str, seconds: float,
+                     labels: dict = None) -> None:
         """`observe` a wall-clock interval in integer microseconds AND
         accumulate it on the monotonic `name` counter (so totals and
-        percentiles stay in one place)."""
+        percentiles stay in one place).  `labels=` splits both sides
+        into that labelset (see `observe`)."""
         us = int(seconds * 1e6)
-        self.incr(name, us)
-        self.observe(name, us)
+        self.incr(name, us, labels=labels)
+        self.observe(name, us, labels=labels)
 
-    def percentiles(self, name: str, pcts=(50, 90, 99)) -> dict:
-        """{'p50': ..., 'p90': ..., 'p99': ..., 'n': samples} over the
-        retained window for `name` (empty dict when nothing observed).
-        Nearest-rank on the sorted window — no numpy dependency."""
-        with self._lock:
-            dq = self._samples.get(name)
-            if not dq:
-                return {}
-            xs = sorted(dq)
+    @staticmethod
+    def _pct_dict(xs, pcts):
+        """Nearest-rank percentiles of a pre-sorted window — no numpy
+        dependency."""
         n = len(xs)
         out = {"n": n}
         for p in pcts:
             idx = min(n - 1, max(0, int(round(p / 100.0 * n)) - 1))
             out["p%g" % p] = xs[idx]
         return out
+
+    def percentiles(self, name: str, pcts=(50, 90, 99)) -> dict:
+        """{'p50': ..., 'p90': ..., 'p99': ..., 'n': samples} over the
+        retained window for `name` (empty dict when nothing observed)."""
+        with self._lock:
+            dq = self._samples.get(name)
+            if not dq:
+                return {}
+            xs = sorted(dq)
+        return self._pct_dict(xs, pcts)
+
+    # -- labeled splits ------------------------------------------------
+    def labeled_snapshot(self, prefix: str = None) -> dict:
+        """{name: [{'labels': {...}, 'value': n}, ...]} for every
+        labeled counter (optionally prefix-filtered)."""
+        with self._lock:
+            out = {}
+            for name, per in self._lcounts.items():
+                if prefix is not None and not name.startswith(prefix):
+                    continue
+                out[name] = [{"labels": dict(k), "value": v}
+                             for k, v in sorted(per.items())]
+        return out
+
+    def labeled_percentiles(self, name: str, pcts=(50, 90, 99)) -> list:
+        """[{'labels': {...}, 'p50': ..., 'n': ...}, ...] — one entry
+        per labelset observed for `name` (empty list when none)."""
+        with self._lock:
+            per = self._lsamples.get(name)
+            if not per:
+                return []
+            windows = [(k, sorted(dq)) for k, dq in sorted(per.items())
+                       if dq]
+        return [dict(self._pct_dict(xs, pcts), labels=dict(k))
+                for k, xs in windows]
+
+    def labeled_latency_snapshot(self, prefix: str = None,
+                                 pcts=(50, 90, 99)) -> dict:
+        """{name: labeled_percentiles(name)} for every labeled sample
+        series (optionally prefix-filtered)."""
+        with self._lock:
+            names = [k for k in self._lsamples
+                     if prefix is None or k.startswith(prefix)]
+        return {k: self.labeled_percentiles(k, pcts) for k in names}
 
     def latency_snapshot(self, prefix: str = None, pcts=(50, 90, 99)) \
             -> dict:
@@ -119,6 +210,8 @@ class EventCounters:
         with self._lock:
             self._counts.clear()
             self._samples.clear()
+            self._lcounts.clear()
+            self._lsamples.clear()
 
     def log_nonzero(self, logger=None) -> None:
         """Log every nonzero counter, then p50/p90/p99 for every

@@ -1,19 +1,33 @@
-"""Inference serving subsystem (ISSUE 3): shape-bucketed dynamic
-batching over AOT-warmed executables — the deploy-side counterpart of
-the resilient trainer (PR 1) and the async device feed (PR 2).
+"""Inference serving subsystem (ISSUE 3 + ISSUE 8): shape-bucketed
+dynamic batching over AOT-warmed executables, hardened for sustained
+multi-tenant overload — the deploy-side counterpart of the resilient
+trainer (PR 1) and the async device feed (PR 2).
 
     from incubator_mxnet_tpu import serving
     eng = net.inference_engine(ctx=mx.gpu())       # or serving.InferenceEngine(net)
     eng.warmup(example_shape=(3, 224, 224), wire_dtype="uint8")
-    fut = eng.submit(img)                          # concurrent: returns a Future
+    fut = eng.submit(img, lane="high", tenant="acme")  # concurrent Future
     probs = fut.result()
     eng.close()
 
-See docs/serving.md for lifecycle, knob tuning and the counter
-reference.
+Many models on one device pool go through the ModelRegistry (HBM
+admission control from the cost registry, per-model circuit
+breakers)::
+
+    reg = serving.ModelRegistry(devices=[mx.gpu(0), mx.gpu(1)])
+    reg.register("ranker", net, example_shape=(256,))
+    reg.warmup("ranker")
+    fut = reg.submit("ranker", x, lane="high", deadline=0.05)
+
+See docs/serving.md for lifecycle, admission math, the lane/shed
+decision table and the counter reference.
 """
 from .engine import (InferenceEngine, QueueFull, DeadlineExceeded,
-                     EngineClosed, serve_counters)
+                     EngineClosed, Shed, serve_counters)
+from .registry import (ModelRegistry, AdmissionDenied, CircuitOpen,
+                       UnknownModel, project_footprint)
 
 __all__ = ["InferenceEngine", "QueueFull", "DeadlineExceeded",
-           "EngineClosed", "serve_counters"]
+           "EngineClosed", "Shed", "serve_counters",
+           "ModelRegistry", "AdmissionDenied", "CircuitOpen",
+           "UnknownModel", "project_footprint"]

@@ -46,6 +46,20 @@ center on).  The engine closes the executable set instead:
    `rejected`, `batches`, `requests`, `traces`, ...) plus per-request
    latency samples for `events.percentiles("serve.e2e_us")` — tails,
    not means, are the serving SLO.
+6. **Overload hardening (ISSUE 8).**  Requests carry a priority
+   `lane` (`MXNET_SERVE_LANES`, highest first) and optionally a
+   `tenant`.  The dispatcher drains lanes in strict priority order,
+   earliest-deadline-first within one.  Under sustained overload the
+   engine SHEDS instead of queueing toward uniform collapse: a lane
+   past its quota share of the queue (`MXNET_SERVE_LANE_QUOTAS`), a
+   tenant past `MXNET_SERVE_TENANT_QUOTA`, or a request whose
+   deadline is already unmeetable gets the typed `Shed` /
+   `DeadlineExceeded` error synchronously (`serve.shed`, labeled by
+   lane/tenant/reason), and over-deadline work found at dispatch time
+   is reaped without device time.  `serve.e2e_us`/`serve.requests`
+   additionally split by lane and tenant through the labeled
+   percentile rings (`events.labeled_latency_snapshot("serve.")`),
+   so /metrics and black-box dumps answer WHOSE p99 blew out.
 
 Multi-device replica dispatch: pass `devices=[ctx, ...]` (or build via
 `ShardedTrainer.serve()` / `parallel.mesh.replica_contexts`) and the
@@ -68,6 +82,8 @@ executable.
 """
 from __future__ import annotations
 
+import heapq
+import itertools
 import queue
 import signal
 import threading
@@ -86,7 +102,7 @@ from ..telemetry import flightrec as _bb
 from ..telemetry import spans as _tele
 
 __all__ = ["InferenceEngine", "QueueFull", "DeadlineExceeded",
-           "EngineClosed", "serve_counters"]
+           "EngineClosed", "Shed", "serve_counters"]
 
 
 class QueueFull(MXNetError):
@@ -102,6 +118,15 @@ class EngineClosed(MXNetError):
     """submit() after drain()/close() (or during SIGTERM drain)."""
 
 
+class Shed(MXNetError):
+    """The request was refused by overload policy — its lane is over
+    quota, its tenant is over quota, or its deadline was already
+    unmeetable (ISSUE 8).  Unlike `QueueFull` (transient backpressure:
+    retry soon), a shed means the engine is deliberately degrading
+    low-priority intake to protect higher lanes — back off or
+    re-submit on a higher lane."""
+
+
 def serve_counters():
     """Snapshot of the `serve.*` counters (µs totals / counts)."""
     return events.snapshot("serve.")
@@ -109,9 +134,10 @@ def serve_counters():
 
 class _Request:
     __slots__ = ("data", "n", "future", "t_enq", "deadline", "single",
-                 "tele")
+                 "tele", "lane", "tenant")
 
-    def __init__(self, data, n, future, deadline, single):
+    def __init__(self, data, n, future, deadline, single, lane=None,
+                 tenant=None):
         self.data = data
         self.n = n
         self.future = future
@@ -119,11 +145,170 @@ class _Request:
         self.deadline = None if deadline is None \
             else self.t_enq + float(deadline)
         self.single = single
+        self.lane = lane
+        self.tenant = tenant
         # the submitter's span context (telemetry): the dispatcher's
         # serve.dispatch/serve.infer spans parent onto it, so a
         # request's submit→dispatch→infer chain shares one trace id
         # across the three threads it crosses
         self.tele = _tele.current()
+
+
+class _OverQuota(Exception):
+    """Internal: a put would push its lane past quota (the engine
+    translates it into the public typed `Shed`)."""
+
+    def __init__(self, lane, depth, cap):
+        super().__init__(lane, depth, cap)
+        self.lane, self.depth, self.cap = lane, depth, cap
+
+
+class _LaneQueue:
+    """Priority-lane request queue with `queue.Queue`'s accounting
+    surface (the subset the engine uses: put_nowait/get/get_nowait/
+    task_done/qsize/maxsize/unfinished_tasks/all_tasks_done), so the
+    drain()/close() exactly-once contract carries over unchanged.
+
+    Ordering (ISSUE 8): strict priority ACROSS lanes (the dispatcher
+    never serves a lower lane while a higher one has work) and
+    earliest-deadline-first WITHIN a lane (no-deadline requests keep
+    FIFO order after every deadlined one — a request that asked for a
+    latency bound outranks one that didn't).  Each lane may carry an
+    occupancy cap (its quota share of `maxsize`): a put beyond it
+    raises `_OverQuota` so over-quota low-priority work is SHED at
+    submit time instead of queueing the whole engine toward uniform
+    deadline collapse."""
+
+    def __init__(self, maxsize, lanes, lane_caps):
+        self.maxsize = int(maxsize)
+        self._lanes = tuple(lanes)
+        self._caps = dict(lane_caps)        # lane -> cap (None = none)
+        self._heaps = {ln: [] for ln in self._lanes}
+        self._seq = itertools.count()       # FIFO tiebreak within EDF
+        self._mutex = threading.Lock()
+        self._not_empty = threading.Condition(self._mutex)
+        self.all_tasks_done = threading.Condition(self._mutex)
+        self.unfinished_tasks = 0
+        self._size = 0
+
+    def put_nowait(self, req):
+        with self._mutex:
+            # lane quota BEFORE global fullness: the engine's
+            # displacement path relies on queue.Full implying the
+            # request's own lane still has quota headroom (so the
+            # post-eviction re-put cannot fail)
+            h = self._heaps[req.lane]
+            cap = self._caps.get(req.lane)
+            if cap is not None and len(h) >= cap:
+                raise _OverQuota(req.lane, len(h), cap)
+            if self._size >= self.maxsize:
+                raise queue.Full
+            key = (req.deadline if req.deadline is not None
+                   else float("inf"), next(self._seq))
+            heapq.heappush(h, (key, req))
+            self._size += 1
+            self.unfinished_tasks += 1
+            self._not_empty.notify()
+
+    def _pop_locked(self):
+        for lane in self._lanes:            # highest priority first
+            h = self._heaps[lane]
+            if h:
+                _, req = heapq.heappop(h)
+                self._size -= 1
+                return req
+        raise queue.Empty
+
+    def get_nowait(self):
+        with self._mutex:
+            return self._pop_locked()
+
+    def evict_lowest(self, below):
+        """Remove and return the LAST-to-run request (latest deadline,
+        newest arrival) of the lowest-priority non-empty lane strictly
+        below `below`, or None when every lower lane is empty.  The
+        engine uses this to DISPLACE low work when a higher-lane
+        submit meets a full queue — without it, lower-lane backlog
+        could hold every slot and the top lane would see QueueFull
+        under exactly the overload the lanes exist for.  The victim
+        stays counted in unfinished_tasks: the caller sheds it through
+        the normal resolve path (task_done fires there)."""
+        try:
+            start = self._lanes.index(below) + 1
+        except ValueError:
+            return None
+        with self._mutex:
+            for lane in reversed(self._lanes[start:]):
+                h = self._heaps[lane]
+                if h:
+                    item = max(h)       # latest deadline, newest seq
+                    h.remove(item)
+                    heapq.heapify(h)
+                    self._size -= 1
+                    return item[1]
+        return None
+
+    def get(self, timeout=None):
+        # single-consumer contract (the dispatcher): one wait then one
+        # pop attempt; a timeout/spurious wakeup surfaces queue.Empty,
+        # which every call site already loops on
+        with self._not_empty:
+            if not self._size:
+                self._not_empty.wait(timeout)
+            return self._pop_locked()
+
+    def task_done(self):
+        with self.all_tasks_done:
+            n = self.unfinished_tasks - 1
+            if n < 0:
+                raise ValueError("task_done() called too many times")
+            self.unfinished_tasks = n
+            if n == 0:
+                self.all_tasks_done.notify_all()
+
+    def qsize(self):
+        with self._mutex:
+            return self._size
+
+    def lane_depths(self):
+        with self._mutex:
+            return {ln: len(h) for ln, h in self._heaps.items()}
+
+
+def _parse_lanes(spec):
+    if spec and isinstance(spec, (list, tuple)):
+        names = [str(s).strip() for s in spec if str(s).strip()]
+    else:
+        names = [s.strip() for s in str(spec or "").split(",")
+                 if s.strip()]
+    out = []
+    for n in names:                         # dedupe, order-preserving
+        if n not in out:
+            out.append(n)
+    if not out:
+        raise ValueError("serve lanes spec is empty: %r" % (spec,))
+    return tuple(out)
+
+
+def _parse_lane_quotas(spec, lanes, cap):
+    """lane -> occupancy cap (requests) from the quota-fraction spec;
+    the top lane defaults to the full queue (None = no lane cap), and
+    an explicit fraction >= 1 also means no extra bound."""
+    if spec and isinstance(spec, (list, tuple)):
+        fracs = [float(s) for s in spec]
+    elif spec:
+        fracs = [float(s) for s in str(spec).split(",") if s.strip()]
+    else:
+        fracs = [max(0.25, 1.0 - 0.25 * i) for i in range(len(lanes))]
+    if not fracs or any(f <= 0 for f in fracs):
+        raise ValueError("lane quotas must be positive fractions, "
+                         "got %r" % (spec,))
+    while len(fracs) < len(lanes):
+        fracs.append(fracs[-1])             # short list: last repeats
+    caps = {}
+    for lane, f in zip(lanes, fracs):
+        caps[lane] = None if f >= 1.0 else max(1, int(f * cap))
+    return caps
 
 
 def _parse_buckets(spec, max_batch):
@@ -165,7 +350,8 @@ class InferenceEngine:
     def __init__(self, block, ctx=None, devices=None, buckets=None,
                  max_batch=None, max_wait_us=None, queue_cap=None,
                  example_shape=None, wire_dtype=None,
-                 handle_sigterm=False):
+                 handle_sigterm=False, lanes=None, lane_quotas=None,
+                 tenant_quota=None, cost_label=None):
         from ..parallel.functional import functionalize
         if devices is None:
             devices = [ctx or current_context()]
@@ -184,9 +370,24 @@ class InferenceEngine:
         self._max_wait = (int(max_wait_us if max_wait_us is not None
                               else _cfg.get("MXNET_SERVE_MAX_WAIT_US"))
                           / 1e6)
-        cap = int(queue_cap if queue_cap is not None
-                  else _cfg.get("MXNET_SERVE_QUEUE_CAP"))
-        self._q = queue.Queue(maxsize=max(1, cap))
+        cap = max(1, int(queue_cap if queue_cap is not None
+                         else _cfg.get("MXNET_SERVE_QUEUE_CAP")))
+        # priority lanes (ISSUE 8): strict priority across, EDF within;
+        # submits default to the TOP lane so single-lane callers keep
+        # the pre-lane behavior (quota 1.0 on the top lane = the plain
+        # bounded queue)
+        self._lanes = _parse_lanes(
+            lanes if lanes is not None else _cfg.get("MXNET_SERVE_LANES"))
+        self._lane_caps = _parse_lane_quotas(
+            lane_quotas if lane_quotas is not None
+            else _cfg.get("MXNET_SERVE_LANE_QUOTAS"),
+            self._lanes, cap)
+        self._q = _LaneQueue(cap, self._lanes, self._lane_caps)
+        self._tenant_quota = int(
+            tenant_quota if tenant_quota is not None
+            else _cfg.get("MXNET_SERVE_TENANT_QUOTA"))
+        self._tenant_q = {}         # tenant -> currently-queued count
+        self._cost_label = str(cost_label or "serve.infer")
         self._example_shape = (tuple(example_shape)
                                if example_shape is not None else None)
         self._wire_dtype = (str(_np.dtype(wire_dtype))
@@ -208,6 +409,8 @@ class InferenceEngine:
                                             # dispatcher share the block)
         self._thread = None
         self._carry = None          # request pulled but not yet batched
+        self._svc_ewma = {}         # bucket -> EWMA batch service s
+                                    # (deadline feasibility at dispatch)
         self._rr = 0
         self._n_batches = 0
         self._dev_batches = [0] * len(self._ctxs)
@@ -265,9 +468,11 @@ class InferenceEngine:
             return out
 
         # each (device, bucket) signature becomes one cost-registry row
-        # under serve.infer — the per-bucket FLOPs/HBM attribution the
-        # blackbox dump reports for a serving host
-        return aot_jit(infer, label="serve.infer", kind="serve")
+        # under the engine's cost label (default serve.infer; the
+        # ModelRegistry passes serve.infer:<model> so admission can
+        # find THIS model's measured footprint) — the per-bucket
+        # FLOPs/HBM attribution the blackbox dump reports
+        return aot_jit(infer, label=self._cost_label, kind="serve")
 
     def refresh_params(self):
         """(Re-)replicate the block's current parameters onto every
@@ -359,17 +564,20 @@ class InferenceEngine:
                     "signature; convert client-side)"
                     % (dtype, self._wire_dtype))
 
-    def submit(self, x, deadline=None):
+    def submit(self, x, deadline=None, lane=None, tenant=None):
         """Enqueue ONE example (no batch dim).  Returns a Future whose
         result is the model output for this example (batch dim
         stripped), an NDArray on the executing device.  `deadline` is
         seconds from now; expiry resolves the future with
-        DeadlineExceeded.  Raises QueueFull / EngineClosed
-        synchronously."""
+        DeadlineExceeded.  `lane` picks the priority lane (default:
+        the top lane); `tenant` tags the request for per-tenant quotas
+        and the labeled serve.* splits.  Raises QueueFull / Shed /
+        EngineClosed synchronously."""
         arr = self._host_array(x)
-        return self._submit(arr[None], deadline, single=True)
+        return self._submit(arr[None], deadline, single=True,
+                            lane=lane, tenant=tenant)
 
-    def submit_batch(self, x, deadline=None):
+    def submit_batch(self, x, deadline=None, lane=None, tenant=None):
         """Enqueue a small batch (leading batch dim, size ≤ the largest
         bucket).  The batch is dispatched as one unit (never split), so
         it shares one future."""
@@ -381,31 +589,98 @@ class InferenceEngine:
                 "batch of %d exceeds the largest bucket (%d); chunk it "
                 "client-side (the bucket set is closed by design)"
                 % (arr.shape[0], self._buckets[-1]))
-        return self._submit(arr, deadline, single=False)
+        return self._submit(arr, deadline, single=False,
+                            lane=lane, tenant=tenant)
 
-    def _submit(self, arr, deadline, single):
+    def _shed_mark(self, lane, tenant, reason, deadline=False):
+        """The shed counter block — ONE definition for every shed path
+        (quota sheds, born-expired, dispatch-time expiry,
+        displacement), so the aggregate + lane/reason + tenant splits
+        cannot drift apart."""
+        events.incr("serve.rejected")
+        if deadline:
+            events.incr("serve.deadline_expired")
+        events.incr("serve.shed")
+        events.incr("serve.shed", labels={"lane": lane or "-",
+                                          "reason": reason})
+        if tenant is not None:
+            events.incr("serve.shed", labels={"tenant": tenant})
+
+    def _shed(self, lane, tenant, reason, msg):
+        self._shed_mark(lane, tenant, reason)
+        raise Shed(msg)
+
+    def _submit(self, arr, deadline, single, lane=None, tenant=None):
         if fault.should_fire("serve.enqueue"):
             events.incr("serve.rejected")
             raise QueueFull("injected enqueue fault (serve.enqueue)")
         self._check_example(arr.shape[1:], arr.dtype)
+        lane = self._lanes[0] if lane is None else str(lane)
+        if lane not in self._lane_caps:
+            raise ValueError("unknown lane %r (engine lanes: %s)"
+                             % (lane, ",".join(self._lanes)))
+        tenant = str(tenant) if tenant is not None else None
         fut = Future()
-        req = _Request(arr, arr.shape[0], fut, deadline, single)
+        req = _Request(arr, arr.shape[0], fut, deadline, single,
+                       lane=lane, tenant=tenant)
+        if req.deadline is not None and req.deadline <= req.t_enq:
+            # born expired: queueing it could only burn queue slots on
+            # work that is already lost — shed, deadline-typed
+            self._shed_mark(lane, tenant, "deadline", deadline=True)
+            raise DeadlineExceeded("deadline is not in the future")
         # closed-check + enqueue are ATOMIC against close()'s final
         # flush (which sets _closed then drains the queue under the
         # same lock): a put that wins the race lands BEFORE the flush
-        # and is resolved by it — no future is ever stranded
+        # and is resolved by it — no future is ever stranded.  The
+        # tenant-quota hold increments under the SAME lock, and
+        # _retire's decrement is the single release point — counts
+        # can't leak or double-release across the shed/expiry paths.
         with self._lock:
             if self._closed or self._draining:
                 events.incr("serve.rejected")
                 raise EngineClosed("engine is draining/closed")
+            if tenant is not None and self._tenant_quota > 0 and \
+                    self._tenant_q.get(tenant, 0) >= self._tenant_quota:
+                self._shed(lane, tenant, "tenant_quota",
+                           "tenant %r over quota (%d queued, cap %d); "
+                           "back off or raise MXNET_SERVE_TENANT_QUOTA"
+                           % (tenant, self._tenant_q.get(tenant, 0),
+                              self._tenant_quota))
+            victim = None
             try:
                 self._q.put_nowait(req)
+            except _OverQuota as oq:
+                self._shed(lane, tenant, "lane_quota",
+                           "lane %r over quota (%d queued, cap %d); "
+                           "excess low-priority work is shed under "
+                           "overload — see MXNET_SERVE_LANE_QUOTAS"
+                           % (oq.lane, oq.depth, oq.cap))
             except queue.Full:
-                events.incr("serve.rejected")
-                raise QueueFull(
-                    "serve queue at capacity (%d requests); retry "
-                    "later or raise MXNET_SERVE_QUEUE_CAP"
-                    % self._q.maxsize)
+                # priority displacement: a higher-lane submit meeting
+                # a full queue evicts the newest lowest-lane request
+                # (which is shed, typed) instead of being rejected —
+                # otherwise lower-lane backlog whose quotas sum past
+                # 1.0 would hold every slot and the TOP lane would see
+                # QueueFull under exactly the overload lanes exist for
+                victim = self._q.evict_lowest(below=lane)
+                if victim is None:
+                    events.incr("serve.rejected")
+                    raise QueueFull(
+                        "serve queue at capacity (%d requests); retry "
+                        "later or raise MXNET_SERVE_QUEUE_CAP"
+                        % self._q.maxsize)
+                # the eviction freed a slot and this lane was under
+                # its own quota (the first put raised Full, not
+                # _OverQuota), so the re-put cannot fail
+                self._q.put_nowait(req)
+            if tenant is not None:
+                self._tenant_q[tenant] = \
+                    self._tenant_q.get(tenant, 0) + 1
+        if victim is not None:          # outside the lock: _finish →
+            self._shed_mark(victim.lane, victim.tenant, "displaced")
+            self._finish(victim, exc=Shed(  # _retire re-takes it
+                "displaced by %r-lane traffic under overload "
+                "(queue full); back off or escalate lanes" % lane))
         self._ensure_dispatcher()
         return fut
 
@@ -458,6 +733,21 @@ class InferenceEngine:
             finally:
                 del eng
 
+    def _retire(self, req):
+        """Return an accepted request's queue slot (task_done) and
+        release its tenant-quota hold — the single decrement point,
+        reached exactly once per accepted request (via _finish or the
+        cancel path), so tenant counts cannot leak across shed storms
+        or drain."""
+        if req.tenant is not None:
+            with self._lock:
+                n = self._tenant_q.get(req.tenant, 0) - 1
+                if n > 0:
+                    self._tenant_q[req.tenant] = n
+                else:
+                    self._tenant_q.pop(req.tenant, None)
+        self._q.task_done()
+
     def _finish(self, req, result=None, exc=None):
         """Resolve a request's future (result or exception) and retire
         its queue slot — tolerant of caller-side cancel()/double
@@ -471,7 +761,7 @@ class InferenceEngine:
                 req.future.set_result(result)
         except Exception:               # noqa: BLE001 — cancelled/done
             events.incr("serve.cancelled")
-        self._q.task_done()
+        self._retire(req)
 
     def _collect(self):
         """Coalesce queued requests into one bucket's worth: pull
@@ -536,8 +826,11 @@ class InferenceEngine:
         return reqs if reqs else None
 
     def _expire(self, req):
-        events.incr("serve.rejected")
-        events.incr("serve.deadline_expired")
+        # over-deadline work found at dispatch time is SHED (typed
+        # error, never device time) — under overload this is what keeps
+        # a backed-up lane from dragging every deadline down with it
+        self._shed_mark(req.lane, req.tenant, "deadline",
+                        deadline=True)
         self._finish(req, exc=DeadlineExceeded(
             "request expired after %.3fs in queue"
             % (time.monotonic() - req.t_enq)))
@@ -548,19 +841,56 @@ class InferenceEngine:
                 return b
         return self._buckets[-1]
 
+    #: headroom multiplier on the EWMA service estimate in the
+    #: dispatch-time feasibility check: the estimate is a mean, the
+    #: deadline is a bound — without margin, requests dispatched at the
+    #: feasibility edge land just past their deadline whenever the
+    #: actual service time comes in above the mean
+    _SVC_MARGIN = 1.25
+
+    def _svc_estimate(self, bucket):
+        """EWMA batch service seconds for `bucket`.  When this bucket
+        hasn't run yet, scale the NEAREST known bucket's EWMA by the
+        size ratio — judging a size-1 batch by the 32-wide bucket's
+        wall would spuriously shed small requests that had time to
+        spare.  0 cold: feasibility shedding only engages once real
+        service times exist."""
+        with self._lock:
+            est = self._svc_ewma.get(bucket)
+            if est is None and self._svc_ewma:
+                near = min(self._svc_ewma,
+                           key=lambda b: abs(b - bucket))
+                est = self._svc_ewma[near] * (bucket / float(near))
+            return (est or 0.0) * self._SVC_MARGIN
+
     def _execute(self, reqs):
-        # deadline re-check at dispatch time: expiry during the
-        # coalescing window must not burn device time
-        live = []
+        # deadline-AWARE dispatch (ISSUE 8): a request is shed not only
+        # when its deadline already passed, but when it CANNOT make it
+        # — now + the estimated batch service time (per-bucket EWMA)
+        # past the deadline means dispatching it would burn device time
+        # to deliver a result the caller has already written off.
+        # Two passes: reap the already-expired FIRST, then judge
+        # feasibility against the service time of the batch that will
+        # ACTUALLY run — 31 stale requests must not doom the 1 fresh
+        # one by inflating the bucket estimate
         now = time.monotonic()
+        fresh = []
         for r in reqs:
             if r.deadline is not None and now > r.deadline:
+                self._expire(r)
+            else:
+                fresh.append(r)
+        live = []
+        est = self._svc_estimate(
+            self._bucket_for(sum(r.n for r in fresh))) if fresh else 0.0
+        for r in fresh:
+            if r.deadline is not None and now + est > r.deadline:
                 self._expire(r)
             elif not r.future.set_running_or_notify_cancel():
                 # caller cancelled while queued: drop before burning
                 # device time; the future is already CANCELLED
                 events.incr("serve.cancelled")
-                self._q.task_done()
+                self._retire(r)
             else:
                 live.append(r)          # RUNNING: cancel() is now inert
         if not live:
@@ -705,8 +1035,12 @@ class InferenceEngine:
                     self._finish(r, exc=e)
                 return
             self._replica_ok(dev_i)
-            events.observe_time("serve.infer_us",
-                                time.monotonic() - t0)
+            dt_svc = time.monotonic() - t0
+            with self._lock:    # feed the deadline-feasibility EWMA
+                prev = self._svc_ewma.get(bucket)
+                self._svc_ewma[bucket] = dt_svc if prev is None \
+                    else 0.3 * dt_svc + 0.7 * prev
+            events.observe_time("serve.infer_us", dt_svc)
             events.incr("serve.batches")
             events.incr("serve.batch_fill", total)
             events.incr("serve.pad_waste", bucket - total)
@@ -789,8 +1123,22 @@ class InferenceEngine:
                                   ctx=ctx), out)
             off = hi
             self._finish(r, result=res)
-            events.observe_time("serve.e2e_us",
-                                time.monotonic() - r.t_enq)
+            dt = time.monotonic() - r.t_enq
+            events.observe_time("serve.e2e_us", dt)
+            # tenant/lane splits of the same series (ISSUE 8): the
+            # aggregate above stays authoritative, the labeled rings
+            # answer "p99 for lane X / tenant Y" in /metrics + dumps
+            us = int(dt * 1e6)
+            if r.lane is not None:
+                events.observe("serve.e2e_us", us,
+                               labels={"lane": r.lane})
+                events.incr("serve.requests", r.n,
+                            labels={"lane": r.lane})
+            if r.tenant is not None:
+                events.observe("serve.e2e_us", us,
+                               labels={"tenant": r.tenant})
+                events.incr("serve.requests", r.n,
+                            labels={"tenant": r.tenant})
 
     # -- warmup --------------------------------------------------------
     def warmup(self, example_shape=None, wire_dtype=None):
@@ -910,8 +1258,11 @@ class InferenceEngine:
         """Engine + process-wide `serve.*` counter snapshot, including
         latency percentiles (p50/p90/p99) for the observed series."""
         now = time.monotonic()
+        with self._lock:
+            tenants = dict(self._tenant_q)
         return {"counters": serve_counters(),
                 "latency": events.latency_snapshot("serve."),
+                "labeled": events.labeled_latency_snapshot("serve."),
                 "buckets": list(self._buckets),
                 "devices": [repr(c) for c in self._ctxs],
                 "device_batches": list(self._dev_batches),
@@ -920,4 +1271,8 @@ class InferenceEngine:
                     ("probing" if u > 0.0 else "healthy")
                     for u in self._unhealthy_until],
                 "queue_depth": self._q.qsize(),
+                "lanes": {"order": list(self._lanes),
+                          "depths": self._q.lane_depths(),
+                          "caps": dict(self._lane_caps)},
+                "tenants_queued": tenants,
                 "warm": self._warm}

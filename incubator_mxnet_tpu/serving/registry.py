@@ -1,0 +1,568 @@
+"""Multi-model serving registry with HBM admission control and
+per-model circuit breakers (ISSUE 8 tentpole).
+
+The PR 3 engine serves ONE model; production traffic is many models on
+a fixed device pool, and nothing stopped a second model from being
+loaded past HBM capacity — the failure mode is an allocator OOM (or a
+wedged device) at TRAFFIC time, long after the deploy decision that
+caused it.  `ModelRegistry` closes the loop the PR 5 cost registry
+opened: XLA's memory_analysis already tells us every serving
+executable's argument/output/temp bytes, so admission becomes a ledger
+check instead of a production incident.
+
+**Admission control.**  Each registry device carries a budget
+(`MXNET_SERVE_HBM_BUDGET`, else the device's PJRT ``bytes_limit``
+where the backend reports one) and a committed-bytes ledger.  A model
+asks for `replicas` devices; admission judges a fresh **projection**
+of the block in hand — parameter bytes (one full replica per device)
++ `MXNET_SERVE_HBM_TEMP_FACTOR` × the largest bucket's input+output
+activation bytes (outputs via ``jax.eval_shape`` — a trace, never a
+compile).  **Measured** reality flows in through
+``warmup()``→``reconcile()``: once this engine's executables exist,
+their memory-analysis rows (label ``serve.infer:<name>``; max bucket
+argument + output + temp bytes) replace the projection in the
+ledger.  Register never trusts pre-existing rows — the cost registry
+is process-wide, and a re-registered name must not inherit its
+previous incarnation's footprint (unregister drops the rows).
+
+Placement is best-fit decreasing: the `replicas` devices with the most
+free budget take the model.  If the k-th best device cannot fit it,
+registration fails with the typed `AdmissionDenied`, a
+``serve.admission_rejected`` counter, and a flight-recorder event
+naming the model and the bin-packing decision (per-device free bytes
+vs the footprint) — the refusal is forensically visible, not a silent
+stack trace.  ``warmup(name)`` re-reconciles the ledger against the
+measured rows once the executables exist.
+
+**Circuit breaker.**  The PR 7 replica-health probe generalized to
+whole-model backends: `MXNET_SERVE_BREAKER_FAILS` consecutive terminal
+request failures (infrastructure errors — flow-control sheds and
+deadline expiries are neutral) OPEN the model's breaker, and further
+submits fail fast with `CircuitOpen` instead of queueing onto a dead
+backend.  After `MXNET_SERVE_BREAKER_COOLDOWN_S` ONE probe request is
+let through (half-open); success re-closes the breaker
+(``serve.breaker_closed``), failure restarts the cooldown.  Every
+transition lands in the flight-recorder ring naming the model.
+
+Typical lifecycle::
+
+    reg = serving.ModelRegistry(devices=[mx.gpu(i) for i in range(4)])
+    reg.register("ranker", ranker_net, replicas=2,
+                 example_shape=(256,), wire_dtype="float32")
+    reg.warmup("ranker")                      # compile + reconcile
+    fut = reg.submit("ranker", x, lane="high", tenant="acme",
+                     deadline=0.05)
+    ...
+    reg.unregister("ranker")                  # close + release budget
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as _np
+
+from .. import config as _cfg
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..monitor import events
+from ..telemetry import costs as _costs
+from ..telemetry import flightrec as _bb
+from .engine import (InferenceEngine, QueueFull, DeadlineExceeded,
+                     EngineClosed, Shed)
+
+__all__ = ["ModelRegistry", "AdmissionDenied", "CircuitOpen",
+           "UnknownModel", "project_footprint"]
+
+
+class AdmissionDenied(MXNetError):
+    """The model's projected HBM footprint does not fit the remaining
+    per-device budget on enough devices — refused at REGISTRATION time
+    (a ledger check), not discovered as an allocator OOM at traffic
+    time."""
+
+
+class CircuitOpen(MXNetError):
+    """The model's backend circuit breaker is open: its recent
+    dispatches failed terminally, so submits fail fast instead of
+    queueing onto a dead backend.  Retry after the cooldown (a probe
+    re-closes the breaker once the backend recovers)."""
+
+
+class UnknownModel(MXNetError):
+    """submit()/warmup()/unregister() for a name that was never
+    registered (or was already unregistered)."""
+
+
+#: flow-control errors are NEUTRAL for the breaker: they mean the
+#: engine is protecting itself, not that the backend is broken
+_FLOW_ERRORS = (Shed, QueueFull, DeadlineExceeded, EngineClosed,
+                CircuitOpen)
+
+
+def _param_bytes(block):
+    """Total parameter bytes of an initialized block (one full replica
+    per serving device).  Deferred-init params (model_zoo nets before a
+    first forward) are materialized the same way the engine's
+    extract_params would."""
+    from ..parallel.functional import extract_params
+    return sum(int(_np.prod(v.shape)) * _np.dtype(v.dtype).itemsize
+               for v in extract_params(block).values())
+
+
+def project_footprint(block, buckets, example_shape, wire_dtype,
+                      temp_factor=None):
+    """Projected per-device HBM bytes for serving `block` with the
+    given bucket set: parameter bytes + temp_factor × (input + output
+    bytes of the largest bucket).  Outputs come from `jax.eval_shape`
+    over the functionalized block — a trace, never a compile, so
+    admission stays cheap.  Returns (bytes, detail dict)."""
+    import jax
+    from ..parallel.functional import functionalize
+    from ..ndarray.ndarray import NDArray
+    if temp_factor is None:
+        temp_factor = float(_cfg.get("MXNET_SERVE_HBM_TEMP_FACTOR"))
+    pb = _param_bytes(block)
+    largest = int(max(buckets))
+    dt = _np.dtype(wire_dtype or "float32")
+    in_bytes = largest * int(_np.prod(example_shape)) * dt.itemsize
+    out_bytes = 0
+    try:
+        from ..parallel.functional import extract_params
+        pure = functionalize(block, training=False)
+        pvals = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                 for n, v in extract_params(block).items()}
+
+        def fwd(params, x):
+            nd_in = (NDArray(x),)
+            tr = getattr(block, "_apply_input_transform", None)
+            if tr is not None:
+                nd_in = tr(nd_in)
+            out, _ = pure(params, *nd_in)
+            return out
+
+        x = jax.ShapeDtypeStruct((largest,) + tuple(example_shape),
+                                 dt)
+        out = jax.eval_shape(fwd, pvals, x)
+        out_bytes = sum(
+            int(_np.prod(a.shape)) * _np.dtype(a.dtype).itemsize
+            for a in jax.tree_util.tree_leaves(out))
+    except Exception:           # noqa: BLE001 — projection degrades to
+        pass                    # the input-side estimate, never raises
+    total = int(pb + temp_factor * (in_bytes + out_bytes))
+    return total, {"param_bytes": int(pb), "input_bytes": int(in_bytes),
+                   "output_bytes": int(out_bytes),
+                   "temp_factor": float(temp_factor),
+                   "bucket": largest}
+
+
+class _Breaker:
+    """Whole-model circuit breaker (closed → open → half-open).  State
+    transitions are lock-guarded; `allow()` is the submit-time gate."""
+
+    def __init__(self, model, max_fails, cooldown_s):
+        self.model = model
+        self.max_fails = int(max_fails)
+        self.cooldown = float(cooldown_s)
+        self._lock = threading.Lock()
+        self.state = "closed"
+        self.streak = 0
+        self.open_until = 0.0
+
+    def allow(self):
+        """True when a submit may proceed.  An open breaker whose
+        cooldown elapsed admits exactly ONE probe (the window re-arms
+        immediately, so a burst cannot pile onto an unproven
+        backend)."""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            now = time.monotonic()
+            if now < self.open_until:
+                return False
+            # half-open: one probe through, window re-armed
+            self.open_until = now + self.cooldown
+            events.incr("serve.breaker_probes")
+            return True
+
+    def ok(self):
+        with self._lock:
+            self.streak = 0
+            reopened = self.state != "closed"
+            self.state = "closed"
+            self.open_until = 0.0
+        if reopened:
+            events.incr("serve.breaker_closed")
+            _bb.record("serve", "breaker_closed", model=self.model)
+
+    def fail(self, exc=None):
+        with self._lock:
+            self.streak += 1
+            tripped = (self.streak >= self.max_fails
+                       or self.state == "open")
+            newly = tripped and self.state == "closed"
+            if tripped:
+                self.state = "open"
+                self.open_until = time.monotonic() + self.cooldown
+            streak = self.streak
+        if newly:
+            events.incr("serve.breaker_opened")
+            _bb.record("serve", "breaker_open", model=self.model,
+                       consecutive_fails=int(streak),
+                       error=type(exc).__name__ if exc else None,
+                       cooldown_s=self.cooldown)
+            import logging
+            logging.getLogger(__name__).warning(
+                "serving backend %r circuit OPEN after %d consecutive "
+                "failures (%s); failing fast for %.1fs", self.model,
+                streak, type(exc).__name__ if exc else "?",
+                self.cooldown)
+
+
+class _Entry:
+    __slots__ = ("name", "engine", "breaker", "footprint", "basis",
+                 "devices", "detail")
+
+    def __init__(self, name, engine, breaker, footprint, basis,
+                 devices, detail):
+        self.name = name
+        self.engine = engine
+        self.breaker = breaker
+        self.footprint = footprint
+        self.basis = basis          # "measured" | "projected"
+        self.devices = devices      # indices into the registry pool
+        self.detail = detail
+
+
+class ModelRegistry:
+    """N `InferenceEngine`s behind one admission-controlled surface.
+
+    devices: the serving pool (Contexts; default: the current
+        context).  Every model replica occupies one pool device and
+        commits its footprint to that device's ledger.
+    hbm_budget: per-device budget in bytes (default
+        MXNET_SERVE_HBM_BUDGET; 0 = the device's reported bytes_limit,
+        else unbudgeted — admission always runs, the ledger is always
+        kept, but nothing is refused without a budget to refuse
+        against).
+    """
+
+    def __init__(self, devices=None, hbm_budget=None):
+        if devices is None:
+            devices = [current_context()]
+        self._ctxs = [d if isinstance(d, Context) else Context(*d)
+                      for d in devices]
+        budget = int(hbm_budget if hbm_budget is not None
+                     else _cfg.get("MXNET_SERVE_HBM_BUDGET"))
+        self._budgets = [self._device_budget(c, budget)
+                         for c in self._ctxs]
+        self._committed = [0] * len(self._ctxs)
+        self._lock = threading.Lock()
+        self._models = {}           # name -> _Entry
+        self._closed = False
+        _bb.install_crash_hooks()
+
+    @staticmethod
+    def _device_budget(ctx, budget):
+        if budget > 0:
+            return budget
+        try:
+            from ..storage import memory_info
+            _, limit = memory_info(ctx)
+            return int(limit or 0)  # 0 = backend reports no limit
+        except Exception:           # noqa: BLE001
+            return 0
+
+    # -- admission -----------------------------------------------------
+    def _place(self, name, footprint, replicas):
+        """Best-fit decreasing bin-pack: the `replicas` pool devices
+        with the most free budget take the model.  Returns the chosen
+        indices, or raises AdmissionDenied with the full decision.
+        Caller holds self._lock."""
+        free = [(self._budgets[i] - self._committed[i]
+                 if self._budgets[i] > 0 else float("inf"), i)
+                for i in range(len(self._ctxs))]
+        free.sort(key=lambda t: (-t[0], t[1]))
+        if replicas > len(self._ctxs):
+            raise AdmissionDenied(
+                "model %r wants %d replicas but the pool has %d "
+                "devices" % (name, replicas, len(self._ctxs)))
+        chosen = free[:replicas]
+        worst_free, _ = chosen[-1]
+        if worst_free < footprint:
+            decision = [
+                {"device": repr(self._ctxs[i]),
+                 "budget": self._budgets[i],
+                 "committed": self._committed[i],
+                 "free": (self._budgets[i] - self._committed[i]
+                          if self._budgets[i] > 0 else None)}
+                for i in range(len(self._ctxs))]
+            events.incr("serve.admission_rejected")
+            events.incr("serve.admission_rejected",
+                        labels={"model": name})
+            # the refusal is a flight-recorder event NAMING the model
+            # and the bin-packing decision (the acceptance contract) —
+            # a later blackbox dump explains why the deploy bounced
+            _bb.record("serve", "admission_rejected", model=name,
+                       projected_bytes=int(footprint),
+                       replicas=int(replicas),
+                       decision=decision)
+            raise AdmissionDenied(
+                "model %r projected footprint %d bytes does not fit "
+                "the remaining budget on %d device(s): %s"
+                % (name, footprint, replicas,
+                   ", ".join("%s free=%s" % (d["device"], d["free"])
+                             for d in decision)))
+        return [i for _, i in chosen]
+
+    def register(self, name, block, replicas=1, example_shape=None,
+                 wire_dtype=None, buckets=None, max_batch=None,
+                 **engine_kw):
+        """Admit `block` as model `name` on `replicas` pool devices.
+
+        The per-device footprint comes from the cost registry when
+        measured rows exist for this model (a known re-deploy), else
+        from `project_footprint` — both checked against the device
+        budgets BEFORE any executable is built.  Raises AdmissionDenied
+        (with a flight-recorder event) on refusal; returns the
+        admission record on success."""
+        name = str(name)
+        max_batch = int(max_batch if max_batch is not None
+                        else _cfg.get("MXNET_SERVE_MAX_BATCH"))
+        from .engine import _parse_buckets
+        bset = _parse_buckets(
+            buckets if buckets is not None
+            else _cfg.get("MXNET_SERVE_BUCKETS"), max_batch)
+        label = "serve.infer:%s" % name
+        # admission always starts from a fresh PROJECTION of the block
+        # in hand: the cost registry is process-wide and keeps rows
+        # across unregister, so trusting a pre-existing
+        # 'serve.infer:<name>' row here would admit a RE-registered
+        # name at its previous incarnation's footprint.  Measured
+        # reality flows into the ledger through warmup()→reconcile(),
+        # which reads the rows THIS engine's executables just filed.
+        if example_shape is not None:
+            footprint, detail = project_footprint(
+                block, bset, example_shape, wire_dtype)
+            basis = "projected"
+        else:
+            # no signature yet (deferred first-request engines): only
+            # the parameter side is projectable
+            try:
+                footprint = _param_bytes(block)
+            except Exception:       # noqa: BLE001 — deferred params
+                footprint = 0
+            basis, detail = "projected", {"source": "params_only"}
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("registry is closed")
+            if name in self._models:
+                raise ValueError("model %r already registered "
+                                 "(unregister it first)" % name)
+            idxs = self._place(name, footprint, int(replicas))
+            for i in idxs:
+                self._committed[i] += footprint
+            # hold the name while the engine builds OUTSIDE the lock
+            # (construction replicates params onto devices — slow)
+            self._models[name] = None
+        try:
+            engine = InferenceEngine(
+                block, devices=[self._ctxs[i] for i in idxs],
+                buckets=bset, max_batch=max_batch,
+                example_shape=example_shape, wire_dtype=wire_dtype,
+                cost_label=label, **engine_kw)
+        except Exception:
+            with self._lock:    # roll the admission back — a failed
+                for i in idxs:  # build must not leak committed budget
+                    self._committed[i] = max(
+                        0, self._committed[i] - footprint)
+                self._models.pop(name, None)
+            raise
+        entry = _Entry(
+            name, engine,
+            _Breaker(name, _cfg.get("MXNET_SERVE_BREAKER_FAILS"),
+                     _cfg.get("MXNET_SERVE_BREAKER_COOLDOWN_S")),
+            footprint, basis, idxs, detail)
+        with self._lock:
+            if self._closed:
+                closed = True       # a close() raced the engine build:
+            else:                   # don't resurrect a closed registry
+                closed = False
+                self._models[name] = entry
+        if closed:
+            engine.close()
+            raise EngineClosed("registry closed during registration "
+                               "of model %r" % name)
+        events.incr("serve.models_admitted")
+        _bb.record("serve", "admitted", model=name,
+                   footprint_bytes=int(footprint), basis=basis,
+                   devices=[repr(self._ctxs[i]) for i in idxs])
+        return {"model": name, "footprint_bytes": int(footprint),
+                "basis": basis, "detail": detail,
+                "devices": [repr(self._ctxs[i]) for i in idxs]}
+
+    def unregister(self, name, timeout=30.0):
+        """Close the model's engine (drain + resolve every future) and
+        release its committed budget."""
+        with self._lock:
+            entry = self._models.get(str(name))
+            if entry is None:           # absent or mid-register
+                raise UnknownModel("model %r is not registered"
+                                   % (name,))
+            del self._models[str(name)]
+            for i in entry.devices:
+                self._committed[i] = max(
+                    0, self._committed[i] - entry.footprint)
+        entry.engine.close(timeout)
+        # drop the model's cost rows with it: a later re-registration
+        # under the same name must not read THIS incarnation's
+        # footprint (register projects fresh; warmup re-measures)
+        _costs.drop_rows("serve.infer:%s" % entry.name, kind="serve")
+        events.incr("serve.models_evicted")
+        _bb.record("serve", "evicted", model=entry.name,
+                   released_bytes=int(entry.footprint))
+
+    # -- traffic -------------------------------------------------------
+    def _entry(self, name):
+        with self._lock:
+            entry = self._models.get(str(name))
+        if entry is None:   # absent OR still mid-register (placeholder)
+            raise UnknownModel("model %r is not registered" % (name,))
+        return entry
+
+    def engine(self, name):
+        """The model's underlying InferenceEngine (escape hatch)."""
+        return self._entry(name).engine
+
+    def _observed(self, breaker):
+        """Future callback: success (or a flow-control rejection)
+        feeds the breaker's verdict; infrastructure failures trip
+        it."""
+        def cb(fut):
+            if fut.cancelled():
+                return
+            exc = fut.exception()
+            if exc is None:
+                breaker.ok()
+            elif not isinstance(exc, _FLOW_ERRORS):
+                breaker.fail(exc)
+        return cb
+
+    def _route(self, entry, submit, *args, **kw):
+        if not entry.breaker.allow():
+            events.incr("serve.breaker_rejected")
+            events.incr("serve.breaker_rejected",
+                        labels={"model": entry.name})
+            raise CircuitOpen(
+                "model %r backend circuit is open (cooldown %.1fs); "
+                "recent dispatches failed terminally"
+                % (entry.name, entry.breaker.cooldown))
+        try:
+            fut = submit(*args, **kw)
+        except _FLOW_ERRORS:
+            raise                   # engine self-protection: neutral
+        except (ValueError, TypeError):
+            raise                   # CLIENT error (bad shape/dtype/
+                                    # lane): a misconfigured caller
+                                    # must not open the breaker on a
+                                    # healthy backend for everyone
+        except Exception as e:      # noqa: BLE001 — submit-side infra
+            entry.breaker.fail(e)   # failure counts against the model
+            raise
+        fut.add_done_callback(self._observed(entry.breaker))
+        return fut
+
+    def submit(self, name, x, deadline=None, lane=None, tenant=None):
+        """Route one example to model `name` through its circuit
+        breaker.  Raises UnknownModel / CircuitOpen synchronously on
+        top of the engine's QueueFull / Shed / EngineClosed."""
+        entry = self._entry(name)
+        return self._route(entry, entry.engine.submit, x,
+                           deadline=deadline, lane=lane, tenant=tenant)
+
+    def submit_batch(self, name, x, deadline=None, lane=None,
+                     tenant=None):
+        entry = self._entry(name)
+        return self._route(entry, entry.engine.submit_batch, x,
+                           deadline=deadline, lane=lane, tenant=tenant)
+
+    # -- warmup / reconcile --------------------------------------------
+    def warmup(self, name=None, **kw):
+        """`engine.warmup()` for one model (or all), then reconcile the
+        admission ledger against the MEASURED cost-registry rows the
+        warmup just created — the projection admitted the model, the
+        measurement keeps the ledger honest."""
+        if name is not None:
+            names = [str(name)]
+        else:
+            with self._lock:
+                names = [n for n, e in self._models.items()
+                         if e is not None]
+        out = {}
+        for n in names:
+            entry = self._entry(n)
+            out[n] = entry.engine.warmup(**kw)
+            self.reconcile(n)
+        return out if name is None else out[str(name)]
+
+    def reconcile(self, name):
+        """Swap a model's projected footprint for the measured one
+        (cost-registry memory-analysis rows) when available; adjusts
+        the committed ledger by the delta and records the correction.
+        Returns the measured bytes (0 = nothing measured yet)."""
+        entry = self._entry(name)
+        measured = _costs.footprint_bytes("serve.infer:%s" % entry.name,
+                                          kind="serve")
+        if measured <= 0 or measured == entry.footprint:
+            return measured
+        with self._lock:
+            delta = measured - entry.footprint
+            for i in entry.devices:
+                self._committed[i] = max(0, self._committed[i] + delta)
+            entry.footprint, entry.basis = measured, "measured"
+        _bb.record("serve", "footprint_reconciled", model=entry.name,
+                   measured_bytes=int(measured), delta_bytes=int(delta))
+        return measured
+
+    # -- introspection / lifecycle -------------------------------------
+    def stats(self):
+        with self._lock:
+            models = {
+                n: {"footprint_bytes": e.footprint, "basis": e.basis,
+                    "devices": [repr(self._ctxs[i]) for i in e.devices],
+                    "breaker": e.breaker.state}
+                for n, e in self._models.items() if e is not None}
+            ledger = [
+                {"device": repr(c), "budget": b, "committed": u,
+                 "free": (b - u) if b > 0 else None}
+                for c, b, u in zip(self._ctxs, self._budgets,
+                                   self._committed)]
+        return {"models": models, "ledger": ledger}
+
+    def drain_all(self, timeout=30.0):
+        ok = True
+        with self._lock:
+            entries = [e for e in self._models.values()
+                       if e is not None]
+        for e in entries:
+            ok = e.engine.drain(timeout) and ok
+        return ok
+
+    def close(self, timeout=30.0):
+        """Close every engine (resolving every outstanding future) and
+        release the whole ledger.  Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            entries, self._models = [
+                e for e in self._models.values() if e is not None], {}
+            self._committed = [0] * len(self._ctxs)
+        for e in entries:
+            e.engine.close(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

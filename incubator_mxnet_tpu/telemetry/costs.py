@@ -44,7 +44,7 @@ import time
 import weakref
 
 __all__ = ["note_executable", "invoke", "table", "totals", "snapshot",
-           "reset", "metered_jit", "MeteredJit"]
+           "reset", "metered_jit", "MeteredJit", "footprint_bytes"]
 
 _LOCK = threading.Lock()
 _ROWS = {}                      # key -> dict row
@@ -215,6 +215,50 @@ def totals():
             "compile_wall_s": round(sum(r["compile_wall_s"]
                                         for r in rows), 3),
             "hbm_peak_bytes": max(peaks.values()) if peaks else 0}
+
+
+def footprint_bytes(label_prefix, kind=None):
+    """MEASURED per-device HBM footprint of one executable family
+    (ISSUE 8 admission control): the max over registered rows of one
+    label family (optionally filtered by `kind`) of argument + output
+    + temp bytes from XLA's memory_analysis.  Buckets of one serving
+    model share parameters, so the max row — the largest bucket — IS
+    the family's working set.  Rows are labeled `<family>[<idx>]`
+    (aot_cache appends the signature ordinal), so the match is exact
+    up to the '[' delimiter — plain startswith would let model
+    'ranker' read model 'ranker2's footprint.  Returns 0 when no
+    matching row carries memory fields (plain-jit rows resolve
+    cost_analysis only; admission then falls back to projection)."""
+    best = 0
+    bracket = label_prefix + "["
+    for r in table():
+        if kind is not None and r.get("kind") != kind:
+            continue
+        label = str(r.get("label", ""))
+        if label != label_prefix and not label.startswith(bracket):
+            continue
+        b = (r.get("argument_bytes", 0) + r.get("output_bytes", 0)
+             + r.get("temp_bytes", 0))
+        best = max(best, int(b))
+    return best
+
+
+def drop_rows(label_prefix, kind=None):
+    """Remove the registered rows of one label family (the
+    `footprint_bytes` matching rule: exact, or `<prefix>[...]`).  The
+    ModelRegistry drops a model's rows on unregister so a later
+    re-registration under the same name cannot read the previous
+    incarnation's footprint; stale `invoke()`s against dropped keys
+    are no-ops.  Returns the number of rows removed."""
+    bracket = label_prefix + "["
+    with _LOCK:
+        gone = [k for k, r in _ROWS.items()
+                if (kind is None or r.get("kind") == kind)
+                and (str(r.get("label", "")) == label_prefix
+                     or str(r.get("label", "")).startswith(bracket))]
+        for k in gone:
+            del _ROWS[k]
+    return len(gone)
 
 
 def snapshot():

@@ -88,6 +88,19 @@ class MetricsExporter:
         return (str(v).replace("\\", r"\\").replace('"', r"\"")
                 .replace("\n", r"\n"))
 
+    @classmethod
+    def _labelstr(cls, labels, extra=None):
+        """`{k="v",...}` for a labels dict (+ optional extra pairs),
+        deterministically ordered; empty string for no labels."""
+        items = sorted((labels or {}).items())
+        if extra:
+            items += list(extra.items())
+        if not items:
+            return ""
+        return "{%s}" % ",".join(
+            '%s="%s"' % (cls._escape_label(k), cls._escape_label(v))
+            for k, v in items)
+
     @staticmethod
     def _cost_lines(prefix):
         """The executable cost registry (telemetry.costs) as labeled
@@ -119,36 +132,64 @@ class MetricsExporter:
 
     def prometheus_text(self) -> str:
         """Prometheus exposition text (version 0.0.4): counters +
-        quantile summaries for every observed sample series, plus the
-        per-executable cost families."""
+        quantile summaries for every observed sample series (labeled
+        tenant/lane splits render as labeled children of the same
+        family — ISSUE 8), plus the per-executable cost families."""
         counts, lats = self._snapshot()
+        lcounts = self._c.labeled_snapshot()
+        llats = self._c.labeled_latency_snapshot(pcts=self._pcts)
         # an empty percentile dict (a reset() racing this scrape
         # between the snapshot's name collection and the per-name
         # percentiles) renders as a plain counter path, never KeyError
         sampled = {n for n, p in lats.items() if p}
+        sampled |= {n for n, rows in llats.items() if rows}
         # sampled series render as summaries; their companion counters
         # (the same name = total µs, '<name>.n' = total observations)
         # fold into _sum/_count instead of repeating as bare counters
         folded = sampled | {n + ".n" for n in sampled}
         lines = []
-        for name in sorted(set(counts) | sampled):
+        for name in sorted(set(counts) | sampled | set(lcounts)):
             if name in sampled:
                 m = _metric_name(self._prefix, name)
-                p = lats[name]
+                p = lats.get(name) or {}
                 lines.append("# TYPE %s summary" % m)
                 for pct in self._pcts:
-                    lines.append('%s{quantile="%s"} %s'
-                                 % (m, _fmt(pct / 100.0),
-                                    _fmt(p["p%g" % pct])))
+                    if p:
+                        lines.append('%s{quantile="%s"} %s'
+                                     % (m, _fmt(pct / 100.0),
+                                        _fmt(p["p%g" % pct])))
+                    for row in llats.get(name, ()):
+                        lines.append("%s%s %s" % (
+                            m, self._labelstr(
+                                row["labels"],
+                                {"quantile": _fmt(pct / 100.0)}),
+                            _fmt(row["p%g" % pct])))
                 if name in counts:      # observe_time keeps the total
                     lines.append("%s_sum %s" % (m, _fmt(counts[name])))
-                lines.append("%s_count %s"
-                             % (m, _fmt(counts.get(name + ".n",
-                                                   p["n"]))))
+                if p:
+                    lines.append("%s_count %s"
+                                 % (m, _fmt(counts.get(name + ".n",
+                                                       p["n"]))))
+                # labeled _count comes from the CUMULATIVE '<name>.n'
+                # labelset counters, not the bounded ring window — a
+                # window-size count plateaus at MAX_SAMPLES and reads
+                # as rate()==0 to Prometheus while traffic flows
+                lcum = {tuple(sorted(r["labels"].items())): r["value"]
+                        for r in lcounts.get(name + ".n", ())}
+                for row in llats.get(name, ()):
+                    key = tuple(sorted(row["labels"].items()))
+                    lines.append("%s_count%s %s"
+                                 % (m, self._labelstr(row["labels"]),
+                                    _fmt(lcum.get(key, row["n"]))))
             elif name not in folded:
                 m = _metric_name(self._prefix, name)
                 lines.append("# TYPE %s counter" % m)
-                lines.append("%s %s" % (m, _fmt(counts[name])))
+                if name in counts:
+                    lines.append("%s %s" % (m, _fmt(counts[name])))
+                for row in lcounts.get(name, ()):
+                    lines.append("%s%s %s"
+                                 % (m, self._labelstr(row["labels"]),
+                                    _fmt(row["value"])))
         if self._c is events:
             # the cost registry is process-wide state: it accompanies
             # the process ledger only — an exporter over a custom
@@ -165,6 +206,11 @@ class MetricsExporter:
                "uptime_s": round(time.time() - self._t0, 3),
                "counters": counts,
                "percentiles": lats}
+        lcounts = self._c.labeled_snapshot()
+        llats = self._c.labeled_latency_snapshot(pcts=self._pcts)
+        if lcounts or llats:
+            out["labeled"] = {"counters": lcounts,
+                              "percentiles": llats}
         if self._c is events:
             try:
                 from . import costs as _costs

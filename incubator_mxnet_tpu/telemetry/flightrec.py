@@ -306,6 +306,10 @@ def dump_blackbox(path=None, reason="manual", exc=None, last=None):
     # cost resolution must not skew the counters this dump reports)
     counters = events.snapshot()
     pcts = events.latency_snapshot()
+    # tenant/lane splits (ISSUE 8): the labeled rings ride along so an
+    # overload dump can say WHOSE p99 blew out, not just that one did
+    labeled = {"counters": events.labeled_snapshot(),
+               "percentiles": events.labeled_latency_snapshot()}
     hbm_sample(tag="dump", force=True)
     from . import costs as _costs
     try:
@@ -322,6 +326,7 @@ def dump_blackbox(path=None, reason="manual", exc=None, last=None):
         "config": _config_snapshot(),
         "counters": counters,
         "percentiles": pcts,
+        "labeled": labeled,
         "costs": cost_block,
         "hbm": {"peaks": hbm_peaks()},
         "events": evs,
