@@ -58,6 +58,7 @@ from . import callback
 from . import monitor
 from .monitor import Monitor
 from . import fault
+from . import integrity
 from . import telemetry
 from . import serving
 from . import numpy as np              # mx.np — NumPy-semantics front-end
@@ -69,5 +70,6 @@ __all__ = ["MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",
            "gluon", "optimizer", "Optimizer", "metric", "initializer",
            "kvstore", "kv", "io", "image", "profiler", "runtime",
            "test_utils", "symbol", "sym", "Symbol", "module", "mod",
-           "parallel", "fault", "monitor", "telemetry", "np", "npx",
+           "parallel", "fault", "integrity", "monitor", "telemetry",
+           "np", "npx",
            "__version__"]

@@ -209,6 +209,30 @@ register("MXNET_CKPT_KEEP", int, 3,
          "ResilientTrainer: checkpoints retained (keep-last-K garbage "
          "collection; older step_* directories are removed after a "
          "successful write)")
+register("MXNET_CKPT_VERIFY", bool, True,
+         "Verify every checkpoint against its integrity manifest "
+         "(per-file + per-leaf CRCs, integrity.py) before restoring "
+         "it.  A mismatch raises a typed CheckpointCorrupt naming the "
+         "bad leaf and resume() salvages the newest VERIFIABLE "
+         "checkpoint from keep-K instead of dying.  0 skips "
+         "verification (a flipped bit loads silently)")
+register("MXNET_IO_CORRUPT_BUDGET", int, 16,
+         "Corrupt RecordIO records tolerated (quarantined: skipped, "
+         "counted on io.decode.records_corrupt, ring-evented and "
+         "appended to the io-quarantine JSONL) per epoch per "
+         "reader/service before the epoch fails loudly with "
+         "CorruptRecordBudgetExceeded.  Negative = unlimited "
+         "quarantine; 0 = zero tolerance (first corrupt record fails "
+         "the epoch)")
+register("MXNET_SDC_AUDIT_STEPS", int, 0,
+         "Cross-replica SDC audit cadence: every N steps, hash every "
+         "replicated param/optimizer-state shard per replica and "
+         "compare across the mesh (integrity.audit_replicas).  A "
+         "divergent replica is silent data corruption: black-box dump "
+         "naming replica+leaf, then checkpoint rollback "
+         "(ResilientTrainer) or replica eviction (ElasticTrainer). "
+         "0 = off (the audit reads every replicated leaf back to "
+         "host, so the cadence is a cost knob)")
 register("MXNET_BAD_STEP_ROLLBACK", int, 3,
          "ResilientTrainer: consecutive skipped (non-finite/spiking) "
          "steps before rolling back to the last checkpoint; 0 disables "
@@ -236,7 +260,7 @@ register("MXNET_IO_WORKER_RESTARTS", int, 2,
          "DecodeService: dead decode-worker auto-respawns allowed per "
          "service (pool-wide).  A respawned worker resumes its "
          "(wid, epoch) shard slice at the first undelivered batch — "
-         "per-batch RNG derivation keeps the stream bit-identical to "
+         "per-record RNG derivation keeps the stream bit-identical to "
          "an uninterrupted run.  Respawns are counted on "
          "io.decode.worker_restarts; past the budget a dead worker is "
          "a hard mid-epoch error (the pre-elastic behaviour).  0 "

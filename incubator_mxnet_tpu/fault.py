@@ -37,6 +37,24 @@ Sites are plain strings; the built-in ones:
                         skips heartbeats for one staleness window —
                         reported (mesh.replica_slow counter +
                         flight-recorder event) but not shrunk
+    ckpt.bitflip        ResilientTrainer: ONE bit of the largest data
+                        file inside the just-published checkpoint is
+                        flipped (flip_file_bit) — the classic silent
+                        storage corruption; detected by the integrity
+                        manifest on the next verify/restore, salvaged
+                        from keep-K
+    io.corrupt          record readers (decode-service workers and the
+                        threaded ImageRecordIter path; call-ordinal =
+                        record read): the payload gets one bit flipped
+                        in flight (flip_bits) — caught by the CRC
+                        sidecar or the decoder and QUARANTINED, never
+                        retried (corruption is non-transient)
+    mesh.replica_divergence  cross-replica SDC audit
+                        (integrity.audit_replicas): the victim replica
+                        (highest rid) reports a perturbed CRC for one
+                        leaf — detection, blame and the rollback/
+                        eviction response all run the production
+                        comparison path
 
 Faults install programmatically::
 
@@ -55,6 +73,7 @@ installed — `should_fire` on an empty registry is a dict lookup miss.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, List, Optional
@@ -62,7 +81,7 @@ from typing import Dict, List, Optional
 __all__ = ["InjectedFault", "TransientFault", "InjectedIOError",
            "Preempted", "install", "clear", "reset_from_config",
            "should_fire", "maybe_raise", "maybe_slow", "fired_count",
-           "active_sites"]
+           "active_sites", "flip_bits", "flip_file_bit"]
 
 
 class InjectedFault(Exception):
@@ -264,3 +283,40 @@ def maybe_slow(site: str, step: Optional[int] = None):
     """Stall if a slow-I/O fault at `site` fires (its `seconds` already
     elapsed inside should_fire)."""
     should_fire(site, step)
+
+
+# ---------------------------------------------------------------------------
+# deterministic corruption injectors (ISSUE 9): the byte-level flips
+# behind the ckpt.bitflip / io.corrupt sites.  Pure and seedable —
+# the same input always corrupts the same bit, so a test (or the
+# bench chaos scenario) can assert EXACTLY which record/leaf went bad.
+# ---------------------------------------------------------------------------
+
+def flip_bits(buf: bytes, seed: int = 0) -> bytes:
+    """Return `buf` with one bit flipped at a deterministic position
+    (middle of the payload, nudged by `seed`).  Empty input returns
+    empty — there is nothing to corrupt."""
+    if not buf:
+        return buf
+    b = bytearray(buf)
+    pos = (len(b) // 2 + int(seed)) % len(b)
+    b[pos] ^= 1 << (int(seed) % 8)
+    return bytes(b)
+
+
+def flip_file_bit(path: str, seed: int = 0) -> int:
+    """Flip one bit in the middle of the file at `path` in place
+    (deterministic per (size, seed)); returns the byte offset flipped.
+    The ckpt.bitflip site applies this to the largest data file of a
+    just-published checkpoint — the closest safe analogue of a storage
+    bitflip an injected fault can produce."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return -1
+    pos = (size // 2 + int(seed)) % size
+    with open(path, "r+b") as fh:
+        fh.seek(pos)
+        byte = fh.read(1)
+        fh.seek(pos)
+        fh.write(bytes([byte[0] ^ (1 << (int(seed) % 8))]))
+    return pos

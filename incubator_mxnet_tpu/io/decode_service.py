@@ -47,11 +47,21 @@ Worker death is survivable: a worker that dies mid-epoch is
 AUTO-RESPAWNED (up to ``MXNET_IO_WORKER_RESTARTS`` pool-wide, counted
 on ``io.decode.worker_restarts``).  The replacement resumes the SAME
 (wid, epoch) shard slice at the first undelivered batch — augmentation
-RNG derives per (seed, epoch, wid, seq) batch, so the resumed stream
+RNG derives per (seed, epoch, wid, seq, record), so the resumed stream
 is bit-identical to an uninterrupted one and every record is still
 decoded exactly once.  Slots the dead worker held are reclaimed
 through a shared slot-owner table, so the ring never shrinks.  Past
 the respawn budget a dead worker is a hard mid-epoch error, as before.
+
+Corrupt records are QUARANTINED, not fatal (ISSUE 9): with a
+``<rec>.crc`` sidecar present (`recordio.write_crc_sidecar`) every
+payload is CRC-verified before decode, and a mismatching OR
+undecodable record is skipped — the batch ships short, the parent
+books ``io.decode.records_corrupt`` + a ring event + a quarantine
+JSONL entry naming file/offset — under the pool-wide per-epoch
+``MXNET_IO_CORRUPT_BUDGET`` (exceeded → typed
+`CorruptRecordBudgetExceeded`).  Per-RECORD RNG derivation is what
+keeps the surviving records bit-identical to an uninjected run.
 
 Observability (`monitor.events` + the flight-recorder ring):
 
@@ -60,6 +70,7 @@ Observability (`monitor.events` + the flight-recorder ring):
     io.decode.queue_depth                  ready-batch gauge (observe)
     io.decode.epochs                       epochs announced
     io.decode.worker_restarts              dead workers auto-respawned
+    io.decode.records_corrupt              records quarantined
 
 A consumer wait above 1 ms lands a `("io", "stall")` event with the
 queue depth in the black-box ring, so a dump attributes starvation to
@@ -81,9 +92,12 @@ import warnings
 import numpy as _np
 
 from .. import config as _cfg
+from .. import fault as _fault
+from ..integrity import (CorruptRecordBudgetExceeded, RecordCorrupt,
+                         checksum_fn)
 from ..monitor import events
 from .recordio import (idx_sidecar_path, list_record_offsets,
-                       read_record, unpack_img)
+                       read_crc_sidecar, read_record, unpack_img)
 
 __all__ = ["DecodeService", "DecodeServiceUnavailable", "SlabBatch",
            "shard_records", "decode_record", "service_available"]
@@ -252,13 +266,28 @@ def decode_record(raw, data_shape, resize, rand_crop, rand_mirror, rng,
 
 def _batch_rng(seed, epoch, wid, seq):
     """Augment RNG for ONE batch, derived from (seed, epoch, wid, seq).
-    Per-batch (not per-epoch-stream) derivation is what makes a
-    respawned worker resumable bit-for-bit: batch `seq` draws the same
-    crops/mirrors whether it is decoded by the original worker or by a
-    replacement that skipped straight to it."""
+    Kept for callers that want a whole-batch stream; the workers now
+    derive per RECORD (`_record_rng`) — see its docstring for why."""
     return _np.random.RandomState(
         (int(seed) * 2654435761 + int(epoch) * 1000003 +
          int(wid) * 8191 + int(seq) * 7919 + 1) % (2 ** 31 - 1))
+
+
+def _record_rng(seed, epoch, wid, seq, j):
+    """Augment RNG for ONE record, derived from
+    (seed, epoch, wid, seq, record-position-in-batch).
+
+    Deriving per RECORD (not per batch with sequential draws) gives
+    two independence properties the integrity layer needs on top of
+    the respawn bit-identity the per-batch scheme already had:
+    a QUARANTINED record consumes no draws, so the clean records
+    around it keep exactly the pixels an uninjected run produces (the
+    bit-identical-clean-stream contract) — and a respawned worker
+    resuming at batch `seq` still reproduces every record of it."""
+    return _np.random.RandomState(
+        (int(seed) * 2654435761 + int(epoch) * 1000003 +
+         int(wid) * 8191 + int(seq) * 7919 + int(j) * 104729 + 1)
+        % (2 ** 31 - 1))
 
 
 def _write_label(row, label):
@@ -339,13 +368,23 @@ def _slot_views(buf, spec):
 
 
 def _worker_main(wid, spec, ctrl_q, free_q, out_q, cur_epoch,
-                 owners=None):
+                 owners=None, corrupt_n=None):
     """Worker process entry: decode this worker's shard of each
     announced epoch into free slab slots.  jax-free by design — only
     numpy/PIL/recordio run here.  `owners` is the shared slot-owner
     table: a worker writes its wid when it acquires a slot, the PARENT
     clears it on message receipt — so a slot held by a worker that died
-    is identifiable and reclaimable (auto-respawn)."""
+    is identifiable and reclaimable (auto-respawn).
+
+    `corrupt_n` is the pool-wide per-epoch quarantine counter (a
+    lock-free shared int — racy increments can only UNDER-count,
+    which errs on the tolerant side of the budget): a record whose
+    payload fails its sidecar CRC or whose decode raises is
+    QUARANTINED — reported to the parent as a ``("corrupt", ...)``
+    message naming file offset and reason, skipped, the batch shipped
+    short — until ``MXNET_IO_CORRUPT_BUDGET`` is exceeded, at which
+    point the worker fails the epoch loudly (the parent re-raises a
+    typed `CorruptRecordBudgetExceeded`)."""
     seg = None
     fh = None
     if os.environ.get("MXNET_IO_WORKER_DEBUG"):
@@ -367,6 +406,10 @@ def _worker_main(wid, spec, ctrl_q, free_q, out_q, cur_epoch,
         batch = spec["batch"]
         mean = spec["mean"]
         std = spec["std"]
+        crcs = spec.get("crcs")
+        crc_of = checksum_fn(spec["crc_algo"]) \
+            if crcs is not None else None
+        budget = int(spec.get("corrupt_budget", -1))
         while True:
             cmd = ctrl_q.get()
             if cmd[0] == "stop":
@@ -394,21 +437,59 @@ def _worker_main(wid, spec, ctrl_q, free_q, out_q, cur_epoch,
                     if owners is not None:
                         owners[slot] = wid
                     dview, lview = views[slot]
-                    # per-batch augment RNG (seed, epoch, wid, seq):
-                    # bit-identical whether this batch is decoded by
-                    # the original worker or a post-crash replacement
-                    rng = _batch_rng(spec["seed"], epoch, wid, seq)
+                    k = 0           # clean records land compacted
                     for j, ri in enumerate(idxs):
-                        fh.seek(offsets[ri])
-                        raw = read_record(fh)
-                        _, label = decode_record(
-                            raw, spec["data_shape"], spec["resize"],
-                            spec["rand_crop"], spec["rand_mirror"],
-                            rng, mean=mean, std=std,
-                            dtype=spec["dtype"], out=dview[j])
-                        _write_label(lview[j], label)
-                    out_q.put(("batch", epoch, slot, len(idxs),
-                               wid, seq))
+                        try:
+                            fh.seek(offsets[ri])
+                            # in-flight payload corruption injector
+                            # (io.corrupt, fault.py): caught below by
+                            # the CRC sidecar or the decoder — the
+                            # production quarantine path, not a mock
+                            raw = read_record(fh)
+                            if raw is None:
+                                raise RecordCorrupt(
+                                    spec["path"], int(offsets[ri]),
+                                    "EOF mid-shard (truncated file)")
+                            if _fault.should_fire("io.corrupt"):
+                                raw = _fault.flip_bits(raw)
+                            if crc_of is not None and \
+                                    int(crcs[ri]) >= 0 and \
+                                    crc_of(raw) != int(crcs[ri]):
+                                raise RecordCorrupt(
+                                    spec["path"], int(offsets[ri]),
+                                    "payload CRC mismatch")
+                            # per-RECORD augment RNG (seed, epoch,
+                            # wid, seq, j): bit-identical whether this
+                            # record is decoded by the original
+                            # worker, a post-crash replacement, or in
+                            # a run where its NEIGHBOR was quarantined
+                            rng = _record_rng(spec["seed"], epoch,
+                                              wid, seq, j)
+                            _, label = decode_record(
+                                raw, spec["data_shape"],
+                                spec["resize"], spec["rand_crop"],
+                                spec["rand_mirror"], rng, mean=mean,
+                                std=std, dtype=spec["dtype"],
+                                out=dview[k])
+                        except Exception as e:  # noqa: BLE001 —
+                            # quarantine: ONE bad record must not kill
+                            # the worker or perturb its clean stream
+                            out_q.put((
+                                "corrupt", epoch, wid,
+                                int(offsets[ri]),
+                                ("%s: %s" % (type(e).__name__,
+                                             e))[:200]))
+                            cn = 1
+                            if corrupt_n is not None:
+                                cn = corrupt_n.value + 1
+                                corrupt_n.value = cn
+                            if 0 <= budget < cn:
+                                raise CorruptRecordBudgetExceeded(
+                                    spec["path"], cn, budget)
+                            continue
+                        _write_label(lview[k], label)
+                        k += 1
+                    out_q.put(("batch", epoch, slot, k, wid, seq))
                     slot = None             # ownership passed on (the
                     seq += 1                # parent clears owners[])
                     if cur_epoch.value != epoch:
@@ -525,6 +606,18 @@ class DecodeService:
         if slots <= 0:
             slots = 2 * workers + 2
         self._slots_n = max(slots, workers + 1)
+        # optional integrity sidecar (<rec>.crc): per-record payload
+        # CRCs the workers verify before decoding — a mismatch is
+        # QUARANTINED (skipped + reported), not decoded into garbage
+        crc_algo, crc_arr = None, None
+        sidecar = read_crc_sidecar(path_imgrec)
+        if sidecar is not None:
+            crc_algo, crc_map = sidecar
+            checksum_fn(crc_algo)   # unknown algo fails HERE, loudly
+            crc_arr = _np.full(len(self._offsets), -1, _np.int64)
+            for i, off in enumerate(self._offsets):
+                crc_arr[i] = crc_map.get(int(off), -1)
+        self._corrupt_budget = int(_cfg.get("MXNET_IO_CORRUPT_BUDGET"))
         self._spec = {
             "path": path_imgrec, "offsets": self._offsets,
             "batch": self._batch, "data_shape": self._data_shape,
@@ -537,6 +630,8 @@ class DecodeService:
             "std": None if std is None else
             _np.asarray(std, _np.float32).reshape(3, 1, 1),
             "slots": self._slots_n, "shm": None,
+            "crcs": crc_arr, "crc_algo": crc_algo,
+            "corrupt_budget": self._corrupt_budget,
         }
         dbytes = int(_np.prod((self._batch,) + self._data_shape)) * \
             _np.dtype(dtype).itemsize
@@ -555,6 +650,7 @@ class DecodeService:
         self._out_q = None
         self._cur_epoch = None      # mp.Value workers poll for aborts
         self._owners = None         # shared slot-owner table (respawn)
+        self._corrupt_n = None      # pool-wide per-epoch quarantines
         self._delivered = {}        # wid -> batches received this epoch
         self._restarts_left = int(_cfg.get("MXNET_IO_WORKER_RESTARTS"))
         self._lock = threading.Lock()   # slot recycle is cross-thread
@@ -607,6 +703,10 @@ class DecodeService:
         # parent clears on delivery — slots a dead worker held are
         # identifiable and reclaimed on respawn (ring never shrinks)
         self._owners = ctx.Array("l", [-1] * self._slots_n, lock=False)
+        # pool-wide quarantine counter, lock-free on purpose: a racy
+        # lost increment only under-counts toward the budget, and a
+        # SIGKILLed worker can never wedge siblings on a Value lock
+        self._corrupt_n = ctx.Value("l", 0, lock=False)
         for s in range(self._slots_n):
             self._free_q.put(s)
         try:
@@ -668,7 +768,8 @@ class DecodeService:
         p = ctx.Process(
             target=_worker_main,
             args=(wid, self._spec, cq, self._free_q,
-                  self._out_q, self._cur_epoch, self._owners),
+                  self._out_q, self._cur_epoch, self._owners,
+                  self._corrupt_n),
             daemon=True, name="DecodeWorker-%d" % wid)
         p.start()
         self._ctrl[wid] = cq
@@ -696,7 +797,7 @@ class DecodeService:
         batch that reached the parent is counted in `self._delivered`.
         Each worker — replacement and survivor alike — resumes its
         (wid, epoch) shard slice at the first undelivered batch;
-        per-batch RNG derivation (seed, epoch, wid, seq) makes the
+        per-record RNG derivation (seed, epoch, wid, seq, j) makes the
         resumed streams bit-identical to an uninterrupted run, with
         every record still decoded exactly once."""
         import multiprocessing as mp
@@ -873,6 +974,8 @@ class DecodeService:
         self._exhausted = False
         self._consumed = False
         self._delivered = {}
+        if self._corrupt_n is not None:
+            self._corrupt_n.value = 0   # quarantine budget is per-epoch
         self._cur_epoch.value = self._epoch
         for cq in self._ctrl:
             cq.put(("epoch", self._epoch))
@@ -904,6 +1007,8 @@ class DecodeService:
             if msg[0] == "batch":
                 self._owners[msg[2]] = -1
                 self._free_q.put(msg[2])
+            elif msg[0] == "corrupt":
+                continue            # aborted epoch: not booked
             elif msg[0] in ("eoe", "error") and msg[1] == self._epoch:
                 self._eoe_wids.add(msg[2])
 
@@ -972,7 +1077,18 @@ class DecodeService:
                 self._owners[msg[2]] = -1   # stale (pre-reset straggler)
                 self._free_q.put(msg[2])
                 continue
-            if tag in ("eoe", "error") and msg[1] != self._epoch:
+            if tag in ("eoe", "error", "corrupt") and \
+                    msg[1] != self._epoch:
+                continue
+            if tag == "corrupt":
+                # a worker quarantined one record: book it — counter,
+                # flight-recorder event, quarantine JSONL naming
+                # file/offset — and keep pulling (the batch it came
+                # from still arrives, just short)
+                from .. import integrity as _integ
+                _integ.quarantine_record(
+                    self._path, msg[3], msg[4],
+                    epoch=self._epoch, wid=msg[2])
                 continue
             if tag == "eoe":
                 self._eoe_wids.add(msg[2])
@@ -983,8 +1099,22 @@ class DecodeService:
             if tag == "error":
                 self._eoe_wids.add(msg[2])  # the worker left the epoch
                 self._exhausted = True
+                if str(msg[3]).startswith(
+                        "CorruptRecordBudgetExceeded"):
+                    # the typed loud failure: the epoch's data is
+                    # sick, not blipping (budget counted pool-wide)
+                    raise CorruptRecordBudgetExceeded(
+                        self._path,
+                        int(self._corrupt_n.value)
+                        if self._corrupt_n is not None else -1,
+                        self._corrupt_budget)
                 raise RuntimeError("decode worker %d failed: %s"
                                    % (msg[2], msg[3]))
+            if msg[3] == 0:         # batch: every record quarantined —
+                self._owners[msg[2]] = -1   # recycle the slot, advance
+                self._delivered[msg[4]] = int(msg[5]) + 1   # resume pt
+                self._free_q.put(msg[2])
+                continue            # keep pulling
             break
         _, _, slot, count, wid, seq = msg
         # delivery: the slot's owner mark clears (a respawn must not

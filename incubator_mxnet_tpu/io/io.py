@@ -373,7 +373,15 @@ class ImageRecordIter(DataIter):
         # native C++ pipeline (src/io/recordio_pipeline.cc — the
         # ImageRecordIOParser2 equivalent): GIL-free decode+augment.
         # PIL threadpool below is the always-available fallback.
-        if dtype in ("float32", "uint8") and self.data_shape[0] == 3:
+        # A present .crc integrity sidecar OPTS OUT of the native
+        # reader: per-record CRC verification + quarantine live in the
+        # python/service decode paths (the C++ pipeline decodes
+        # internally, record boundaries invisible), and a caller who
+        # wrote a sidecar asked for verification, not speed.
+        from .recordio import crc_sidecar_path as _crc_side
+        has_crc = os.path.exists(_crc_side(path_imgrec))
+        if not has_crc and dtype in ("float32", "uint8") \
+                and self.data_shape[0] == 3:
             from . import native as _native
             if _native.available():
                 try:
@@ -406,6 +414,22 @@ class ImageRecordIter(DataIter):
         else:
             self._rec = MXRecordIO(path_imgrec, "r")
             self._keys = None
+        # integrity sidecar (<rec>.crc): payload CRCs verified before
+        # decode; a mismatching or undecodable record is QUARANTINED
+        # (skipped + counted + ledgered) under MXNET_IO_CORRUPT_BUDGET
+        from .recordio import read_crc_sidecar
+        from ..integrity import checksum_fn
+        self._path = path_imgrec
+        self._crc_fn = None
+        self._crc_map = None
+        sidecar = read_crc_sidecar(path_imgrec)
+        if sidecar is not None:
+            algo, self._crc_map = sidecar
+            self._crc_fn = checksum_fn(algo)
+        from .. import config as _config
+        self._corrupt_budget = int(
+            _config.get("MXNET_IO_CORRUPT_BUDGET"))
+        self._corrupt_n = 0         # per-epoch quarantine count
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=preprocess_threads)
         self._prefetch = max(1, prefetch_buffer)
@@ -493,6 +517,7 @@ class ImageRecordIter(DataIter):
             self._pos = 0
         else:
             self._rec.reset()
+        self._corrupt_n = 0
         while True:
             raws = []
             with self._lock:
@@ -504,8 +529,11 @@ class ImageRecordIter(DataIter):
             if not raws:
                 return
             results = [f.result() for f in
-                       [self._pool.submit(self._process, r)
-                        for r in raws]]
+                       [self._pool.submit(self._process, r, off)
+                        for r, off in raws]]
+            results = [r for r in results if r is not None]
+            if not results:         # whole batch quarantined: read on
+                continue
             data = _np.stack([r[0] for r in results])
             label = _np.stack([r[1] for r in results])
             data, label, pad = self._pad_batch(data, label)
@@ -536,27 +564,62 @@ class ImageRecordIter(DataIter):
             self._pos = 0
         else:
             self._rec.reset()
+        self._corrupt_n = 0
         self._pending = []
         self._fill()
 
     def _read_record(self):
+        """One raw record plus its byte offset (the quarantine ledger
+        and the CRC sidecar are keyed by offset), or None at epoch
+        end."""
         if self._keys is not None:
             if self._pos >= len(self._order):
                 return None
-            rec = self._rec.read_idx(self._order[self._pos])
+            key = self._order[self._pos]
+            rec = self._rec.read_idx(key)
             self._pos += 1
-            return rec
-        return self._rec.read()
+            return rec, self._rec.idx[key]
+        off = self._rec.tell()
+        rec = self._rec.read()
+        return None if rec is None else (rec, off)
 
-    def _process(self, raw):
+    def _quarantine(self, offset, reason):
+        """Book one corrupt record (counter + ring event + quarantine
+        JSONL) and enforce the per-epoch budget — called from pool
+        threads, so the budget count rides the reader lock."""
+        from .. import integrity as _integ
+        _integ.quarantine_record(self._path, offset, reason)
+        with self._lock:
+            self._corrupt_n += 1
+            n = self._corrupt_n
+        if 0 <= self._corrupt_budget < n:
+            raise _integ.CorruptRecordBudgetExceeded(
+                self._path, n, self._corrupt_budget)
+
+    def _process(self, raw, offset=-1):
         # ONE decode+augment implementation for the threaded pool and
         # the decode-service workers (io/decode_service.py) — the two
-        # execution engines cannot drift numerically
+        # execution engines cannot drift numerically.  Returns None
+        # for a QUARANTINED record (CRC mismatch / undecodable).
+        from .. import fault
         from .decode_service import decode_record
-        return decode_record(raw, self.data_shape, self._resize,
-                             self._rand_crop, self._rand_mirror,
-                             self._rng, mean=self._mean, std=self._std,
-                             dtype=self._dtype)
+        from ..integrity import RecordCorrupt
+        try:
+            if fault.should_fire("io.corrupt"):
+                raw = fault.flip_bits(raw)
+            if self._crc_fn is not None:
+                want = self._crc_map.get(int(offset), -1)
+                if want >= 0 and self._crc_fn(raw) != want:
+                    raise RecordCorrupt(self._path, offset,
+                                        "payload CRC mismatch")
+            return decode_record(raw, self.data_shape, self._resize,
+                                 self._rand_crop, self._rand_mirror,
+                                 self._rng, mean=self._mean,
+                                 std=self._std, dtype=self._dtype)
+        except Exception as e:      # noqa: BLE001 — one bad record
+            # must not kill the epoch (the budget decides that)
+            self._quarantine(offset, "%s: %s" % (type(e).__name__, e))
+            return None
 
     def _fill(self):
         while len(self._pending) < self._prefetch:
@@ -569,7 +632,8 @@ class ImageRecordIter(DataIter):
                     raws.append(r)
             if not raws:
                 break
-            futs = [self._pool.submit(self._process, r) for r in raws]
+            futs = [self._pool.submit(self._process, r, off)
+                    for r, off in raws]
             self._pending.append(futs)
 
     def next(self):
@@ -594,11 +658,15 @@ class ImageRecordIter(DataIter):
             self._nat_fut = self._pool.submit(self._native.next_batch)
             data, label, pad = self._pad_batch(*batch)
             return DataBatch([nd.array(data)], [nd.array(label)], pad=pad)
-        if not self._pending:
-            raise StopIteration
-        futs = self._pending.pop(0)
-        self._fill()
-        results = [f.result() for f in futs]
+        while True:
+            if not self._pending:
+                raise StopIteration
+            futs = self._pending.pop(0)
+            self._fill()
+            results = [r for r in (f.result() for f in futs)
+                       if r is not None]
+            if results:             # an all-quarantined batch is
+                break               # skipped, not emitted empty
         data, label, pad = self._pad_batch(
             _np.stack([r[0] for r in results]),
             _np.stack([r[1] for r in results]))

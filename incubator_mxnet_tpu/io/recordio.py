@@ -25,7 +25,8 @@ import numpy as _np
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IndexedRecordIO", "IRHeader",
            "pack", "unpack", "pack_img", "unpack_img", "read_record",
-           "list_record_offsets", "idx_sidecar_path"]
+           "list_record_offsets", "idx_sidecar_path",
+           "crc_sidecar_path", "write_crc_sidecar", "read_crc_sidecar"]
 
 _MAGIC = 0xced7230a
 _CFLAG_BITS = 29
@@ -102,6 +103,70 @@ def idx_sidecar_path(uri):
     match a dot in a parent directory)."""
     base, ext = os.path.splitext(uri)
     return (base if ext else uri) + ".idx"
+
+
+def crc_sidecar_path(uri):
+    """Path of the ``.crc`` integrity sidecar for a .rec file —
+    ``<uri>.crc`` verbatim (no extension swap: the sidecar names the
+    exact file it covers, and a ``train.rec`` / ``train.idx`` pair
+    must not collide with ``train.crc`` meaning either)."""
+    return str(uri) + ".crc"
+
+
+def write_crc_sidecar(uri, offsets=None):
+    """Write the per-record CRC sidecar for a .rec file: one
+    ``offset<TAB>crc`` line per record over the PAYLOAD bytes
+    (what `read_record` returns — framing headers and padding are
+    already covered by the magic check), headed by an ``#algo=`` line
+    naming the checksum in use (`integrity.checksum_algo`).  Readers
+    with the sidecar present verify each payload and QUARANTINE
+    mismatches instead of decoding garbage pixels.  Returns the
+    sidecar path."""
+    from ..integrity import checksum, checksum_algo
+    if offsets is None:
+        offsets = list_record_offsets(uri)
+    path = crc_sidecar_path(uri)
+    tmp = path + ".tmp"
+    with open(uri, "rb") as fh, open(tmp, "w") as out:
+        out.write("#algo=%s\n" % checksum_algo())
+        for off in offsets:
+            fh.seek(int(off))
+            payload = read_record(fh)
+            if payload is None:
+                raise IOError("EOF at offset %d while writing CRC "
+                              "sidecar for %s" % (off, uri))
+            out.write("%d\t%d\n" % (int(off), checksum(payload)))
+    os.replace(tmp, path)
+    return path
+
+
+def read_crc_sidecar(uri):
+    """Load a ``.crc`` sidecar: ``(algo, {offset: crc})``, or ``None``
+    when the file has none (verification simply stays off).  A
+    malformed sidecar raises IOError — half a safety net is worse
+    than none."""
+    path = crc_sidecar_path(uri)
+    if not os.path.isfile(path):
+        return None
+    algo = None
+    crcs = {}
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                if line.startswith("#"):
+                    if line.startswith("#algo="):
+                        algo = line[len("#algo="):]
+                    continue
+                off, crc = line.split("\t")
+                crcs[int(off)] = int(crc)
+    except (ValueError, OSError) as e:
+        raise IOError("malformed CRC sidecar %s: %s" % (path, e)) from e
+    if algo is None:
+        raise IOError("CRC sidecar %s missing the #algo= header" % path)
+    return algo, crcs
 
 
 class MXRecordIO:

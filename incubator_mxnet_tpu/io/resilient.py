@@ -5,7 +5,11 @@ NativeImageRecordReader, a DataIter, or anything with read-ish
 methods) and retries transient failures — IOError/OSError and injected
 `fault.TransientFault` — with exponential backoff.  Non-transient
 errors (corrupt framing raising ValueError, StopIteration) pass
-through untouched.
+through untouched — and so do PERMANENT IOErrors: corruption
+(`integrity.RecordCorrupt`) and the errno classes that cannot heal
+with time (ENOENT, EACCES, EISDIR...) fail FAST on the first attempt
+instead of burning the whole backoff budget re-reading bytes that
+will never change (`NON_RETRYABLE`).
 
     reader = RetryingReader(MXRecordIO(path, "r"))
     buf = reader.read()          # survives a flaky NFS mount
@@ -20,26 +24,40 @@ deterministic full-window sleep).
 from __future__ import annotations
 
 from .. import fault
+from ..integrity import RecordCorrupt
 from ..monitor import events
 
-__all__ = ["RetryingReader", "retry_io"]
+__all__ = ["RetryingReader", "retry_io", "NON_RETRYABLE"]
 
 #: method names proxied WITH retry; everything else proxies straight
 #: through (reset/seek mutate position — retrying those is the
 #: caller's decision, not a blanket policy)
 _RETRIED = ("read", "read_idx", "next_batch", "next", "__next__")
 
+#: permanent I/O failures: matching exceptions fail FAST even though
+#: they are (subclasses of) OSError.  Corruption re-read is the same
+#: corruption; a missing file does not appear because we slept; a
+#: permission error does not self-grant.  Retrying these turns one
+#: clear error into MXNET_RETRY_MAX slow copies of it — and a corrupt
+#: record retried forever is exactly how a poisoned file turns into a
+#: retry storm.
+NON_RETRYABLE = (RecordCorrupt, FileNotFoundError, PermissionError,
+                 IsADirectoryError, NotADirectoryError)
+
 
 def retry_io(fn, retries=None, backoff=None, what="io operation",
-             jitter=True):
+             jitter=True, non_retryable=NON_RETRYABLE):
     """Run `fn()` under the transient-I/O retry policy.  Injected
     faults fire INSIDE the reader (fault sites io.read / io.slow at the
     actual I/O boundary), so what is retried here is exactly what a
-    real storage blip would raise."""
+    real storage blip would raise.  `non_retryable` failures
+    (corruption, permanent errnos — see `NON_RETRYABLE`) pass through
+    on the FIRST attempt."""
     from ..parallel.resilience import retry_transient
     return retry_transient(fn, retries=retries, backoff=backoff,
                            what=what,
                            retryable=(fault.TransientFault, OSError),
+                           non_retryable=non_retryable,
                            event="io.retry", jitter=jitter)
 
 

@@ -57,6 +57,7 @@ import numpy as _np
 import jax
 
 from .. import fault
+from .. import integrity
 from ..monitor import events
 from ..telemetry import flightrec as _bb
 from .mesh import surviving_mesh
@@ -232,6 +233,18 @@ class ElasticTrainer:
     min_replicas / stale_steps / down_steps / ckpt_interval / keep /
     seed / handle_sigterm: see the MXNET_ELASTIC_* / MXNET_CKPT_*
         knobs and ResilientTrainer.
+    audit_interval: cross-replica SDC audit cadence
+        (MXNET_SDC_AUDIT_STEPS; 0 = off).  Every N steps the
+        supervisor hashes replicated state per replica — digests
+        round-trip through THIS trainer's kvstore, the heartbeat
+        channel — and a divergent replica is EVICTED through the
+        shrink path (black-box dump naming replica + leaf first); at
+        min_replicas it falls back to checkpoint rollback.  An
+        SDC-evicted replica is eligible for re-admission at the next
+        epoch boundary like any other down replica: the rebuild
+        restores one consistent checkpoint onto every member, so a
+        transient flip does not permanently cost a replica (persistent
+        flippers get re-evicted by the next audit round).
 
     Drive it with ``step(data_fn)`` where ``data_fn(step, n_replicas)
     -> (batch, labels)`` is a pure function — after a shrink the step
@@ -246,7 +259,8 @@ class ElasticTrainer:
                  ckpt_interval: Optional[int] = None,
                  keep: Optional[int] = None, kv=None,
                  stale_steps=None, down_steps=None,
-                 handle_sigterm: bool = True):
+                 handle_sigterm: bool = True,
+                 audit_interval: Optional[int] = None):
         from .. import config
         from ..kvstore import create as kv_create
         self._build = build_trainer
@@ -264,6 +278,9 @@ class ElasticTrainer:
         self.ckpt_dir = ckpt_dir
         self.ckpt_interval = ckpt_interval
         self.keep = keep
+        self.audit_interval = int(
+            audit_interval if audit_interval is not None
+            else config.get("MXNET_SDC_AUDIT_STEPS"))
         self._sigterm = handle_sigterm
         self.kv = kv if kv is not None else kv_create("local")
         self.health = ReplicaHealth(self.kv, self.n_total,
@@ -303,10 +320,13 @@ class ElasticTrainer:
         if self.trainer is not None:
             self.trainer.release()
         self.trainer = self._build(mesh, lr_factor)
+        # audit_interval=0: the SUPERVISOR owns the SDC audit (its
+        # response is eviction, not the wrapper's rollback)
         self.resilient = ResilientTrainer(
             self.trainer, ckpt_dir=self.ckpt_dir,
             ckpt_interval=self.ckpt_interval, keep=self.keep,
-            seed=self.seed, handle_sigterm=self._sigterm)
+            seed=self.seed, handle_sigterm=self._sigterm,
+            audit_interval=0)
         if resume:
             self.resilient.resume()
         if preempted:
@@ -322,7 +342,8 @@ class ElasticTrainer:
             jax.block_until_ready(leaves)
 
     # -- transitions ----------------------------------------------------
-    def _shrink(self, lost, stepno: int) -> None:
+    def _shrink(self, lost, stepno: int,
+                reason: str = "replica_down") -> None:
         survivors = [r for r in self.active if r not in lost]
         if len(survivors) < self.min_replicas:
             raise RuntimeError(
@@ -334,13 +355,14 @@ class ElasticTrainer:
         t0 = time.perf_counter()
         self._drain()
         # forensics BEFORE teardown: the dying replica's trail — the
-        # replica_down marker from poll(), this shrink marker, and the
-        # step/counter timeline — is still in the ring; the dump names
-        # the lost replica and its device
+        # replica_down marker from poll() (or the integrity.sdc marker
+        # from the audit), this shrink marker, and the step/counter
+        # timeline — is still in the ring; the dump names the lost
+        # replica, its device, and why it is being removed
         _bb.record_mesh(
             "shrink", step=int(stepno), lost=sorted(int(r) for r in lost),
             devices=[repr(self.devices[r]) for r in sorted(lost)],
-            survivors=len(survivors))
+            survivors=len(survivors), reason=reason)
         self.last_blackbox = _bb.crash_dump("mesh.shrink")
         # membership epoch: every credential of the old mesh dies here
         self.kv.advance_generation("mesh-shrink")
@@ -356,6 +378,7 @@ class ElasticTrainer:
         events.incr("mesh.steps_lost", max(0, steps_lost))
         self.transitions.append(
             {"kind": "shrink", "step": int(stepno),
+             "reason": reason,
              "lost": sorted(int(r) for r in lost),
              "replicas": self.n_replicas,
              "steps_lost": int(steps_lost),
@@ -449,9 +472,50 @@ class ElasticTrainer:
         if lost:
             self._shrink(lost, stepno)
             stepno = self.trainer._n_step
+        if self.audit_interval > 0 and stepno > 0 and \
+                stepno % self.audit_interval == 0 and \
+                self.n_replicas > 1:
+            # cross-replica SDC audit through the kvstore; a divergent
+            # replica is evicted via the shrink path (rollback when
+            # eviction would undershoot min_replicas)
+            self._audit(stepno, inject=first_visit)
+            stepno = self.trainer._n_step
         batch, labels = data_fn(stepno, self.n_replicas)
         loss, ok = self.resilient.step(batch, labels)
         return loss, ok
+
+    def _audit(self, stepno: int, inject: bool = True) -> None:
+        rid_of = {self.devices[r]: r for r in self.active}
+        report = integrity.audit_replicas(
+            self.trainer, step=stepno, rid_of=rid_of, kv=self.kv,
+            inject=inject)
+        if report.ok:
+            return
+        victims = [r for r in report.victims() if r in self.active]
+        log.error("cross-replica SDC at step %d: replica(s) %s "
+                  "diverge on %s", stepno, victims,
+                  report.leaves()[:4])
+        if not victims:
+            return
+        if len(self.active) - len(victims) >= self.min_replicas:
+            events.incr("mesh.sdc_evicted", len(victims))
+            # eviction: the divergent replica leaves through the same
+            # drain → dump → generation++ → rebuild path a dead one
+            # does; the restore re-places ONE consistent checkpoint on
+            # every survivor, so the divergence cannot outlive the
+            # transition
+            self._shrink(victims, stepno, reason="sdc")
+        else:
+            # at min_replicas eviction is not an option: dump, then
+            # roll every replica back to the last verifiable
+            # checkpoint (the ResilientTrainer SDC response)
+            _bb.crash_dump("sdc")
+            if not self.resilient.resume():
+                raise integrity.SDCDetected(victims, report.leaves(),
+                                            stepno)
+            events.incr("integrity.sdc_rollback")
+            log.warning("SDC response at min_replicas: rolled back to "
+                        "step %d", self.trainer._n_step)
 
     def run(self, data_fn: Callable, n_steps: int) -> dict:
         """Drive `step` until `n_steps` steps are COMPLETE (shrink

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import fault
+from .. import integrity
 from ..monitor import events
 from ..telemetry import costs as _costs
 from ..telemetry import flightrec as _bb
@@ -68,7 +69,8 @@ _TMP_PREFIX = ".tmp_"
 
 def retry_transient(fn, retries=None, backoff=None, what="operation",
                     retryable=(fault.TransientFault, OSError),
-                    event="resilience.retry", jitter=True):
+                    non_retryable=(), event="resilience.retry",
+                    jitter=True):
     """Call `fn()`, retrying `retries` times with JITTERED exponential
     backoff on transient failures: the window doubles per attempt and
     each sleep is drawn uniformly from [window/2, window], so a fleet
@@ -79,7 +81,14 @@ def retry_transient(fn, retries=None, backoff=None, what="operation",
     MXNET_RETRY_BACKOFF (seconds).  `jitter=False` sleeps the full
     window deterministically (tests).  Each retry increments `event`
     on monitor.events (callers pick their own counter so concurrent
-    retries in different subsystems don't pollute each other)."""
+    retries in different subsystems don't pollute each other).
+
+    `non_retryable` carves PERMANENT failures out of the retryable
+    families: an exception matching it is re-raised immediately even
+    when it also matches `retryable` — corruption
+    (`integrity.RecordCorrupt` is an IOError) and permanent errno
+    classes (ENOENT, EACCES) would otherwise burn the whole backoff
+    budget re-reading bytes that cannot change."""
     import random
     from .. import config
     if retries is None:
@@ -93,6 +102,8 @@ def retry_transient(fn, retries=None, backoff=None, what="operation",
         try:
             return fn()
         except retryable as e:
+            if non_retryable and isinstance(e, non_retryable):
+                raise               # permanent: fail fast, loudly
             attempt += 1
             if attempt > retries:
                 raise
@@ -127,6 +138,20 @@ class ResilientTrainer:
                     backoff on bad steps (default: scale 1.0)
     handle_sigterm: install a SIGTERM handler that converts preemption
                     into checkpoint-and-clean-exit (main thread only)
+    audit_interval: cross-replica SDC audit cadence in steps (default:
+                    MXNET_SDC_AUDIT_STEPS; 0 = off).  Every N steps
+                    the replicated params/opt state are hashed per
+                    replica and compared (`integrity.audit_replicas`);
+                    a divergent replica triggers a black-box dump and
+                    a rollback to the last verifiable checkpoint
+
+    Checkpoints carry an integrity manifest (per-file + per-leaf CRCs,
+    `integrity.write_manifest`) written INSIDE the temp dir, so the
+    atomic publish covers it.  With MXNET_CKPT_VERIFY (default on),
+    `resume()` verifies before restoring and walks keep-K back to the
+    newest VERIFIABLE checkpoint when the newest is corrupt — salvage,
+    not death — leaving a `ckpt.salvage` black-box dump with the
+    trail.
 
     Cost model: unlike ShardedTrainer.step (async dispatch, loss left
     on device), every guarded step materialises `loss`/`ok` on the
@@ -145,7 +170,8 @@ class ResilientTrainer:
                  rollback_after: Optional[int] = None,
                  seed: int = 0, ema_decay: float = 0.9,
                  loss_scaler: Optional[LossScaler] = None,
-                 handle_sigterm: bool = True):
+                 handle_sigterm: bool = True,
+                 audit_interval: Optional[int] = None):
         from .. import config
         self.trainer = trainer
         self.ckpt_dir = os.path.abspath(ckpt_dir) if ckpt_dir else None
@@ -159,6 +185,9 @@ class ResilientTrainer:
         self.rollback_after = int(
             rollback_after if rollback_after is not None
             else config.get("MXNET_BAD_STEP_ROLLBACK"))
+        self.audit_interval = int(
+            audit_interval if audit_interval is not None
+            else config.get("MXNET_SDC_AUDIT_STEPS"))
         self.seed = int(seed)
         self.ema_decay = float(ema_decay)
         self.loss_ema = None               # running mean of good losses
@@ -364,6 +393,13 @@ class ResilientTrainer:
                     self.bad_steps >= self.rollback_after:
                 self.rollback()
 
+        if self.audit_interval > 0 and \
+                t._n_step % self.audit_interval == 0 and \
+                getattr(t, "data_parallel_size", 1) > 1:
+            # cross-replica SDC audit: replicated state must be
+            # bit-identical across the mesh; divergence rolls back
+            self.audit(t._n_step)
+
         if self._preempted:
             self._handle_preemption()
         elif self.ckpt_dir and self.ckpt_interval > 0 and \
@@ -372,6 +408,42 @@ class ResilientTrainer:
             # rollback saves still work off the initial one)
             self.checkpoint()
         return loss, ok
+
+    # -- cross-replica SDC audit ---------------------------------------
+    def audit(self, step: Optional[int] = None, inject: bool = True):
+        """One cross-replica integrity audit round
+        (`integrity.audit_replicas`): hash every replicated
+        param/opt-state shard per replica and compare.  Divergence is
+        an SDC detection — black-box dump naming replica + leaf, then
+        rollback to the newest verifiable checkpoint (which re-places
+        one consistent copy on every replica).  With no checkpoint to
+        roll back to, raises `integrity.SDCDetected`.  Returns the
+        `AuditReport`."""
+        step = int(step if step is not None else self.trainer._n_step)
+        report = integrity.audit_replicas(self.trainer, step=step,
+                                          inject=inject)
+        if report.ok:
+            return report
+        log.error("cross-replica SDC at step %d: replica(s) %s "
+                  "diverge on %s", step, report.victims(),
+                  report.leaves()[:4])
+        # dump BEFORE the response: the ring still holds the audit
+        # trail that condemned the replica
+        _bb.crash_dump("sdc")
+        if self.ckpt_dir and self._have_ckpt:
+            scale = self.scaler.loss_scale
+            if self.resume():
+                self.scaler.loss_scale = scale
+                self.bad_steps = 0
+                events.incr("integrity.sdc_rollback")
+                _bb.record("integrity", "sdc_rollback", step=step,
+                           restored=int(self.trainer._n_step))
+                log.warning("SDC response: rolled back to step %d "
+                            "(consistent state re-placed on every "
+                            "replica)", self.trainer._n_step)
+                return report
+        raise integrity.SDCDetected(report.victims(), report.leaves(),
+                                    step)
 
     # -- checkpointing -------------------------------------------------
     def _ckpt_name(self, step):
@@ -407,10 +479,27 @@ class ResilientTrainer:
             # step are deterministic within a run, so rewriting would
             # only re-serialize identical data — and deleting the
             # published dir to make room would break the no-window
-            # atomicity guarantee.  Point LATEST at it and move on.
-            self._publish_latest(self._ckpt_name(step))
-            self._have_ckpt = True
-            return final
+            # atomicity guarantee.  Point LATEST at it and move on —
+            # UNLESS the existing directory fails its integrity
+            # manifest (a post-shrink replay can revisit the step a
+            # bitflip landed on): re-pointing LATEST at known-corrupt
+            # bytes would undo the salvage, so the corpse is removed
+            # and this step's state is re-serialized fresh.
+            from .. import config
+            bad = None
+            if config.get("MXNET_CKPT_VERIFY"):
+                try:
+                    integrity.verify_checkpoint(final,
+                                                name_leaves=False)
+                except integrity.CheckpointCorrupt as e:
+                    bad = e
+            if bad is None:
+                self._publish_latest(self._ckpt_name(step))
+                self._have_ckpt = True
+                return final
+            log.warning("existing checkpoint %s is corrupt (%s); "
+                        "rewriting it", final, bad)
+            shutil.rmtree(final, ignore_errors=True)
         tmp = os.path.join(self.ckpt_dir,
                            _TMP_PREFIX + self._ckpt_name(step))
 
@@ -431,12 +520,24 @@ class ResilientTrainer:
                     "mesh_devices": len(list(t.mesh.devices.flat))}
             with open(os.path.join(tmp, _META), "w") as f:
                 json.dump(meta, f)
+            # integrity manifest LAST, inside the temp dir: per-file
+            # CRCs over everything just serialized (meta included) +
+            # per-leaf CRCs over the in-memory values — covered by the
+            # same atomic rename as the data it guards
+            integrity.write_manifest(
+                tmp, leaves=integrity.named_leaves(t.params,
+                                                   t.opt_state))
             os.replace(tmp, final)
 
         t_ck = time.perf_counter()
         with _tele.span("train.checkpoint"):
             retry_transient(write, what="checkpoint step %d" % step)
             self._publish_latest(self._ckpt_name(step))
+        if fault.should_fire("ckpt.bitflip", step):
+            # injected silent storage corruption: one bit of the
+            # largest data blob in the PUBLISHED checkpoint flips —
+            # invisible now, caught by the manifest at restore time
+            self._inject_ckpt_bitflip(final, step)
         self._have_ckpt = True
         events.incr("resilience.checkpoint_written")
         _bb.record("ckpt", "written", step=step,
@@ -452,6 +553,29 @@ class ResilientTrainer:
             self._tele.record_checkpoint(time.perf_counter() - t_ck)
         self._gc()
         return final
+
+    def _inject_ckpt_bitflip(self, final, step):
+        """ckpt.bitflip fault site body: flip one bit of the largest
+        data blob (the orbax ``d/`` payload dir when present, so the
+        damage lands on leaf BYTES and the verify failure can name the
+        leaf) of the published checkpoint."""
+        cands = []
+        for root, _dirs, files in os.walk(final):
+            for f in files:
+                if f == integrity.MANIFEST:
+                    continue
+                fp = os.path.join(root, f)
+                in_data = os.path.basename(root) == "d"
+                cands.append((in_data, os.path.getsize(fp), fp))
+        if not cands:
+            return
+        _in_data, _size, target = max(cands)
+        pos = fault.flip_file_bit(target)
+        log.warning("fault: flipped bit at byte %d of %s (checkpoint "
+                    "step %d) — silent until verified", pos, target,
+                    step)
+        _bb.record("fault", "ckpt.bitflip", step=int(step),
+                   file=os.path.relpath(target, final))
 
     def _publish_latest(self, name):
         latest_tmp = os.path.join(self.ckpt_dir, _LATEST + ".tmp")
@@ -515,7 +639,14 @@ class ResilientTrainer:
 
     # -- restore -------------------------------------------------------
     def _restore_from(self, name) -> bool:
+        from .. import config
         path = os.path.join(self.ckpt_dir, name)
+        if config.get("MXNET_CKPT_VERIFY"):
+            # verify BEFORE restoring: a corrupt checkpoint raises a
+            # typed CheckpointCorrupt naming the bad file/leaf instead
+            # of loading flipped bits into device memory (or dying in
+            # the deserializer); resume() then walks keep-K
+            integrity.verify_checkpoint(path)
         meta_path = os.path.join(path, _META)
         with open(meta_path) as f:
             meta = json.load(f)
@@ -545,10 +676,17 @@ class ResilientTrainer:
         return True
 
     def resume(self) -> bool:
-        """Restore the newest valid checkpoint, falling back through
-        older keep-K checkpoints when the newest is corrupt/partial.
+        """Restore the newest VERIFIABLE checkpoint, falling back
+        through older keep-K checkpoints when the newest is corrupt or
+        partial (manifest verification under MXNET_CKPT_VERIFY raises
+        typed `integrity.CheckpointCorrupt` naming the bad leaf; other
+        damage surfaces as OSError/ValueError).  A LATEST pointer
+        naming a missing/deleted directory is counted and skipped —
+        the keep-K walk is the same one the salvage path uses.
         Returns True when a checkpoint was restored (and clears any
-        PREEMPTED marker), False for a fresh start."""
+        PREEMPTED marker), False for a fresh start.  When corruption
+        forced a fallback, the restore leaves a ``ckpt.salvage``
+        black-box dump carrying the whole trail."""
         if not self.ckpt_dir:
             return False
         candidates = [name for _, name in reversed(self._list_checkpoints())]
@@ -559,10 +697,32 @@ class ResilientTrainer:
             if latest in candidates:
                 candidates.remove(latest)
                 candidates.insert(0, latest)
+            elif latest:
+                # LATEST names a checkpoint that no longer exists
+                # (deleted by an aggressive GC, a partial sync, an
+                # operator): not fatal — fall back through keep-K
+                events.incr("resilience.latest_dangling")
+                _bb.record("integrity", "latest_dangling",
+                           latest=latest)
+                log.warning("LATEST names %s which does not exist in "
+                            "%s; falling back through keep-K", latest,
+                            self.ckpt_dir)
+        salvage_trail = []          # [(name, why)] skipped candidates
+        corrupt_seen = False
         for name in candidates:
             try:
                 self._restore_from(name)
+            except integrity.CheckpointCorrupt as e:
+                corrupt_seen = True
+                salvage_trail.append((name, "corrupt: %s" %
+                                      (e.leaves or sorted(e.files))))
+                events.incr("resilience.restore_fallback")
+                log.error("checkpoint %s failed integrity "
+                          "verification (%s); falling back to the "
+                          "previous one", name, e)
+                continue
             except (OSError, ValueError, KeyError) as e:
+                salvage_trail.append((name, str(e)[:120]))
                 events.incr("resilience.restore_fallback")
                 log.warning("checkpoint %s unusable (%s); falling back "
                             "to the previous one", name, e)
@@ -572,7 +732,26 @@ class ResilientTrainer:
                 os.remove(marker)
             self._have_ckpt = True
             events.incr("resilience.restored")
+            if corrupt_seen:
+                # salvage: a corrupt checkpoint was walked past and an
+                # older verifiable one restored — forensic dump while
+                # the ckpt_corrupt trail is still in the ring
+                events.incr("integrity.ckpt_salvaged")
+                _bb.record("integrity", "ckpt_salvaged",
+                           restored=name,
+                           step=int(self.trainer._n_step),
+                           skipped=[n for n, _ in salvage_trail])
+                _bb.crash_dump("ckpt.salvage")
+                log.warning(
+                    "salvaged: restored %s at step %d after skipping "
+                    "%s", name, self.trainer._n_step,
+                    ["%s (%s)" % t for t in salvage_trail])
             log.info("resumed from %s at step %d", name,
                      self.trainer._n_step)
             return True
+        if corrupt_seen:
+            # every keep-K candidate was corrupt: nothing salvageable —
+            # dump the evidence before the caller decides what a fresh
+            # start means
+            _bb.crash_dump("ckpt.salvage_failed")
         return False

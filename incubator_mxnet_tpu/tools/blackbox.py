@@ -15,6 +15,18 @@ Sections: header (reason / pid / exception), the timeline tail, the
 nonzero counters, the cost table (per-executable FLOPs / bytes /
 invocations / compile wall), HBM peaks, and ONE suspected-cause line —
 a heuristic ranking of what the evidence points at.
+
+`verify` (ISSUE 9) checks a checkpoint directory against its integrity
+manifest without loading it into a trainer:
+
+    python -m incubator_mxnet_tpu.tools.blackbox verify /ckpt/run42
+    python -m ... verify /ckpt/run42/step_00000200
+
+Pointed at a single checkpoint it verifies that one; pointed at a
+keep-K directory it verifies every published ``step_*`` child.  Exit
+code 0 = everything verifiable; != 0 with a per-file / per-leaf report
+on any mismatch (the same `integrity.verify_checkpoint` the trainer's
+verify-on-load runs).
 """
 from __future__ import annotations
 
@@ -25,7 +37,8 @@ import time
 
 from .teletop import _fmt_qty
 
-__all__ = ["load_dump", "render", "suspected_cause", "main"]
+__all__ = ["load_dump", "render", "suspected_cause", "verify_main",
+           "main"]
 
 
 def load_dump(path: str) -> dict:
@@ -41,12 +54,42 @@ def suspected_cause(doc: dict) -> str:
     """One line: what the evidence points at, strongest signal first.
     A heuristic, not a verdict — the timeline is the ground truth."""
     c = doc.get("counters", {})
-    kinds = [e.get("kind") for e in doc.get("events", [])]
+    evs = doc.get("events", [])
+    kinds = [e.get("kind") for e in evs]
     exc = doc.get("exception")
     reason = doc.get("reason", "")
     if exc:
         return ("uncaught %s: %s" % (exc.get("type"),
                                      (exc.get("message") or "")[:120]))
+    # integrity family first: silent corruption outranks everything a
+    # run can do to itself — the bytes were wrong
+    sdc = [e for e in evs
+           if e.get("kind") == "integrity" and e.get("name") == "sdc"]
+    if sdc or reason == "sdc" or c.get("integrity.sdc"):
+        last = sdc[-1] if sdc else {}
+        return ("silent data corruption: replica(s) %s diverged from "
+                "the mesh on %s — evicted/rolled back"
+                % (last.get("replicas", "?"),
+                   last.get("leaves") or "replicated state"))
+    salv = [e for e in evs if e.get("kind") == "integrity"
+            and e.get("name") in ("ckpt_corrupt", "ckpt_salvaged")]
+    if salv or reason in ("ckpt.salvage", "ckpt.salvage_failed") \
+            or c.get("integrity.ckpt_corrupt"):
+        failed = reason == "ckpt.salvage_failed" or (
+            c.get("integrity.ckpt_corrupt", 0) and
+            not c.get("integrity.ckpt_salvaged", 0) and
+            not c.get("resilience.restored", 0))
+        bad = [e for e in salv if e.get("name") == "ckpt_corrupt"]
+        what = (bad[-1].get("leaves") or bad[-1].get("files", "?")) \
+            if bad else "?"
+        if failed:
+            return ("checkpoint corruption: every keep-K candidate "
+                    "failed verification (bad leaf/file: %s) — "
+                    "nothing salvageable" % (what,))
+        return ("checkpoint corruption SALVAGED: %d checkpoint(s) "
+                "failed verification (bad leaf/file: %s), an older "
+                "verifiable one was restored"
+                % (c.get("integrity.ckpt_corrupt", 0), what))
     if "preempt" in kinds or reason == "preemption":
         extra = " after earlier rollback(s)" if "rollback" in kinds \
             else ""
@@ -64,6 +107,11 @@ def suspected_cause(doc: dict) -> str:
         return ("%d training step(s) skipped on non-finite/spiking "
                 "loss (below the rollback threshold)"
                 % c["resilience.step_skipped"])
+    if c.get("io.decode.records_corrupt"):
+        return ("corrupt input records: %d quarantined (skipped, "
+                "ledgered in the io-quarantine JSONL) — see "
+                "integrity/record_corrupt events for file/offset"
+                % c["io.decode.records_corrupt"])
     stall, step = c.get("feed.stall_us", 0), c.get("feed.step_us", 0)
     if stall and step and stall > step:
         return ("input-pipeline starvation: feed stalls (%.1fs) exceed "
@@ -144,10 +192,67 @@ def render(doc: dict, events_tail=40) -> str:
     return "\n".join(lines)
 
 
+def verify_main(argv) -> int:
+    """``blackbox verify <dir>`` body: verify one checkpoint (a dir
+    holding an integrity manifest) or every ``step_*`` child of a
+    keep-K directory.  rc 0 = all verifiable; 1 = mismatch (per-file +
+    per-leaf report), 2 = usage/unreadable."""
+    ap = argparse.ArgumentParser(
+        prog="blackbox verify",
+        description="verify checkpoint(s) against their integrity "
+                    "manifests (per-file + per-leaf CRCs)")
+    ap.add_argument("ckpt", help="checkpoint dir, or a keep-K dir of "
+                                 "step_* checkpoints")
+    args = ap.parse_args(argv)
+    from .. import integrity
+    import os
+    root = os.path.abspath(args.ckpt)
+    if not os.path.isdir(root):
+        print("blackbox verify: %s is not a directory" % root,
+              file=sys.stderr)
+        return 2
+    if os.path.exists(os.path.join(root, integrity.MANIFEST)):
+        targets = [root]
+    else:
+        targets = sorted(
+            os.path.join(root, n) for n in os.listdir(root)
+            if n.startswith("step_") and
+            os.path.isdir(os.path.join(root, n)))
+        if not targets:
+            print("blackbox verify: no manifest and no step_* "
+                  "checkpoints under %s" % root, file=sys.stderr)
+            return 2
+    rc = 0
+    for t in targets:
+        try:
+            rep = integrity.verify_checkpoint(t)
+        except integrity.CheckpointCorrupt as e:
+            rc = 1
+            print("CORRUPT  %s" % t)
+            for rel, why in sorted(e.files.items()):
+                print("         file %-44s %s" % (rel, why))
+            for leaf in e.leaves:
+                print("         leaf %s" % leaf)
+            if e.kind == "manifest":
+                print("         %s" % e)
+            continue
+        if rep.get("verified"):
+            print("OK       %s  (%d files, %d leaves, %s)"
+                  % (t, rep["files"], rep.get("leaves", 0),
+                     rep["algo"]))
+        else:
+            print("UNVERIFIED %s  (%s)" % (t, rep.get("reason")))
+    return rc
+
+
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "verify":
+        return verify_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="blackbox",
-        description="summarize a flight-recorder black-box dump")
+        description="summarize a flight-recorder black-box dump "
+                    "(or: blackbox verify <ckpt_dir>)")
     ap.add_argument("dump", help="black-box dump JSON path")
     ap.add_argument("--events", type=int, default=40, metavar="N",
                     help="timeline tail length (default 40)")

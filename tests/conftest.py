@@ -84,6 +84,13 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "elastic: elastic-mesh replica loss/re-admission "
         "tests (CPU-fast, run in tier-1 by default)")
+    # the integrity suite (checkpoint manifests + salvage, corrupt-
+    # record quarantine, cross-replica SDC audit) is CPU-fast and
+    # runs in tier-1 by default; the marker lets it be selected or
+    # excluded explicitly (pytest -m integrity)
+    config.addinivalue_line(
+        "markers", "integrity: corruption-detection/recovery tests "
+        "(CPU-fast, run in tier-1 by default)")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,537 @@
+"""End-to-end integrity (ISSUE 9): checkpoint manifests + salvage,
+corrupt-record quarantine, cross-replica SDC audit — every detection
+and recovery path driven on CPU through the deterministic corruption
+injectors (fault.py: ckpt.bitflip / io.corrupt /
+mesh.replica_divergence) or direct byte surgery on the artifacts."""
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import config, fault, gluon, integrity, nd, \
+    parallel
+from incubator_mxnet_tpu.io import recordio
+from incubator_mxnet_tpu.io.decode_service import (DecodeService,
+                                                   service_available)
+from incubator_mxnet_tpu.monitor import events
+
+import jax
+
+pytestmark = pytest.mark.integrity
+
+needs_service = pytest.mark.skipif(
+    not service_available(),
+    reason="shared memory / process spawn unavailable")
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _build_trainer(seed=7, mesh=None):
+    mx.random.seed(seed)
+    net = gluon.nn.HybridSequential(prefix="ig_")
+    net.add(gluon.nn.Dense(16, in_units=8, activation="relu",
+                           prefix="ig_d1_"),
+            gluon.nn.Dense(4, in_units=16, prefix="ig_d2_"))
+    net.initialize(force_reinit=True)
+    net(nd.ones((2, 8)))
+    return parallel.ShardedTrainer(net, optimizer="adam", lr=1e-2,
+                                   mesh=mesh)
+
+
+def _dp_mesh():
+    from incubator_mxnet_tpu.parallel.mesh import make_mesh
+    return make_mesh((len(jax.devices()),))
+
+
+def _run_steps(rt, n, seed=0, batch=8):
+    rs = np.random.RandomState(seed)
+    for _ in range(n):
+        rt.step(rs.randn(batch, 8).astype(np.float32),
+                rs.randint(0, 4, batch))
+
+
+def _data_blobs(ckpt_dir):
+    """Orbax OCDBT data files (leaf bytes live here), largest last."""
+    out = []
+    for root, _dirs, files in os.walk(ckpt_dir):
+        if os.path.basename(root) != "d":
+            continue
+        for f in files:
+            fp = os.path.join(root, f)
+            out.append((os.path.getsize(fp), fp))
+    return [fp for _, fp in sorted(out)]
+
+
+def _newest_ckpt(ckpt_dir):
+    steps = sorted(n for n in os.listdir(ckpt_dir)
+                   if n.startswith("step_"))
+    return os.path.join(ckpt_dir, steps[-1]), steps
+
+
+def _write_rec(path, n=24, shape=(16, 16)):
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(n):
+        img = ((np.arange(shape[0] * shape[1] * 3, dtype=np.int64)
+                * 7 + i * 13) % 251).astype(np.uint8).reshape(
+                    shape[0], shape[1], 3)
+        w.write(recordio.pack_img((0, float(i), i, 0), img,
+                                  img_fmt=".jpg"))
+    w.close()
+    return recordio.list_record_offsets(path)
+
+
+def _collect(rec, batch=8, **kw):
+    it = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 16, 16),
+                               batch_size=batch, dtype="uint8", **kw)
+    out = {}
+    for b in it:
+        k = b.data[0].shape[0] - b.pad
+        lab = b.label[0].asnumpy()
+        arr = b.data[0].asnumpy()
+        for j in range(k):
+            out[int(lab[j])] = arr[j].copy()
+    it.close()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# checkpoint manifest + verification matrix
+# ---------------------------------------------------------------------------
+
+def test_manifest_written_and_verifies(tmp_path):
+    rt = parallel.ResilientTrainer(_build_trainer(),
+                                   ckpt_dir=str(tmp_path / "ck"),
+                                   ckpt_interval=2, seed=3,
+                                   handle_sigterm=False)
+    _run_steps(rt, 2)
+    newest, steps = _newest_ckpt(rt.ckpt_dir)
+    assert os.path.exists(os.path.join(newest, integrity.MANIFEST))
+    rep = integrity.verify_checkpoint(newest)
+    assert rep["verified"] and rep["files"] > 0 and rep["leaves"] > 0
+    # per-leaf section names params and opt_state entries
+    with open(os.path.join(newest, integrity.MANIFEST)) as f:
+        doc = json.load(f)
+    assert any(k.startswith("params/ig_d1_") for k in doc["leaves"])
+    assert any(k.startswith("opt_state/") for k in doc["leaves"])
+
+
+def test_bitflip_detected_and_salvaged(tmp_path):
+    """Flip one bit of a leaf blob in the NEWEST checkpoint: verify
+    raises a typed error naming the file, and resume() walks keep-K
+    back to the previous verifiable checkpoint (counted + dumped)."""
+    ck = str(tmp_path / "ck")
+    rt = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                   ckpt_interval=2, seed=3,
+                                   handle_sigterm=False)
+    _run_steps(rt, 4)                       # ckpts at 0, 2, 4
+    newest, steps = _newest_ckpt(ck)
+    assert len(steps) >= 2
+    fault.flip_file_bit(_data_blobs(newest)[-1])
+    with pytest.raises(integrity.CheckpointCorrupt) as ei:
+        integrity.verify_checkpoint(newest)
+    assert ei.value.files                   # names the bad file
+    c_corrupt = events.get("integrity.ckpt_corrupt")
+    c_salv = events.get("integrity.ckpt_salvaged")
+    rt2 = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                    seed=3, handle_sigterm=False)
+    assert rt2.resume()
+    # salvaged: an OLDER checkpoint restored, corruption counted
+    assert rt2.trainer._n_step < int(steps[-1][len("step_"):])
+    assert events.get("integrity.ckpt_corrupt") > c_corrupt
+    assert events.get("integrity.ckpt_salvaged") == c_salv + 1
+
+
+def test_truncated_leaf_file_falls_back(tmp_path):
+    ck = str(tmp_path / "ck")
+    rt = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                   ckpt_interval=2, seed=3,
+                                   handle_sigterm=False)
+    _run_steps(rt, 4)
+    newest, _ = _newest_ckpt(ck)
+    blob = _data_blobs(newest)[-1]
+    with open(blob, "r+b") as fh:
+        fh.truncate(os.path.getsize(blob) // 2)
+    with pytest.raises(integrity.CheckpointCorrupt) as ei:
+        integrity.verify_checkpoint(newest)
+    assert any("size" in why for why in ei.value.files.values())
+    rt2 = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                    seed=3, handle_sigterm=False)
+    assert rt2.resume()
+    assert rt2.trainer._n_step == 2
+
+
+def test_corrupt_manifest_itself(tmp_path):
+    ck = str(tmp_path / "ck")
+    rt = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                   ckpt_interval=2, seed=3,
+                                   handle_sigterm=False)
+    _run_steps(rt, 4)
+    newest, _ = _newest_ckpt(ck)
+    with open(os.path.join(newest, integrity.MANIFEST), "w") as f:
+        f.write("{ not json")
+    with pytest.raises(integrity.CheckpointCorrupt) as ei:
+        integrity.verify_checkpoint(newest)
+    assert ei.value.kind == "manifest"
+    rt2 = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                    seed=3, handle_sigterm=False)
+    assert rt2.resume()                     # salvage walk handles it
+    assert rt2.trainer._n_step == 2
+
+
+def test_missing_manifest_tolerated(tmp_path):
+    """Pre-integrity checkpoints (no manifest) restore with a counter,
+    not a rejection."""
+    ck = str(tmp_path / "ck")
+    rt = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                   ckpt_interval=2, seed=3,
+                                   handle_sigterm=False)
+    _run_steps(rt, 2)
+    newest, _ = _newest_ckpt(ck)
+    os.remove(os.path.join(newest, integrity.MANIFEST))
+    c0 = events.get("integrity.ckpt_unverified")
+    rep = integrity.verify_checkpoint(newest)
+    assert rep["verified"] is False
+    assert events.get("integrity.ckpt_unverified") == c0 + 1
+    rt2 = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                    seed=3, handle_sigterm=False)
+    assert rt2.resume()
+
+
+def test_salvage_under_preemption(tmp_path):
+    """Corrupt-then-salvage under SIGTERM-style preemption: the
+    checkpoint written BY the preemption handler gets bitflipped
+    (ckpt.bitflip injector); the relaunched trainer walks back to the
+    previous good one and still clears the PREEMPTED marker."""
+    ck = str(tmp_path / "ck")
+    rt = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                   ckpt_interval=3, seed=3,
+                                   handle_sigterm=False)
+    _run_steps(rt, 4)                       # ckpts at 0, 3
+    fault.install("ckpt.bitflip", steps=[5], times=1)
+    rt.request_preemption()
+    with pytest.raises(fault.Preempted):
+        _run_steps(rt, 1, seed=99)          # preemption ckpt at 5
+    fault.clear()
+    assert parallel.ResilientTrainer.was_preempted(ck)
+    rt2 = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                    seed=3, handle_sigterm=False)
+    assert rt2.resume()
+    assert rt2.trainer._n_step == 3         # salvaged past corrupt 5
+    assert not parallel.ResilientTrainer.was_preempted(ck)
+
+
+def test_latest_dangling_falls_back(tmp_path):
+    """Regression (ISSUE 9 satellite): LATEST naming a deleted
+    checkpoint dir falls back through keep-K instead of dying."""
+    ck = str(tmp_path / "ck")
+    rt = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                   ckpt_interval=2, seed=3,
+                                   handle_sigterm=False)
+    _run_steps(rt, 4)
+    newest, steps = _newest_ckpt(ck)
+    with open(os.path.join(ck, "LATEST")) as f:
+        assert f.read().strip() == steps[-1]
+    shutil.rmtree(newest)                   # LATEST now dangles
+    c0 = events.get("resilience.latest_dangling")
+    rt2 = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                    seed=3, handle_sigterm=False)
+    assert rt2.resume()
+    assert events.get("resilience.latest_dangling") == c0 + 1
+    assert rt2.trainer._n_step == 2
+
+
+# ---------------------------------------------------------------------------
+# retry classification (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+def test_retry_fast_fail_on_corruption():
+    from incubator_mxnet_tpu.io.resilient import RetryingReader, \
+        retry_io
+
+    class Reader:
+        def __init__(self, exc):
+            self.exc = exc
+            self.calls = 0
+
+        def read(self):
+            self.calls += 1
+            raise self.exc
+
+    # corruption and permanent errnos: ONE attempt, no retry counter
+    for exc in (integrity.RecordCorrupt("f.rec", 10, "crc"),
+                FileNotFoundError("gone"),
+                PermissionError("denied")):
+        r = Reader(exc)
+        c0 = events.get("io.retry")
+        with pytest.raises(type(exc)):
+            RetryingReader(r, backoff=0.001, jitter=False).read()
+        assert r.calls == 1
+        assert events.get("io.retry") == c0
+    # transient failures keep the full retry budget
+    r = Reader(fault.TransientFault("blip"))
+    with pytest.raises(fault.TransientFault):
+        retry_io(r.read, retries=2, backoff=0.001, jitter=False)
+    assert r.calls == 3
+
+
+# ---------------------------------------------------------------------------
+# record CRC sidecar + quarantine
+# ---------------------------------------------------------------------------
+
+def test_crc_sidecar_roundtrip(tmp_path):
+    rec = str(tmp_path / "data.rec")
+    offsets = _write_rec(rec, n=10)
+    side = recordio.write_crc_sidecar(rec)
+    assert side == recordio.crc_sidecar_path(rec)
+    algo, crcs = recordio.read_crc_sidecar(rec)
+    assert algo == integrity.checksum_algo()
+    assert sorted(crcs) == [int(o) for o in offsets]
+    # values verify against a fresh read
+    fn = integrity.checksum_fn(algo)
+    with open(rec, "rb") as fh:
+        fh.seek(offsets[3])
+        assert fn(recordio.read_record(fh)) == crcs[int(offsets[3])]
+    assert recordio.read_crc_sidecar(str(tmp_path / "none.rec")) is None
+
+
+def test_threaded_quarantine_counts_and_ledger(tmp_path):
+    """A payload bitflip on disk: the CRC sidecar catches it, the
+    record is quarantined (skipped, counted, ledgered with
+    file/offset) and every clean record's pixels are untouched."""
+    rec = str(tmp_path / "data.rec")
+    offsets = _write_rec(rec)
+    recordio.write_crc_sidecar(rec)
+    base = _collect(rec)
+    with open(rec, "r+b") as fh:            # flip a payload byte of
+        fh.seek(offsets[3] + 8 + 40)        # record 3 (label 3)
+        b0 = fh.read(1)
+        fh.seek(offsets[3] + 8 + 40)
+        fh.write(bytes([b0[0] ^ 0x10]))
+    c0 = events.get("io.decode.records_corrupt")
+    got = _collect(rec)
+    assert events.get("io.decode.records_corrupt") == c0 + 1
+    assert sorted(set(base) - set(got)) == [3]
+    assert all(np.array_equal(base[k], got[k]) for k in got)
+    ledger = integrity.quarantine_path()
+    entries = [json.loads(ln) for ln in open(ledger)]
+    assert any(e["file"] == rec and e["offset"] == int(offsets[3])
+               for e in entries)
+
+
+def test_threaded_budget_exceeded_is_loud(tmp_path):
+    rec = str(tmp_path / "data.rec")
+    offsets = _write_rec(rec)
+    recordio.write_crc_sidecar(rec)
+    with open(rec, "r+b") as fh:
+        fh.seek(offsets[5] + 8 + 40)
+        b0 = fh.read(1)
+        fh.seek(offsets[5] + 8 + 40)
+        fh.write(bytes([b0[0] ^ 0x20]))
+    config.set("MXNET_IO_CORRUPT_BUDGET", "0")
+    try:
+        with pytest.raises(integrity.CorruptRecordBudgetExceeded):
+            _collect(rec)
+    finally:
+        config.unset("MXNET_IO_CORRUPT_BUDGET")
+
+
+@needs_service
+def test_service_quarantine_clean_stream_bit_identical(tmp_path):
+    """io.corrupt injector in a decode worker: exactly the poisoned
+    records are quarantined and the surviving stream — full augment
+    on — is bit-identical to an uninjected run (per-record RNG: a
+    quarantined neighbour consumes no draws)."""
+    rec = str(tmp_path / "data.rec")
+    _write_rec(rec)
+    recordio.write_crc_sidecar(rec)
+
+    def stream(inject):
+        if inject:
+            fault.install("io.corrupt", at_calls=[5], times=1)
+        svc = DecodeService(rec, 4, (3, 16, 16), workers=1,
+                            shuffle=True, seed=5, rand_crop=True,
+                            rand_mirror=True, dtype="uint8")
+        try:
+            out = {}
+            for sb in svc:
+                for j in range(sb.count):
+                    out[int(sb.label[j, 0])] = sb.data[j].copy()
+            return out
+        finally:
+            svc.close()
+            if inject:
+                fault.clear("io.corrupt")
+
+    base = stream(False)
+    c0 = events.get("io.decode.records_corrupt")
+    got = stream(True)
+    assert events.get("io.decode.records_corrupt") == c0 + 1
+    assert len(got) == len(base) - 1
+    assert all(np.array_equal(base[k], got[k]) for k in got)
+
+
+@needs_service
+def test_service_budget_exceeded_typed(tmp_path):
+    rec = str(tmp_path / "data.rec")
+    _write_rec(rec)
+    recordio.write_crc_sidecar(rec)
+    config.set("MXNET_IO_CORRUPT_BUDGET", "0")
+    fault.install("io.corrupt", at_calls=[3], times=1)
+    svc = DecodeService(rec, 4, (3, 16, 16), workers=1, dtype="uint8")
+    try:
+        with pytest.raises(integrity.CorruptRecordBudgetExceeded):
+            for _ in svc:
+                pass
+    finally:
+        svc.close()
+        fault.clear()
+        config.unset("MXNET_IO_CORRUPT_BUDGET")
+
+
+# ---------------------------------------------------------------------------
+# cross-replica SDC audit
+# ---------------------------------------------------------------------------
+
+def test_audit_clean_then_divergence_rolls_back(tmp_path):
+    """Audit on the 8-way mesh: clean state passes (digests through a
+    kvstore round-trip included); an injected divergence names the
+    victim replica + leaf and the response is checkpoint rollback."""
+    from incubator_mxnet_tpu.kvstore import create as kv_create
+    rt = parallel.ResilientTrainer(
+        _build_trainer(mesh=_dp_mesh()), ckpt_dir=str(tmp_path / "ck"),
+        seed=3, handle_sigterm=False, audit_interval=0)
+    assert rt.trainer.data_parallel_size == len(jax.devices())
+    _run_steps(rt, 2)
+    rep = integrity.audit_replicas(rt.trainer, kv=kv_create("local"),
+                                   step=2, inject=False)
+    assert rep.ok and rep.groups > 0
+    assert sorted(rep.digests) == list(range(len(jax.devices())))
+    c0 = events.get("integrity.sdc")
+    fault.install("mesh.replica_divergence", steps=[97], times=1)
+    rep2 = rt.audit(step=97)
+    fault.clear()
+    assert not rep2.ok
+    assert rep2.victims() == [len(jax.devices()) - 1]
+    assert rep2.leaves()                    # the bad leaf is named
+    assert events.get("integrity.sdc") == c0 + 1
+    # response: rolled back to the initial checkpoint
+    assert rt.trainer._n_step == 0
+    assert events.get("integrity.sdc_rollback") >= 1
+
+
+def test_audit_without_checkpoint_raises():
+    rt = parallel.ResilientTrainer(_build_trainer(mesh=_dp_mesh()),
+                                   ckpt_dir=None, seed=3,
+                                   handle_sigterm=False,
+                                   audit_interval=0)
+    fault.install("mesh.replica_divergence", steps=[11], times=1)
+    try:
+        with pytest.raises(integrity.SDCDetected):
+            rt.audit(step=11)
+    finally:
+        fault.clear()
+
+
+def test_elastic_sdc_eviction_and_readmission(tmp_path):
+    """ElasticTrainer audits through its kvstore and EVICTS the
+    divergent replica via the shrink path (reason 'sdc'), then
+    re-admits it at the epoch boundary; training completes finite."""
+    n = len(jax.devices())
+    batch = 8 * 7
+
+    def build(mesh, lr_factor):
+        mx.random.seed(11)
+        net = gluon.nn.HybridSequential(prefix="igsd_")
+        net.add(gluon.nn.Dense(16, in_units=8, activation="relu",
+                               prefix="igsd_d1_"),
+                gluon.nn.Dense(4, in_units=16, prefix="igsd_d2_"))
+        net.initialize(force_reinit=True)
+        net(nd.ones((2, 8)))
+        return parallel.ShardedTrainer(net, optimizer="adam",
+                                       lr=1e-2 * lr_factor, mesh=mesh)
+
+    def data_fn(step, n_replicas):
+        rs = np.random.RandomState(1000 + step)
+        return (rs.randn(batch, 8).astype(np.float32),
+                rs.randint(0, 4, batch))
+
+    config.set("MXNET_FAULT_PLAN", "mesh.replica_divergence@4")
+    fault.reset_from_config()
+    try:
+        et = parallel.ElasticTrainer(
+            build, ckpt_dir=str(tmp_path / "ck"), steps_per_epoch=6,
+            ckpt_interval=2, seed=5, handle_sigterm=False,
+            audit_interval=2)
+        losses = et.run(data_fn, 8)
+    finally:
+        fault.clear()
+        config.unset("MXNET_FAULT_PLAN")
+    shrinks = [t for t in et.transitions if t["kind"] == "shrink"]
+    assert len(shrinks) == 1
+    assert shrinks[0]["reason"] == "sdc"
+    assert shrinks[0]["lost"] == [n - 1]
+    grows = [t for t in et.transitions if t["kind"] == "grow"]
+    assert grows and grows[0]["readmitted"] == [n - 1]
+    assert et.n_replicas == n
+    assert events.get("mesh.sdc_evicted") >= 1
+    assert all(np.isfinite(v) for v in losses.values())
+    assert et.last_blackbox and os.path.exists(et.last_blackbox)
+    with open(et.last_blackbox) as f:
+        doc = json.load(f)
+    mesh_evs = [e for e in doc["events"] if e.get("kind") == "mesh"
+                and e.get("name") == "shrink"]
+    assert mesh_evs and mesh_evs[-1].get("reason") == "sdc"
+    sdc_evs = [e for e in doc["events"]
+               if e.get("kind") == "integrity" and e.get("name") == "sdc"]
+    assert sdc_evs and sdc_evs[-1]["replicas"] == [n - 1]
+
+
+# ---------------------------------------------------------------------------
+# blackbox CLI: verify subcommand + suspected-cause heuristics
+# ---------------------------------------------------------------------------
+
+def test_blackbox_verify_cli(tmp_path, capsys):
+    from incubator_mxnet_tpu.tools import blackbox as bb
+    ck = str(tmp_path / "ck")
+    rt = parallel.ResilientTrainer(_build_trainer(), ckpt_dir=ck,
+                                   ckpt_interval=2, seed=3,
+                                   handle_sigterm=False)
+    _run_steps(rt, 4)
+    assert bb.main(["verify", ck]) == 0     # keep-K dir: all children
+    out = capsys.readouterr().out
+    assert out.count("OK") >= 2
+    newest, _ = _newest_ckpt(ck)
+    fault.flip_file_bit(_data_blobs(newest)[-1])
+    assert bb.main(["verify", ck]) == 1
+    out = capsys.readouterr().out
+    assert "CORRUPT" in out and "crc mismatch" in out
+    assert bb.main(["verify", newest]) == 1     # single-ckpt form
+    capsys.readouterr()
+    assert bb.main(["verify", str(tmp_path / "nope")]) == 2
+
+
+def test_suspected_cause_integrity_kinds():
+    from incubator_mxnet_tpu.tools.blackbox import suspected_cause
+    base = {"counters": {}, "events": [], "reason": "manual"}
+    sdc = dict(base, reason="sdc", events=[
+        {"kind": "integrity", "name": "sdc", "replicas": [3],
+         "leaves": ["params/w"]}])
+    assert "silent data corruption" in suspected_cause(sdc)
+    assert "[3]" in suspected_cause(sdc)
+    salv = dict(base, reason="ckpt.salvage", counters={
+        "integrity.ckpt_corrupt": 1, "integrity.ckpt_salvaged": 1,
+        "resilience.restored": 1})
+    assert "SALVAGED" in suspected_cause(salv)
+    dead = dict(base, reason="ckpt.salvage_failed",
+                counters={"integrity.ckpt_corrupt": 3})
+    assert "nothing salvageable" in suspected_cause(dead)
+    quar = dict(base, counters={"io.decode.records_corrupt": 2})
+    assert "quarantined" in suspected_cause(quar)
+    # corruption outranks the older heuristics
+    mixed = dict(sdc, counters={"serve.deadline_expired": 9})
+    assert "silent data corruption" in suspected_cause(mixed)
