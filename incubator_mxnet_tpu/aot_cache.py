@@ -111,12 +111,18 @@ class _AotJitted:
     """Callable with jax.jit semantics + executable disk persistence.
     One compiled executable per input aval signature."""
 
-    def __init__(self, fn, donate_argnums=(), label=None, kind="aot"):
+    def __init__(self, fn, donate_argnums=(), label=None, kind="aot",
+                 expect_donated=None):
         self._jit = jax.jit(fn, donate_argnums=donate_argnums)
         self._compiled = {}
         self._label = label or getattr(fn, "__name__", "fn")
         self._kind = kind
         self._cost_keys = {}        # sig -> costs registry row key
+        # donation audit (ISSUE 10 satellite): same warn-once contract
+        # as MeteredJit — an AOT-cached step that stopped donating its
+        # state should say so by name
+        _costs._audit_donation(self._label, donate_argnums,
+                               expect_donated)
 
     def _sig(self, args):
         leaves, treedef = jax.tree_util.tree_flatten(args)
@@ -281,7 +287,8 @@ class _AotJitted:
         return self._jit.lower(*args, **kw)
 
 
-def aot_jit(fn, donate_argnums=(), label=None, kind="aot"):
+def aot_jit(fn, donate_argnums=(), label=None, kind="aot",
+            expect_donated=None):
     """`jax.jit(fn, donate_argnums=...)` with executable persistence
     under `MXNET_AOT_CACHE_DIR` (no-op passthrough when unset).
 
@@ -290,11 +297,16 @@ def aot_jit(fn, donate_argnums=(), label=None, kind="aot"):
     cost/memory analysis is extracted from the compiled executable
     already in hand; without it, the plain jit is wrapped in a
     `MeteredJit` (invocation counts + lazily-resolved cost analysis).
-    Unlabeled calls keep the original zero-overhead contract."""
+    Unlabeled calls keep the original zero-overhead contract.
+    `expect_donated` arms the donation audit (warn once, by label,
+    when a donatable argnum is not in `donate_argnums`)."""
     if not cache_dir():
         if label is not None:
             return _costs.metered_jit(fn, donate_argnums=donate_argnums,
-                                      kind=kind, label=label)
+                                      kind=kind, label=label,
+                                      expect_donated=expect_donated)
+        _costs._audit_donation(label or getattr(fn, "__name__", "fn"),
+                               donate_argnums, expect_donated)
         return jax.jit(fn, donate_argnums=donate_argnums)
     return _AotJitted(fn, donate_argnums=donate_argnums, label=label,
-                      kind=kind)
+                      kind=kind, expect_donated=expect_donated)

@@ -419,6 +419,48 @@ register("MXNET_BLACKBOX_DIR", str, "",
          "Directory for black-box dumps (auto-named "
          "blackbox-<ts>-p<pid>-<seq>-<reason>.json). Empty = current "
          "working directory")
+register("MXNET_ZERO_LEVEL", int, 0,
+         "Default ZeRO stage for ShardedTrainer(zero=None): 0 = fully "
+         "replicated, 1 = optimizer state sharded along the data axis "
+         "(the legacy WSC path, bit-compatible with earlier releases), "
+         "2 = + gradients reduce-scattered in size-capped buckets and "
+         "the update computed shard-locally, 3 = + parameters STORED "
+         "sharded (gathered on demand at step start, per-replica "
+         "persistent param memory ~1/N).  Levels 2-3 use the explicit "
+         "overlap-first step (parallel/zero.py) and require a 1-d "
+         "data-parallel mesh with replicated param specs",
+         choices=(0, 1, 2, 3))
+register("MXNET_ZERO_BUCKET_MB", float, 0.0,
+         "Gradient-bucket size cap in MB for the ZeRO-2/3 "
+         "reduce-scatter (parallel/zero.py): grads of small/indivisible "
+         "params are concatenated into buckets no larger than this "
+         "before their collective launches.  0 = auto: steered by the "
+         "cost registry's measured per-step bytes when a train-step "
+         "row exists (costs.suggest_bucket_mb), else a 4 MB default "
+         "that sits below the backend's large-collective cliff")
+register("MXNET_ZERO_SOLO_KB", int, 256,
+         "Param size in KB above which a param with a data-divisible "
+         "axis gets its OWN reduce-scatter along that axis (no "
+         "flatten/concat copy) instead of joining a concat bucket")
+register("MXNET_ZERO_OVERLAP", str, "auto",
+         "ZeRO-2/3 collective schedule: 'bwd' launches each bucket's "
+         "reduce-scatter as soon as its grads are ready (interleaved "
+         "with backward — hides collective latency behind compute on "
+         "backends with async collectives), 'trail' coalesces every "
+         "bucket collective after backward at one synchronized point "
+         "(host-bound CPU meshes: staggered rendezvous arrival makes "
+         "interleaved collectives convoy — measured ~10x their "
+         "isolated cost).  'auto' = trail on CPU backends, bwd "
+         "elsewhere", choices=("auto", "bwd", "trail"))
+register("MXNET_DISPATCH_THREADS", int, -1,
+         "ShardedTrainer per-replica dispatch fan-out: worker threads "
+         "that device_put each replica's batch shard concurrently "
+         "(JAX dispatch releases the GIL into C++) and time it into "
+         "train.dispatch_replica_us{replica=}.  -1 = auto (one thread "
+         "per replica, capped at 8, engaged only for multi-replica "
+         "meshes fed from host arrays of >= 1 MB), 0 = off, N = "
+         "exactly N worker threads (1 = uploads serialize through one "
+         "worker but per-replica timing attribution is kept)")
 register("MXNET_INT64_TENSOR_SIZE", bool, False,
          "Large-tensor support: enable 64-bit index arithmetic so "
          "arrays past 2**31 elements index correctly (ref: the "

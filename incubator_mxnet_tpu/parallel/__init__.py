@@ -8,6 +8,8 @@ from .mesh import make_mesh, Mesh, NamedSharding, P, replicated, \
 from .functional import functionalize, extract_params, load_params
 from .trainer import (ShardedTrainer, softmax_ce_loss, sgd_momentum_tree,
                       adam_tree)
+from .zero import BucketPlan, overlap_schedule, zero_level_default
+from .dispatch import DispatchPool
 from .resilience import ResilientTrainer, retry_transient
 from .elastic import ElasticTrainer, ReplicaHealth
 from .pipeline import (pipeline_apply, split_microbatches,
@@ -25,4 +27,6 @@ __all__ = ["make_mesh", "Mesh", "NamedSharding", "P", "replicated",
            "ResilientTrainer", "ElasticTrainer", "ReplicaHealth",
            "retry_transient",
            "softmax_ce_loss", "sgd_momentum_tree", "adam_tree",
+           "BucketPlan", "overlap_schedule", "zero_level_default",
+           "DispatchPool",
            "ring_attention", "ulysses_attention", "local_attention"]

@@ -43,8 +43,9 @@ import threading
 import time
 import weakref
 
-__all__ = ["note_executable", "invoke", "table", "totals", "snapshot",
-           "reset", "metered_jit", "MeteredJit", "footprint_bytes"]
+__all__ = ["note_executable", "note_collective", "invoke", "table",
+           "totals", "snapshot", "reset", "metered_jit", "MeteredJit",
+           "footprint_bytes", "suggest_bucket_mb"]
 
 _LOCK = threading.Lock()
 _ROWS = {}                      # key -> dict row
@@ -132,6 +133,59 @@ def note_executable(kind, label, lowered=None, compiled=None,
         _NEXT[0] += 1
         _ROWS[key] = row
     return key
+
+
+def note_collective(label, op, wire_bytes, n_shards, dtype="float32"):
+    """Register one bucket-collective's cost row (ISSUE 10 satellite):
+    the ZeRO-2/3 reduce-scatter / all-gather buckets are not separate
+    executables (they live inside the fused train step), so XLA's
+    per-executable analysis cannot attribute their bytes-on-wire per
+    bucket.  This row carries the bucket's wire bytes explicitly
+    (``bytes_accessed`` = bytes each shard contributes to the ring),
+    kind="collective", so teletop and the bench JSON can rank buckets
+    the same way they rank executables.  ``invoke(key)`` per step keeps
+    cumulative wire totals honest.  Returns the row key."""
+    row = {"kind": "collective", "label": str(label),
+           "flops": 0.0, "bytes_accessed": float(wire_bytes),
+           "compile_wall_s": 0.0, "loaded": False, "invocations": 0,
+           "analyzed": True, "pending": None,
+           "sig": "%s[%d shards, %s]" % (op, int(n_shards), dtype)}
+    with _LOCK:
+        key = _NEXT[0]
+        _NEXT[0] += 1
+        _ROWS[key] = row
+    return key
+
+
+def suggest_bucket_mb(param_bytes, n_shards, label_prefix=None,
+                      default_mb=4.0):
+    """Bucket-size cap steering (ISSUE 10 tentpole b): pick the
+    MXNET_ZERO_BUCKET_MB default from measured per-executable bytes.
+
+    When a train-step row for ``label_prefix`` already exists with a
+    resolved bytes-accessed figure (a previous build of this trainer —
+    e.g. the elastic rebuild path, where the registry has watched the
+    step run), the cap targets ~1/32 of the executable's measured
+    per-step traffic: enough buckets to interleave with backward,
+    each well under the backend's large-collective cliff.  Without a
+    row, the same 1/32 rule applies to the param bytes themselves.
+    Clamped to [1, 16] MB; an explicit MXNET_ZERO_BUCKET_MB (> 0)
+    always wins at the call site."""
+    basis = float(param_bytes)
+    if label_prefix:
+        bracket = label_prefix + "["
+        with _LOCK:
+            rows = [dict(r) for r in _ROWS.values()]
+        for r in rows:
+            label = str(r.get("label", ""))
+            if (label == label_prefix or label.startswith(bracket)) \
+                    and r.get("bytes_accessed", 0) > 0 \
+                    and r.get("pending") is None:
+                basis = max(basis, float(r["bytes_accessed"]))
+                break
+    if basis <= 0:
+        return float(default_mb)
+    return float(min(16.0, max(1.0, basis / 32.0 / 1e6)))
 
 
 def _note_pending(kind, label, resolver, compile_s=None):
@@ -271,6 +325,31 @@ def reset():
         _ROWS.clear()
 
 
+_DONATION_WARNED = set()
+
+
+def _audit_donation(label, donate_argnums, expect_donated):
+    """Donation audit (ISSUE 10 satellite): a trainer step that fails
+    to donate its state doubles the persistent HBM bill and breaks the
+    in-place-update contract silently.  ``expect_donated`` names the
+    argnums the CALLER says hold donatable state; any of them missing
+    from ``donate_argnums`` warns ONCE per executable label (the label
+    is the thing an operator can grep the cost table / blackbox for)."""
+    if not expect_donated:
+        return
+    missing = sorted(set(int(i) for i in expect_donated)
+                     - set(int(i) for i in donate_argnums))
+    if not missing or label in _DONATION_WARNED:
+        return
+    _DONATION_WARNED.add(label)
+    import warnings
+    warnings.warn(
+        "executable %r: argument(s) %s hold donatable state but are "
+        "not donated (donate_argnums=%s) — the update will copy "
+        "instead of aliasing, doubling this state's memory footprint"
+        % (label, missing, tuple(donate_argnums)))
+
+
 class MeteredJit:
     """`jax.jit` + cost-row registration + invocation counting for the
     plain-jit executables (no aot_cache involved).
@@ -284,10 +363,12 @@ class MeteredJit:
     locked counter bump.  Recorder off: one bool read, then the inner
     jit."""
 
-    def __init__(self, fn, donate_argnums=(), kind="jit", label=None):
+    def __init__(self, fn, donate_argnums=(), kind="jit", label=None,
+                 expect_donated=None):
         import jax
         self._kind = kind
         self._label = label or getattr(fn, "__name__", "fn")
+        _audit_donation(self._label, donate_argnums, expect_donated)
         self._keys = []             # registry row key per traced sig
         self._pending = []          # avals captured at trace time
         # suppresses the hook during lazy cost resolution (its lower()
@@ -358,8 +439,12 @@ class MeteredJit:
         return self._jit.lower(*args, **kw)
 
 
-def metered_jit(fn, donate_argnums=(), kind="jit", label=None):
+def metered_jit(fn, donate_argnums=(), kind="jit", label=None,
+                expect_donated=None):
     """`jax.jit(fn, donate_argnums=...)` with a cost-registry row per
-    input signature and cumulative invocation counts."""
+    input signature and cumulative invocation counts.
+    ``expect_donated`` arms the donation audit: argnums named there but
+    absent from ``donate_argnums`` warn once with the executable
+    label."""
     return MeteredJit(fn, donate_argnums=donate_argnums, kind=kind,
-                      label=label)
+                      label=label, expect_donated=expect_donated)

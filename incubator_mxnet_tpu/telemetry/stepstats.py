@@ -10,6 +10,16 @@
     train.dispatch_us       async dispatch wall (ShardedTrainer steps —
                             loss stays on device, so compute wall is
                             not observable without forfeiting overlap)
+    train.dispatch_replica_us  per-replica batch-shard upload wall,
+                            labeled {replica=<i>} (the DispatchPool
+                            fan-out — ISSUE 10); aggregate + labeled
+                            percentile rings
+    train.collective_us     attributed collective wall per step where
+                            a caller can measure it (the bench's
+                            weak-scaling breakdown derives it from a
+                            collective-free compiled baseline; inside
+                            ONE fused executable it is not separately
+                            observable)
     train.loss              loss samples (percentiles; no counter)
     train.steps_skipped     guarded steps whose update was not applied
     train.steps_compiling   steps that traced a new executable
@@ -49,7 +59,7 @@ class StepTelemetry:
 
     def record_step(self, loss=None, ok=True, wall_s=None,
                     data_wait_s=None, compute_s=None,
-                    dispatch_s=None, traces=None):
+                    dispatch_s=None, collective_s=None, traces=None):
         """One step's telemetry.  Durations in seconds (None = not
         measured); `loss` a host float (NaN/None skipped as a sample);
         `ok` False counts the step as skipped (guarded-step contract);
@@ -67,6 +77,8 @@ class StepTelemetry:
             c.observe_time("train.compute_us", compute_s)
         if dispatch_s is not None:
             c.observe_time("train.dispatch_us", dispatch_s)
+        if collective_s is not None:
+            c.observe_time("train.collective_us", collective_s)
         if loss is not None and math.isfinite(loss):
             c.observe("train.loss", float(loss))
         if not ok:

@@ -91,6 +91,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "integrity: corruption-detection/recovery tests "
         "(CPU-fast, run in tier-1 by default)")
+    # ZeRO-2/3 sharding + overlap-first collective tests (ISSUE 10);
+    # the check_scaling gate itself is slow-marked
+    config.addinivalue_line(
+        "markers", "scaling: ZeRO sharding / weak-scaling tests "
+        "(CPU-fast, run in tier-1 by default)")
 
 
 @pytest.fixture(autouse=True)
