@@ -1063,6 +1063,10 @@ def _elastic_scenario(n_devices, kill_at, steps, steps_per_epoch):
                     "elastic_regrow_s": g["wall_s"]})
     if et.last_blackbox:
         out["elastic_blackbox"] = os.path.basename(et.last_blackbox)
+    if et.fleet is not None:
+        # the merged per-replica view (ISSUE 11): step/dispatch/
+        # collective µs per replica as the supervisor last saw them
+        out["fleet"] = et.fleet.block()
     print(json.dumps(out))
     return out
 
@@ -1102,6 +1106,132 @@ def _write_multichip_elastic(parsed, rc=0):
         json.dump(blob, fh, indent=2)
 
 
+def _fleet_straggler_proof(n_devices, inject_at=4, stale=6, steps=12):
+    """Fleet-observability proof on the virtual mesh (ISSUE 11), run
+    inside the multichip child:
+
+    1. **Straggler detection beats heartbeat staleness.**  An
+       ElasticTrainer with ``mesh.replica_slow@inject_at`` injected
+       and a large ``down_steps`` (the replica is alive-but-slow, the
+       mesh must NOT shrink): the victim's published step times
+       inflate, the skew detector (window 3 here) flags it and the
+       ring gets a ``mesh.straggler`` event naming it — strictly
+       before step ``inject_at + stale``, when heartbeat staleness
+       would first have said "slow".
+    2. **Cross-process trace merge.**  A 2-worker DecodeService feeds
+       a consumer loop that stamps the global step; the workers'
+       decode intervals are re-parented as ``io.decode`` spans under
+       the consumer's span with the WORKER pids.  A black-box dump's
+       embedded trace is then run through ``blackbox merge``: the
+       merged timeline must contain spans from >= 2 processes
+       correlated on the same (trace_id, step).
+    """
+    import tempfile
+
+    from incubator_mxnet_tpu import config as _fcfg, fault, gluon, \
+        nd, parallel, telemetry
+    from incubator_mxnet_tpu.io.decode_service import (
+        DecodeService, DecodeServiceUnavailable)
+    from incubator_mxnet_tpu.telemetry import flightrec
+    from incubator_mxnet_tpu.tools.blackbox import merge_traces
+
+    in_dim, classes = 32, 8
+    batch = n_devices * 2
+    prev_tel = telemetry.enable()
+    _fcfg.set("MXNET_STRAGGLER_WINDOW", "3")
+    _fcfg.set("MXNET_FAULT_PLAN", "mesh.replica_slow@%d" % inject_at)
+    fault.reset_from_config()
+    flightrec.clear()
+
+    def build(mesh, lr_factor):
+        import incubator_mxnet_tpu as mx
+        mx.random.seed(17)
+        net = gluon.nn.HybridSequential(prefix="bfl_")
+        net.add(gluon.nn.Dense(32, in_units=in_dim, activation="relu",
+                               prefix="bfl_d1_"),
+                gluon.nn.Dense(classes, in_units=32, prefix="bfl_d2_"))
+        net.initialize(force_reinit=True)
+        net(nd.ones((2, in_dim)))
+        return parallel.ShardedTrainer(net, optimizer="sgd",
+                                       lr=1e-2 * lr_factor, mesh=mesh)
+
+    def data_fn(step, n_replicas):
+        rs = np.random.RandomState(2000 + step)
+        return (rs.randn(batch, in_dim).astype(np.float32),
+                rs.randint(0, classes, batch))
+
+    out = {"injected_replica": n_devices - 1,
+           "inject_step": inject_at,
+           "heartbeat_slow_step": inject_at + stale}
+    try:
+        ck = tempfile.mkdtemp(prefix="bench_fleet_ck_")
+        et = parallel.ElasticTrainer(
+            build, ckpt_dir=ck, ckpt_interval=4, seed=7,
+            handle_sigterm=False, stale_steps=stale,
+            down_steps=10 * steps)      # alive-but-slow: never shrink
+        et.run(data_fn, steps)
+        strag = [e for e in flightrec.ring_snapshot()
+                 if e["kind"] == "mesh" and e["name"] == "straggler"]
+        out["fleet_view"] = et.fleet.block() if et.fleet else {}
+        if strag:
+            out["straggler_replica"] = strag[0].get("replica")
+            out["straggler_detected_step"] = strag[0].get("step")
+            out["straggler_step_us"] = strag[0].get("step_us")
+            out["straggler_fleet_median_us"] = \
+                strag[0].get("fleet_median_us")
+        out["straggler_ok"] = bool(
+            strag
+            and strag[0].get("replica") == n_devices - 1
+            and strag[0].get("step", 10 ** 9)
+            < out["heartbeat_slow_step"])
+
+        # -- cross-process trace merge proof ---------------------------
+        try:
+            rec = _ensure_rec()
+            svc = DecodeService(rec, 16, (3, 96, 96), workers=2,
+                                resize=112, dtype="uint8")
+            try:
+                it = iter(svc)
+                for s in range(4):
+                    telemetry.set_global_step(1000 + s)
+                    with telemetry.span("fleet.consume", replica=0):
+                        next(it)
+            finally:
+                telemetry.set_global_step(None)
+                svc.close()
+            dump = flightrec.dump_blackbox(
+                path=os.path.join("/tmp", "bench-fleet-trace.json"),
+                reason="fleet-proof")
+            merged_path = os.path.join("/tmp",
+                                       "bench-fleet-merged.trace.json")
+            summary = merge_traces([dump], out_path=merged_path)
+            out["trace_processes"] = len(summary["processes"])
+            out["trace_cross_process_steps"] = \
+                summary["cross_process_steps"][:8]
+            out["trace_cross_process_traces"] = \
+                len(summary["cross_process_traces"])
+            out["trace_merged_events"] = summary["events"]
+            out["trace_ok"] = bool(
+                len(summary["processes"]) >= 2
+                and summary["cross_process_steps"]
+                and summary["cross_process_traces"])
+        except DecodeServiceUnavailable as e:
+            # host incapability is a WAIVER, not a failure (the
+            # check_feed/DecodeService degradation convention): the
+            # trace proof needs worker processes this host can't run
+            out["trace_ok"] = None
+            out["trace_waived_host"] = \
+                "decode service unavailable: %s" % e
+        out["ok"] = bool(out["straggler_ok"]
+                         and out.get("trace_ok") is not False)
+    finally:
+        fault.clear()
+        _fcfg.unset("MXNET_FAULT_PLAN")
+        _fcfg.unset("MXNET_STRAGGLER_WINDOW")
+        telemetry.enable(prev_tel)
+    return out
+
+
 _MULTICHIP_CHILD_MARK = "_BENCH_MULTICHIP_CHILD"
 
 
@@ -1126,8 +1256,10 @@ def run_multichip(n_devices=8):
         env.setdefault("MXNET_BLACKBOX_DIR", "/tmp")
         cmd = [sys.executable, os.path.abspath(__file__),
                "--multichip-child", str(n_devices)]
+        # 600s: the sweep plus the ISSUE 11 fleet proof (an elastic
+        # run + a 2-worker decode service) in one child
         res = subprocess.run(cmd, capture_output=True, text=True,
-                             timeout=420, env=env,
+                             timeout=600, env=env,
                              cwd=os.path.dirname(os.path.abspath(__file__)))
         for line in reversed((res.stdout or "").strip().splitlines()
                              or [""]):
@@ -1282,6 +1414,17 @@ def _multichip_scenario(n_devices):
             "bus; weak_eff is bounded by ~cores/N plus the "
             "update/collective share the ZeRO path removes" % cores),
     }
+    # fleet observability proof (ISSUE 11): straggler injected via
+    # mesh.replica_slow → detected from published step times BEFORE
+    # heartbeat staleness; 2-worker decode spans merged into one
+    # cross-process chrome trace correlated on the global step.
+    # Guarded: a failing proof must report ok=false, never destroy the
+    # completed scaling sweep above (the JSON line IS the result)
+    try:
+        out["fleet"] = _fleet_straggler_proof(n_devices)
+    except Exception as e:          # noqa: BLE001
+        out["fleet"] = {"ok": False, "error": ("%s: %s" % (
+            type(e).__name__, e))[:200]}
     print(json.dumps(out))
     return out
 
@@ -1312,10 +1455,13 @@ def _write_multichip_scaling(parsed, rc=0):
     parsed["weak_eff_target_met"] = target_met
     parsed["weak_eff_target_waived_host_bound"] = (not target_met
                                                    and waived)
+    fleet = parsed.get("fleet", {})
     tail = ("multichip scaling: weak_eff=%.2f (legacy %.2f, %.1fx) "
             "zero=%s sched=%s buckets cap=%.1fMB zero3 param "
             "bytes/replica=%.0f%% of unsharded, %d collective rows, "
             "%d host cores%s\n"
+            "fleet: straggler r%s detected@step%s (heartbeat would "
+            "say slow@step%s), trace merge %s proc / steps %s -> %s\n"
             % (eff, eff_l, parsed.get("weak_eff_gain", 0.0),
                parsed.get("zero_level"),
                parsed.get("overlap_schedule"),
@@ -1323,10 +1469,17 @@ def _write_multichip_scaling(parsed, rc=0):
                parsed.get("collective_cost_rows", 0),
                parsed.get("host_cores", 0),
                "" if eff >= 0.3 else " [host-bound: see "
-               "host_bound_note]"))
+               "host_bound_note]",
+               fleet.get("straggler_replica", "?"),
+               fleet.get("straggler_detected_step", "?"),
+               fleet.get("heartbeat_slow_step", "?"),
+               fleet.get("trace_processes", 0),
+               fleet.get("trace_cross_process_steps", []),
+               "ok" if fleet.get("ok") else "FAILED"))
     blob = {"n_devices": parsed.get("multichip_devices", 0), "rc": rc,
             "ok": (rc == 0 and exercised and improved
-                   and (target_met or waived)),
+                   and (target_met or waived)
+                   and bool(fleet.get("ok"))),
             "skipped": False, "tail": tail, "parsed": parsed}
     here = os.path.dirname(os.path.abspath(__file__))
     with open(os.path.join(here, "MULTICHIP_scaling.json"), "w") as fh:

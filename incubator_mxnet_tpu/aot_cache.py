@@ -84,6 +84,36 @@ def trim_cache(max_entries=None):
         return 0
 
 
+def _stale_reason(exc) -> str:
+    """Classify WHY a cached executable blob failed to load (ISSUE 11
+    satellite): `aot.stale` alone says a recompile happened, not what
+    to fix — BENCH_serve's `aot.stale: 7, aot.miss: 7` smoking gun was
+    undiagnosable.  Four buckets, matched on the failure text:
+
+    - ``version``            — executable format / runtime build
+      rotation ("cached executable is ... format vX, this build is
+      vY"); fix = let the cache re-fill, or pin the runtime.
+    - ``backend_mismatch``   — blob compiled for a different platform /
+      device kind / topology than it is being loaded onto; fix = the
+      cache key (or the deployment) is mixing backends.
+    - ``key_mismatch``       — in/out tree or signature mismatch
+      between the blob and this call; fix = the lowering changed under
+      the same key.
+    - ``deserialize_error``  — anything else (truncated/corrupt blob,
+      read error).
+    """
+    msg = ("%s: %s" % (type(exc).__name__, exc)).lower()
+    if "version" in msg or "format v" in msg:
+        return "version"
+    if any(w in msg for w in ("platform", "backend", "device",
+                              "topology", "shard")):
+        return "backend_mismatch"
+    if any(w in msg for w in ("tree", "structure", "signature",
+                              "argument", "unflatten")):
+        return "key_mismatch"
+    return "deserialize_error"
+
+
 def _key_for(lowered, dev):
     # dev is the device the executable is compiled for and pinned to
     # (_args_device) — NOT jax.devices()[0], which can be a different
@@ -233,12 +263,23 @@ class _AotJitted:
                     print("[aot] HIT lower=%.1fs key=%.1fs load=%.1fs"
                           % (t1 - t0, t2 - t1, _t.perf_counter() - t2))
                 return out
-            except Exception:
+            except Exception as stale_exc:  # noqa: BLE001
                 # corrupt/stale blob: fall through to compile and
-                # overwrite the entry
+                # overwrite the entry — but say WHY, as a labeled
+                # counter + ring event (the aggregate alone made
+                # BENCH_serve's stale=miss=7 undiagnosable)
+                reason = _stale_reason(stale_exc)
                 events.incr("aot.stale")
+                events.incr("aot.stale", labels={"reason": reason})
+                _bb.record("aot", "stale", reason=reason,
+                           label=self._label,
+                           error=("%s: %s" % (
+                               type(stale_exc).__name__,
+                               stale_exc))[:160],
+                           blob=os.path.basename(path))
                 if dbg:
-                    print("[aot] STALE %s" % os.path.basename(path))
+                    print("[aot] STALE (%s) %s"
+                          % (reason, os.path.basename(path)))
         t3 = _t.perf_counter()      # fresh stamp: a failed stale-blob
         with _tele.span("aot.compile"):  # load above must not inflate
             compiled = lowered.compile()  # the compile-cost tail

@@ -461,6 +461,34 @@ register("MXNET_DISPATCH_THREADS", int, -1,
          "meshes fed from host arrays of >= 1 MB), 0 = off, N = "
          "exactly N worker threads (1 = uploads serialize through one "
          "worker but per-replica timing attribution is kept)")
+register("MXNET_STRAGGLER_WINDOW", int, 8,
+         "Fleet straggler detector (telemetry/fleet.py): per-replica "
+         "rolling window of published step times the skew statistic is "
+         "computed over.  Smaller = faster detection, noisier verdict; "
+         "the detector needs at least 2 samples per replica before it "
+         "judges")
+register("MXNET_STRAGGLER_SIGMA", float, 4.0,
+         "Fleet straggler detector: a replica whose windowed median "
+         "step time exceeds the OTHER replicas' median by this many "
+         "robust sigmas (1.4826*MAD, leave-one-out so a small "
+         "fleet's outlier cannot inflate its own baseline) — with a "
+         "floor of 50%% over that median, so a uniform fleet "
+         "(MAD ~ 0) never flags micro-skew — is reported as a "
+         "straggler: mesh.straggler counter + ring event, and "
+         "ElasticTrainer's existing slow-(observed) replica state")
+register("MXNET_FLEET_PUBLISH_STEPS", int, 1,
+         "Fleet telemetry publish cadence: every N supervised steps "
+         "each replica pushes its compact snapshot (step time, "
+         "dispatch/collective walls, HBM watermark, aot hit/miss/"
+         "stale) through the kvstore at __mesh__/telemetry/<rid> for "
+         "rank 0 to merge into the FleetView.  0 disables fleet "
+         "publishing/straggler detection")
+register("MXNET_GATE_REPORT_DIR", str, "",
+         "Directory the CI gates (check_overhead/check_feed/"
+         "check_serve/check_scaling) write per-run JSON artifacts to "
+         "(per-trial numbers + pass/skip/inconclusive verdicts, "
+         "auto-named <gate>-<ts>-p<pid>.json) so flake rates become a "
+         "readable trend.  Empty = no artifact")
 register("MXNET_INT64_TENSOR_SIZE", bool, False,
          "Large-tensor support: enable 64-bit index arithmetic so "
          "arrays past 2**31 elements index correctly (ref: the "

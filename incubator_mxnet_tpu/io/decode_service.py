@@ -76,6 +76,19 @@ A consumer wait above 1 ms lands a `("io", "stall")` event with the
 queue depth in the black-box ring, so a dump attributes starvation to
 decode (depth 0 here) vs wire/H2D (`feed.stall` with depth 0 there).
 
+Cross-process tracing (ISSUE 11): workers are jax- and telemetry-free
+by design, so they cannot emit spans — instead every batch message
+carries the decode interval's wall-clock timing (`time.time()` start +
+duration), and the CONSUMER re-parents it on delivery: an `io.decode`
+span is emitted on the worker's behalf (`telemetry.emit_foreign`) with
+the WORKER's pid, parented under the consumer's innermost open span
+(the feed span on the e2e path) and stamped with the current global
+step.  The delivered `SlabBatch` carries the resulting `TraceContext`
+in `.trace`, so downstream stages (DeviceFeed's transfer span) can
+join the same trace.  On a merged chrome timeline one training step
+therefore shows the worker's decode slice in the worker's own process
+row, correlated with the consumer's step.
+
 Degradation: hosts where shared memory or process spawn is unavailable
 (sandboxes) raise `DecodeServiceUnavailable` from the constructor;
 `ImageRecordIter` catches it, warns ONCE, and continues on the legacy
@@ -434,6 +447,12 @@ def _worker_main(wid, spec, ctrl_q, free_q, out_q, cur_epoch,
                     if slot is None:        # epoch aborted (reset)
                         aborted = True
                         break
+                    # decode-interval wall clock (time.time(): epoch
+                    # time IS comparable across processes, unlike
+                    # perf_counter) — rides the batch message so the
+                    # consumer can emit this interval as an io.decode
+                    # span in THIS worker's process row
+                    bt0 = time.time()
                     if owners is not None:
                         owners[slot] = wid
                     dview, lview = views[slot]
@@ -489,7 +508,8 @@ def _worker_main(wid, spec, ctrl_q, free_q, out_q, cur_epoch,
                             continue
                         _write_label(lview[k], label)
                         k += 1
-                    out_q.put(("batch", epoch, slot, k, wid, seq))
+                    out_q.put(("batch", epoch, slot, k, wid, seq,
+                               bt0, int((time.time() - bt0) * 1e6)))
                     slot = None             # ownership passed on (the
                     seq += 1                # parent clears owners[])
                     if cur_epoch.value != epoch:
@@ -540,17 +560,23 @@ class SlabBatch:
     valid until the slot is recycled — which happens at the NEXT
     `DecodeService.__next__` (or an explicit `release()`).  `wid`/`seq`
     identify the producing worker and its batch ordinal, so a batch
-    stream is attributable (and bit-reproducibility testable)."""
+    stream is attributable (and bit-reproducibility testable).
+    `trace` (ISSUE 11) is the `telemetry.TraceContext` of the
+    `io.decode` span the consumer emitted on the worker's behalf —
+    None when telemetry is off — so downstream stages can join the
+    same trace."""
 
-    __slots__ = ("data", "label", "count", "wid", "seq", "_svc",
-                 "_slot")
+    __slots__ = ("data", "label", "count", "wid", "seq", "trace",
+                 "_svc", "_slot")
 
-    def __init__(self, data, label, count, wid, seq, svc, slot):
+    def __init__(self, data, label, count, wid, seq, svc, slot,
+                 trace=None):
         self.data = data
         self.label = label
         self.count = count
         self.wid = wid
         self.seq = seq
+        self.trace = trace
         self._svc = svc
         self._slot = slot
 
@@ -1116,7 +1142,7 @@ class DecodeService:
                 self._free_q.put(msg[2])
                 continue            # keep pulling
             break
-        _, _, slot, count, wid, seq = msg
+        slot, count, wid, seq = msg[2:6]
         # delivery: the slot's owner mark clears (a respawn must not
         # reclaim a slot the consumer holds) and the worker's resume
         # point advances to the batch after this one
@@ -1131,9 +1157,31 @@ class DecodeService:
             from ..telemetry import flightrec as _bb
             _bb.record("io", "stall", us=wait_us,
                        qdepth=max(depth, 0))
+        # cross-process re-parenting (ISSUE 11): the worker reported
+        # its decode interval's wall timing in the message; emit it as
+        # an io.decode span in the WORKER's process row, parented
+        # under the consumer's innermost open span and stamped with
+        # the current global step — one bool read when telemetry is
+        # off, and pre-ISSUE-11 6-tuple messages (a drain straggler)
+        # simply carry no timing
+        trace = None
+        if len(msg) >= 8:
+            from ..telemetry import spans as _tele
+            if _tele.enabled():
+                proc = self._procs[wid] if wid < len(self._procs) \
+                    else None
+                ctx = _tele.emit_foreign(
+                    "io.decode", msg[6], msg[7] / 1e6,
+                    pid=getattr(proc, "pid", None),
+                    wid=int(wid), seq=int(seq), epoch=self._epoch,
+                    records=int(count))
+                if ctx is not None:
+                    trace = _tele.TraceContext(
+                        ctx.trace_id, ctx.span_id,
+                        _tele.get_global_step())
         dview, lview = self._views[slot]
         sb = SlabBatch(dview[:count], lview[:count], count, wid, seq,
-                       self, slot)
+                       self, slot, trace=trace)
         with self._lock:
             self._current = sb
         events.incr("io.decode.batches")

@@ -56,6 +56,18 @@ def _is_list(x):
 class KVStore:
     """ref: kvstore.py KVStore (python facade over the C KVStore)."""
 
+    def _span(self, op):
+        """A telemetry span for one store operation, tagged with the
+        membership generation + this rank (ISSUE 11: kvstore traffic
+        is the fleet's shared wire, so barrier/push/pull intervals
+        must be attributable to a generation and a rank on the merged
+        timeline).  One bool read when telemetry is disabled."""
+        from ..telemetry import spans as _tele
+        if not _tele.enabled():
+            return _tele.span(op)       # the shared no-op
+        return _tele.span("kv." + op, gen=self._generation,
+                          rank=self.rank)
+
     def __init__(self, kv_type: str = "local"):
         self.type = kv_type
         self._store: Dict = {}
@@ -131,6 +143,10 @@ class KVStore:
 
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
+        with self._span("push"):
+            self._push_body(keys, values)
+
+    def _push_body(self, keys, values):
         for k, v in zip(keys, values):
             if k not in self._store:
                 raise MXNetError("key %r not initialised" % (k,))
@@ -194,12 +210,13 @@ class KVStore:
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize(key, out)
-        for k, o in zip(keys, outs):
-            if k not in self._store:
-                raise MXNetError("key %r not initialised" % (k,))
-            src = self._store[k]
-            for dst in (o if _is_list(o) else [o]):
-                self._write_out(dst, src)
+        with self._span("pull"):
+            for k, o in zip(keys, outs):
+                if k not in self._store:
+                    raise MXNetError("key %r not initialised" % (k,))
+                src = self._store[k]
+                for dst in (o if _is_list(o) else [o]):
+                    self._write_out(dst, src)
 
     def pushpull(self, key, value, out=None, priority=0):
         """Fused allreduce (ref: KVStoreNCCL::PushPull — grouped
@@ -208,10 +225,11 @@ class KVStore:
         if out is None:
             out = value
         _, outs = self._normalize(key, out)
-        for k, v, o in zip(keys, values, outs):
-            agg = self._reduce(v)
-            for dst in (o if _is_list(o) else [o]):
-                self._write_out(dst, agg)
+        with self._span("pushpull"):
+            for k, v, o in zip(keys, values, outs):
+                agg = self._reduce(v)
+                for dst in (o if _is_list(o) else [o]):
+                    self._write_out(dst, agg)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the rows in `row_ids` (ref: sparse kvstore pull for
@@ -269,7 +287,8 @@ class KVStore:
     def _barrier(self, timeout=None, generation=None):
         # in-process store: nothing to wait on, but membership is still
         # enforced — a stale rank must not believe it passed a barrier
-        self.check_generation(generation)
+        with self._span("barrier"):
+            self.check_generation(generation)
 
     def _set_updater(self, updater):
         self._updater = updater
@@ -404,6 +423,11 @@ class DistKVStore(KVStore):
         exit and let the scheduler restart the worker; do not issue
         further kvstore ops from this process."""
         from .. import config, fault as _fault
+        with self._span("barrier"):
+            return self._barrier_body(timeout, generation, config,
+                                      _fault)
+
+    def _barrier_body(self, timeout, generation, config, _fault):
         self.check_generation(generation)
         if timeout is None:
             timeout = float(config.get("MXNET_KVSTORE_BARRIER_TIMEOUT"))
@@ -521,23 +545,25 @@ class DistKVStore(KVStore):
 
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
-        for k, v in zip(keys, values):
-            if k not in self._store:
-                raise MXNetError("key %r not initialised" % (k,))
-            agg = self._dist_aggregate(k, self._reduce(v))
-            from ..ndarray.sparse import RowSparseNDArray
-            if self._updater is not None:
-                self._updater(self._int_key(k), agg, self._store[k])
-            elif isinstance(agg, RowSparseNDArray):
-                rows = agg.indices._data.astype(jnp.int32)
-                dst = self._store[k]
-                dst._data = dst._data.at[rows].set(
-                    agg.data._data.astype(dst._data.dtype))
-            else:
-                self._store[k]._data = jax.device_put(
-                    jnp.array(agg._data,
-                              dtype=self._store[k]._data.dtype, copy=True),
-                    self._store[k].context.jax_device)
+        with self._span("push"):
+            for k, v in zip(keys, values):
+                if k not in self._store:
+                    raise MXNetError("key %r not initialised" % (k,))
+                agg = self._dist_aggregate(k, self._reduce(v))
+                from ..ndarray.sparse import RowSparseNDArray
+                if self._updater is not None:
+                    self._updater(self._int_key(k), agg, self._store[k])
+                elif isinstance(agg, RowSparseNDArray):
+                    rows = agg.indices._data.astype(jnp.int32)
+                    dst = self._store[k]
+                    dst._data = dst._data.at[rows].set(
+                        agg.data._data.astype(dst._data.dtype))
+                else:
+                    self._store[k]._data = jax.device_put(
+                        jnp.array(agg._data,
+                                  dtype=self._store[k]._data.dtype,
+                                  copy=True),
+                        self._store[k].context.jax_device)
 
     def pushpull(self, key, value, out=None, priority=0):
         """Fused allreduce across workers: local reduce → DCN sum →
@@ -546,10 +572,11 @@ class DistKVStore(KVStore):
         if out is None:
             out = value
         _, outs = self._normalize(key, out)
-        for k, v, o in zip(keys, values, outs):
-            agg = self._dist_aggregate(k, self._reduce(v))
-            for dst in (o if _is_list(o) else [o]):
-                self._write_out(dst, agg)
+        with self._span("pushpull"):
+            for k, v, o in zip(keys, values, outs):
+                agg = self._dist_aggregate(k, self._reduce(v))
+                for dst in (o if _is_list(o) else [o]):
+                    self._write_out(dst, agg)
 
     def set_gradient_compression(self, compression_params):
         params = dict(compression_params)

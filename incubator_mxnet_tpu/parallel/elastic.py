@@ -60,6 +60,8 @@ from .. import fault
 from .. import integrity
 from ..monitor import events
 from ..telemetry import flightrec as _bb
+from ..telemetry import spans as _tele
+from ..telemetry.fleet import FleetTelemetry
 from .mesh import surviving_mesh
 from .resilience import ResilientTrainer
 
@@ -103,6 +105,8 @@ class ReplicaHealth:
         self._suppressed = set()        # rids whose beats stopped (down)
         self._slow_until = {}           # rid -> step beats resume
         self._state = {}                # rid -> last reported verdict
+        self._observed_slow = set()     # rids the fleet telemetry
+        #                                 (straggler detector) flagged
         for rid in range(self.n):
             kv.init(_HB_KEY % rid, NDArray(
                 _np.asarray([-1.0, 0.0], _np.float64)))
@@ -120,6 +124,31 @@ class ReplicaHealth:
         self._suppressed.discard(int(rid))
         self._slow_until.pop(int(rid), None)
         self._state.pop(int(rid), None)
+        self._observed_slow.discard(int(rid))
+
+    # -- fleet-telemetry feed (ISSUE 11) --------------------------------
+    def note_observed_slow(self, rid: int, step: int,
+                           source: str = "straggler") -> None:
+        """Feed the "slow (observed)" state from TELEMETRY rather than
+        heartbeat staleness: the straggler detector saw this replica's
+        published step times skew while its beats are still fresh —
+        the alive-but-slow case staleness alone can never see.  The
+        verdict is sticky across polls until `clear_observed_slow`
+        (otherwise every fresh beat would flip it healthy and the next
+        detector round would re-count the same degradation)."""
+        rid = int(rid)
+        self._observed_slow.add(rid)
+        if self._state.get(rid) != "slow":
+            self._state[rid] = "slow"
+            events.incr("mesh.replica_slow")
+            _bb.record_mesh("replica_slow", replica=rid,
+                            step=int(step), source=source)
+
+    def clear_observed_slow(self, rid: int) -> None:
+        """The detector reports the replica back under the line; the
+        next poll may return it to "healthy" (no event — recovery to
+        steady state is not a transition worth a counter)."""
+        self._observed_slow.discard(int(rid))
 
     def beat(self, rid: int, step: int, generation=None) -> bool:
         """Post one heartbeat for `rid` (tagged with the CURRENT
@@ -138,8 +167,13 @@ class ReplicaHealth:
             return False
         if step < self._slow_until.get(rid, -1):
             return False
-        self.kv.push(_HB_KEY % rid, NDArray(
-            _np.asarray([float(step), float(gen)], _np.float64)))
+        # the beat is a kvstore push tagged (replica, step, gen): on
+        # the merged cross-process timeline a replica's heartbeats are
+        # attributable spans, not anonymous store traffic (ISSUE 11)
+        with _tele.span("kv.heartbeat", replica=int(rid),
+                        step=int(step), gen=gen):
+            self.kv.push(_HB_KEY % rid, NDArray(
+                _np.asarray([float(step), float(gen)], _np.float64)))
         return True
 
     def beat_all(self, step: int, active, inject: bool = True) -> None:
@@ -198,6 +232,11 @@ class ReplicaHealth:
                 verdict = "slow"
             else:
                 verdict = "healthy"
+            if verdict == "healthy" and rid in self._observed_slow:
+                # the straggler detector condemned this replica from
+                # its published step times; fresh beats don't acquit
+                # it — only the detector clearing does
+                verdict = "slow"
             if self._state.get(rid) != verdict:
                 self._state[rid] = verdict
                 if verdict == "down":
@@ -253,6 +292,13 @@ class ElasticTrainer:
     continuation equal a from-checkpoint (N-1)-way run bit for bit.
     """
 
+    #: factor by which an injected mesh.replica_slow victim's PUBLISHED
+    #: step wall is inflated during its suppression window — the
+    #: single-controller stand-in for what a genuinely slow replica's
+    #: fleet-telemetry snapshot would report (its steps really take
+    #: longer); detection then runs the production skew arithmetic
+    SLOW_INJECT_FACTOR = 4.0
+
     def __init__(self, build_trainer: Callable, ckpt_dir: str,
                  devices=None, steps_per_epoch: Optional[int] = None,
                  min_replicas: Optional[int] = None, seed: int = 0,
@@ -260,7 +306,8 @@ class ElasticTrainer:
                  keep: Optional[int] = None, kv=None,
                  stale_steps=None, down_steps=None,
                  handle_sigterm: bool = True,
-                 audit_interval: Optional[int] = None):
+                 audit_interval: Optional[int] = None,
+                 fleet: Optional[bool] = None):
         from .. import config
         from ..kvstore import create as kv_create
         self._build = build_trainer
@@ -286,6 +333,14 @@ class ElasticTrainer:
         self.health = ReplicaHealth(self.kv, self.n_total,
                                     stale_steps=stale_steps,
                                     down_steps=down_steps)
+        # fleet telemetry (ISSUE 11): per-replica snapshots through
+        # THIS trainer's kvstore + the straggler detector feeding the
+        # health layer's slow-(observed) state.  Default on; fleet=False
+        # (or MXNET_FLEET_PUBLISH_STEPS=0) disables
+        if fleet is None:
+            fleet = int(config.get("MXNET_FLEET_PUBLISH_STEPS")) > 0
+        self.fleet = FleetTelemetry(self.kv, self.n_total) \
+            if fleet else None
         self.state = "healthy"
         self.transitions = []       # [{kind, step, wall_s, ...}]
         self.last_blackbox = None   # newest mesh-shrink dump path
@@ -331,6 +386,10 @@ class ElasticTrainer:
             self.resilient.resume()
         if preempted:
             self.resilient.request_preemption()
+        # the first step on a fresh trainer pays the compile: its wall
+        # is not a step time, and publishing it would pollute every
+        # replica's straggler window with a seconds-scale outlier
+        self._fleet_skip_next = True
 
     def _drain(self) -> None:
         """Drain in-flight work: block until the device state (params +
@@ -369,6 +428,10 @@ class ElasticTrainer:
         self.health.set_generation(self.kv.generation)
         for rid in lost:
             self.down[rid] = stepno
+            if self.fleet is not None:
+                # a removed replica's stale window must not skew the
+                # survivors' straggler baseline
+                self.fleet.detector.forget(rid)
         self.active = survivors
         old_step = self.trainer._n_step
         self._rebuild(resume=True)
@@ -481,8 +544,45 @@ class ElasticTrainer:
             self._audit(stepno, inject=first_visit)
             stepno = self.trainer._n_step
         batch, labels = data_fn(stepno, self.n_replicas)
+        t0 = time.perf_counter()
         loss, ok = self.resilient.step(batch, labels)
+        if self.fleet is not None:
+            self._fleet_round(stepno, time.perf_counter() - t0)
         return loss, ok
+
+    def _fleet_round(self, stepno: int, wall_s: float) -> None:
+        """Publish this step's per-replica telemetry and act on the
+        straggler verdicts.  Runs AFTER the step's dispatch returned
+        (the device is already busy; the host-side cost is a
+        dozen-float kvstore push per replica, at the
+        MXNET_FLEET_PUBLISH_STEPS cadence).
+
+        Single-controller stand-in: every replica's wall is the
+        measured step wall, except a `mesh.replica_slow` victim — its
+        published wall is inflated by SLOW_INJECT_FACTOR for its
+        suppression window, which is exactly what a genuinely slow
+        replica's own telemetry would report.  Detection and the
+        slow-(observed) feed then run the production path."""
+        if getattr(self, "_fleet_skip_next", False):
+            # compile step (fresh build/rebuild): not a step time
+            self._fleet_skip_next = False
+            return
+        per = {}
+        for rid in self.active:
+            us = wall_s * 1e6
+            if stepno < self.health._slow_until.get(rid, -1):
+                us *= self.SLOW_INJECT_FACTOR
+            per[rid] = us
+        try:
+            stragglers = self.fleet.update(stepno, per)
+        except Exception:           # noqa: BLE001 — observability must
+            return                  # never take the training loop down
+        for rid in stragglers:
+            if rid in self.active:
+                self.health.note_observed_slow(rid, stepno)
+        for rid in sorted(self.health._observed_slow):
+            if rid not in stragglers:
+                self.health.clear_observed_slow(rid)
 
     def _audit(self, stepno: int, inject: bool = True) -> None:
         rid_of = {self.devices[r]: r for r in self.active}

@@ -339,6 +339,10 @@ class ResilientTrainer:
         if self.spike_factor > 0 and self.loss_ema is not None:
             spike_thresh = self.spike_factor * self.loss_ema
 
+        # global-step stamp (ISSUE 11): every span completed during
+        # this step — here, in the feed, in the kvstore — carries the
+        # step id, the cross-process correlation key
+        _tele.set_global_step(stepno)
         step_span = _tele.span("train.step")
         step_span.start()
         t0 = time.perf_counter()

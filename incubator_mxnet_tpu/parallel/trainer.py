@@ -547,6 +547,10 @@ class ShardedTrainer:
             # compiling first step
             tele = self._tele = StepTelemetry(
                 own_traces=self._trace_count)
+        # global-step stamp (ISSUE 11): spans completed during this
+        # step (dispatch fan-out, kvstore, feed) carry the step id —
+        # the cross-process correlation key
+        _tele.set_global_step(self._n_step)
         t0 = time.perf_counter()
         batch = self._place_batch(batch, self._batch_sharding)
         labels = self._place_batch(
@@ -640,6 +644,12 @@ class ShardedTrainer:
         self.params = {}
         self.opt_state = None
         self._step = None
+        # the process-global step stamp this trainer was feeding is
+        # stale the moment training ends: a span emitted later (a
+        # serving request, a checkpoint verify) must not carry the
+        # dead run's step id into a cross-process (trace_id, step)
+        # join — the false-correlation failure mode of ISSUE 11
+        _tele.set_global_step(None)
         if self._dispatch is not None:
             self._dispatch.shutdown()
 

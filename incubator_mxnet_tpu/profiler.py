@@ -32,32 +32,39 @@ _LOCK = threading.Lock()
 _T0 = time.perf_counter()
 
 
-def _append_event(name, cat, t0_s, dur_s, args=None, ph="X"):
+def _append_event(name, cat, t0_s, dur_s, args=None, ph="X", pid=None,
+                  tid=None):
     """Build one chrome-trace event (shared ts/tid conventions) and
     append it to the sink unconditionally."""
     ev = {"name": name, "cat": cat, "ph": ph,
           "ts": (t0_s - _T0) * 1e6, "dur": dur_s * 1e6,
-          "pid": os.getpid(),
-          "tid": threading.get_ident() % 100000}
+          "pid": os.getpid() if pid is None else int(pid),
+          "tid": (threading.get_ident() % 100000) if tid is None
+          else int(tid)}
     if args:
         ev["args"] = dict(args)
     with _LOCK:
         _EVENTS.append(ev)
 
 
-def add_trace_event(name, cat, t0_s, dur_s, args=None, ph="X"):
+def add_trace_event(name, cat, t0_s, dur_s, args=None, ph="X",
+                    pid=None, tid=None):
     """Append one complete event to the shared chrome-trace sink.
     `t0_s` is a `time.perf_counter()` stamp (converted to this
     module's trace origin), `dur_s` seconds.  Telemetry spans use this
     so framework-thread intervals (feed transfers, serving dispatch,
     checkpoint writes) land on the SAME timeline `dump()` renders for
-    the op-dispatch events.  Dropped while the profiler is stopped —
-    the sink is unbounded, and a span that merely STARTED while it was
-    collecting (a long checkpoint straddling set_state('stop')) must
-    not grow it afterwards."""
+    the op-dispatch events.  `pid`/`tid` override the event's process/
+    thread row — `telemetry.emit_foreign` files a decode worker's span
+    under the WORKER's pid so the merged timeline shows it as its own
+    process.  Dropped while the profiler is stopped — the sink is
+    unbounded, and a span that merely STARTED while it was collecting
+    (a long checkpoint straddling set_state('stop')) must not grow it
+    afterwards."""
     if not _STATE["running"] or _STATE["paused"]:
         return
-    _append_event(name, cat, t0_s, dur_s, args=args, ph=ph)
+    _append_event(name, cat, t0_s, dur_s, args=args, ph=ph, pid=pid,
+                  tid=tid)
 
 
 def _listener(name, ctx, elapsed):

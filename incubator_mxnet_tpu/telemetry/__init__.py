@@ -36,22 +36,31 @@ dump.
 """
 from __future__ import annotations
 
-from .spans import (SpanContext, current, enable, enabled, recording,
-                    span)
+from .spans import (SpanContext, TraceContext, current, emit_foreign,
+                    enable, enabled, get_global_step, propagate,
+                    recording, set_global_step, span)
 from .export import MetricsExporter
 from .stepstats import StepTelemetry
 from . import costs
 from . import flightrec
+from . import fleet
+from .fleet import (FleetReporter, FleetTelemetry, FleetView,
+                    StragglerDetector)
 from .flightrec import dump_blackbox, install_crash_hooks
 
-__all__ = ["SpanContext", "span", "current", "enable", "enabled",
-           "recording", "MetricsExporter", "StepTelemetry", "start",
-           "stop", "get_exporter", "snapshot_dict", "costs",
-           "flightrec", "dump_blackbox", "install_crash_hooks"]
+__all__ = ["SpanContext", "TraceContext", "span", "current", "enable",
+           "enabled", "recording", "propagate", "set_global_step",
+           "get_global_step", "emit_foreign", "MetricsExporter",
+           "StepTelemetry", "start", "stop", "get_exporter",
+           "snapshot_dict", "costs", "flightrec", "fleet",
+           "FleetReporter", "FleetView", "FleetTelemetry",
+           "StragglerDetector", "dump_blackbox",
+           "install_crash_hooks"]
 
 #: counter families the condensed snapshot (bench.py JSON) carries
 SNAPSHOT_PREFIXES = ("serve.", "feed.", "train.", "aot.",
-                     "resilience.", "mem.", "fault.", "blackbox.")
+                     "resilience.", "mem.", "fault.", "blackbox.",
+                     "mesh.", "fleet.")
 
 _exporter = None
 

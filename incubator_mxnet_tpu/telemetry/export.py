@@ -219,6 +219,16 @@ class MetricsExporter:
                     out["costs"] = block
             except Exception:       # noqa: BLE001
                 pass
+            # the merged per-replica fleet view (ISSUE 11), when a
+            # supervisor registered one — teletop renders it as
+            # per-replica columns
+            try:
+                from . import flightrec as _bb
+                fleet = _bb.fleet_block()
+                if fleet and fleet.get("replicas"):
+                    out["fleet"] = fleet
+            except Exception:       # noqa: BLE001
+                pass
         return out
 
     def json_text(self) -> str:

@@ -50,11 +50,12 @@ from collections import deque
 from .. import config as _cfg
 from ..monitor import events
 
-__all__ = ["enabled", "enable", "record", "record_mesh", "ring_snapshot",
+__all__ = ["enabled", "enable", "record", "record_at", "record_mesh",
+           "ring_snapshot",
            "clear", "configure", "hbm_sample", "hbm_peaks",
            "sample_counters", "dump_blackbox", "crash_dump",
            "install_crash_hooks", "uninstall_crash_hooks",
-           "last_dump_path"]
+           "last_dump_path", "set_fleet_provider", "fleet_block"]
 
 SCHEMA = "mxtpu-blackbox/1"
 
@@ -73,6 +74,31 @@ CRASH_DUMP_MIN_GAP_S = 10.0
 # None = follow the MXNET_BLACKBOX knob; enable() installs an explicit
 # process-local override (the spans.py pattern)
 _enabled = None
+
+# fleet-view provider (ISSUE 11): telemetry/fleet.py registers a
+# zero-arg callable returning the merged per-replica telemetry block;
+# every dump embeds its result so a forensic file answers "which
+# replica was slow" without a live process to ask
+_FLEET = {"provider": None}
+
+
+def set_fleet_provider(fn):
+    """Register the callable whose result becomes the `fleet` block of
+    every black-box dump (None unregisters).  Best-effort at dump
+    time: a raising provider yields no block, never a failed dump."""
+    _FLEET["provider"] = fn
+
+
+def fleet_block():
+    """The registered fleet provider's current block (None when no
+    provider is set, the provider raised, or its supervisor is gone)."""
+    fn = _FLEET["provider"]
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception:               # noqa: BLE001 — the fleet view is
+        return None                 # forensic garnish, never a blocker
 
 
 def enabled() -> bool:
@@ -116,12 +142,25 @@ def configure(maxlen=None):
 
 def record(kind: str, name: str, **data):
     """Append one structured event to the ring.  The HOT path: one
-    bool read disabled; enabled, one tuple + one locked deque append —
-    no formatting, no serialization until dump time."""
+    bool read disabled (checked HERE, before the clock read and the
+    delegate call — the MXNET_BLACKBOX=0 contract); enabled, one
+    tuple + one locked deque append — no formatting, no serialization
+    until dump time."""
     if not enabled():
         return
-    ev = (time.time(), threading.get_ident(), kind, name,
-          data or None)
+    record_at(time.time(), kind, name, **data)
+
+
+def record_at(ts: float, kind: str, name: str, **data):
+    """`record()` with an explicit wall-clock stamp: a FOREIGN span
+    (telemetry.emit_foreign) describes an interval that ended in
+    another process BEFORE the message delivering it arrived — a
+    prefetched decode batch can sit in the queue for hundreds of ms,
+    and stamping delivery time would shift the slice right by the
+    whole queue wait in the dump's chrome view."""
+    if not enabled():
+        return
+    ev = (float(ts), threading.get_ident(), kind, name, data or None)
     _ring()                         # ensure it exists (locks itself)
     with _LOCK:
         # re-read under the lock: a concurrent configure() swaps the
@@ -256,11 +295,15 @@ def _config_snapshot():
 
 def _chrome_view(evs):
     """The event timeline as chrome://tracing JSON: span events render
-    as complete ('X') slices, everything else as instants."""
+    as complete ('X') slices, everything else as instants.  An event
+    carrying an explicit `pid` (a foreign span emitted on behalf of a
+    decode worker — telemetry.emit_foreign) keeps that pid, so the
+    trace shows the worker's interval in its own process row."""
     out = []
     for e in evs:
         base = {"name": "%s:%s" % (e["kind"], e["name"]),
-                "cat": e["kind"], "pid": os.getpid(), "tid": e["tid"]}
+                "cat": e["kind"], "pid": e.get("pid") or os.getpid(),
+                "tid": e["tid"]}
         dur = e.get("dur_us")
         if dur is not None:
             base.update(ph="X", ts=(e["ts"] * 1e6) - dur, dur=dur)
@@ -316,6 +359,7 @@ def dump_blackbox(path=None, reason="manual", exc=None, last=None):
         cost_block = _costs.snapshot()
     except Exception:               # noqa: BLE001 — cost attribution
         cost_block = {"rows": [], "totals": {}}  # is best-effort
+    fleet = fleet_block()
     evs = ring_snapshot(last=last)
     doc = {
         "schema": SCHEMA,
@@ -328,6 +372,7 @@ def dump_blackbox(path=None, reason="manual", exc=None, last=None):
         "percentiles": pcts,
         "labeled": labeled,
         "costs": cost_block,
+        "fleet": fleet,
         "hbm": {"peaks": hbm_peaks()},
         "events": evs,
         "trace": {"traceEvents": _chrome_view(evs),

@@ -36,10 +36,36 @@ gates:
 - the flight-recorder ring (flightrec.py), whenever the recorder is
   armed — the ring is bounded, so span completions survive into
   black-box dumps even on runs nobody is tracing.
+
+**Cross-process propagation (ISSUE 11).**  A fleet is many processes:
+decode workers, serving hosts, per-replica trainers.  Three additions
+make one request/step traceable across all of them:
+
+- `TraceContext` — the SERIALIZABLE form of a span context
+  (trace_id, parent span_id, global step): `propagate()` captures the
+  innermost open span + current global step as a plain tuple that
+  crosses any wire (a queue message, a kvstore key, an env var);
+  `TraceContext.from_wire()` rebuilds it on the far side, ready to be
+  passed as `parent=`.
+- `set_global_step(step)` — every span completed while a global step
+  is set carries `step` in its args/ring record, so traces from
+  DIFFERENT processes (trainer rank 0, a decode worker, a serving
+  host) correlate on the same step id even when their trace ids never
+  meet.  Trainers stamp it each step.
+- `emit_foreign(...)` — record a completed span ON BEHALF of another
+  process (a jax-free decode worker reports wall-clock timing in its
+  batch message; the consumer emits the span with the WORKER's pid,
+  re-parented under the consumer's current span).  The chrome view
+  then renders the worker's decode interval in its own process row of
+  the same timeline.
+
+Spans also take free-form tags: ``span("kv.push", gen=3, rank=0)`` —
+tags land in the chrome event args and the ring record.
 """
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 
@@ -47,11 +73,23 @@ from .. import config as _cfg
 from .. import profiler as _prof
 from . import flightrec as _bb
 
-__all__ = ["SpanContext", "enabled", "enable", "span", "current",
-           "recording"]
+__all__ = ["SpanContext", "TraceContext", "enabled", "enable", "span",
+           "current", "recording", "propagate", "set_global_step",
+           "get_global_step", "emit_foreign"]
 
 _ids = itertools.count(1)       # CPython-atomic next(); no lock needed
 _tls = threading.local()
+
+# per-process id salt: trace/span ids must be unique ACROSS processes
+# (ISSUE 11 — `blackbox merge` joins timelines on trace_id equality,
+# and a bare counter starting at 1 would collide between any two
+# processes, fabricating cross-process correlations).  pid + a time
+# component survives pid recycling within one merge's inputs.
+_PROC = "%08x" % ((os.getpid() << 12 ^ time.time_ns()) & 0xffffffff)
+
+
+def _new_id(prefix):
+    return "%s%s-%06x" % (prefix, _PROC, next(_ids))
 
 # None = follow the MXNET_TELEMETRY knob live (config.set / env work
 # like every other registered knob); enable() installs an explicit
@@ -102,6 +140,89 @@ class SpanContext:
                                                    self.span_id)
 
 
+class TraceContext(SpanContext):
+    """The SERIALIZABLE span context for crossing a process boundary:
+    (trace_id, parent span_id, global step).  `to_wire()` is a plain
+    tuple of primitives — safe in a multiprocessing queue message,
+    a kvstore payload, or JSON; `from_wire()` rebuilds it on the far
+    side, and the result is a valid `parent=` for `span()` /
+    `emit_foreign()` (it IS a SpanContext).  `step` rides along so the
+    receiver can adopt the sender's global step (`set_global_step`)
+    and its spans correlate on the same step id."""
+
+    __slots__ = ("step",)
+
+    def __init__(self, trace_id: str, span_id: str, step=None):
+        super().__init__(trace_id, span_id)
+        self.step = None if step is None else int(step)
+
+    def to_wire(self):
+        """(trace_id, span_id, step) — primitives only."""
+        return (self.trace_id, self.span_id, self.step)
+
+    @classmethod
+    def from_wire(cls, wire):
+        """Rebuild from `to_wire()` output (or any 2/3-tuple of
+        primitives).  None in, None out."""
+        if wire is None:
+            return None
+        t = tuple(wire)
+        return cls(str(t[0]), str(t[1]),
+                   t[2] if len(t) > 2 else None)
+
+    def __repr__(self):
+        return "TraceContext(trace=%s, span=%s, step=%s)" % (
+            self.trace_id, self.span_id, self.step)
+
+
+def propagate():
+    """The current position in the trace as a serializable
+    `TraceContext` (innermost open span on this thread + the global
+    step), for handing to ANOTHER PROCESS.  None when telemetry is
+    disabled or no span is open AND no global step is set — a bare
+    step still propagates (trace ids are minted lazily on the far
+    side)."""
+    ctx = current()
+    step = get_global_step()
+    if ctx is None and step is None:
+        return None
+    if ctx is None:
+        # no open span: mint a trace so the far side still correlates
+        return TraceContext(_new_id("t"),
+                            _new_id("s"), step)
+    return TraceContext(ctx.trace_id, ctx.span_id, step)
+
+
+# global step id (process-wide): trainers stamp it every step; every
+# span completed while it is set carries `step` in its args/ring
+# record, which is what lets traces from DIFFERENT processes correlate
+# on one step even when their trace ids never meet.  A plain attribute
+# write/read — torn reads are impossible for a python int slot, so no
+# lock on the hot path.
+_GSTEP = {"step": None}
+
+
+def set_global_step(step):
+    """Stamp the process's current global step id onto every span
+    completed from now on (None clears it).  Returns the previous
+    value so scoped users can restore.
+
+    Lifecycle contract: trainers stamp it each step and
+    `ShardedTrainer.release()` clears it — a stamp that outlives its
+    run would mark unrelated later spans (serving, checkpoint
+    verifies) with a dead step id and fabricate cross-process
+    correlations in `blackbox merge`.  Ad-hoc users (bench proofs,
+    tests) clear it themselves."""
+    prev = _GSTEP["step"]
+    _GSTEP["step"] = None if step is None else int(step)
+    return prev
+
+
+def get_global_step():
+    """The current global step id (None when unset)."""
+    return _GSTEP["step"]
+
+
 def _stack():
     st = getattr(_tls, "stack", None)
     if st is None:
@@ -143,19 +264,20 @@ _NULL = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("name", "ctx", "parent_id", "_t0")
+    __slots__ = ("name", "ctx", "parent_id", "tags", "_t0")
 
-    def __init__(self, name, parent):
+    def __init__(self, name, parent, tags=None):
         if parent is None:
             parent = current()
         if parent is not None:
             trace = parent.trace_id
             self.parent_id = parent.span_id
         else:
-            trace = "t%08x" % next(_ids)
+            trace = _new_id("t")
             self.parent_id = None
-        self.ctx = SpanContext(trace, "s%08x" % next(_ids))
+        self.ctx = SpanContext(trace, _new_id("s"))
         self.name = name
+        self.tags = tags
         self._t0 = None
 
     def __enter__(self):
@@ -184,6 +306,11 @@ class _Span:
                 "span_id": self.ctx.span_id}
         if self.parent_id is not None:
             args["parent_id"] = self.parent_id
+        step = _GSTEP["step"]
+        if step is not None:
+            args["step"] = step
+        if self.tags:
+            args.update(self.tags)
         # chrome sink: add_trace_event self-gates on the profiler state
         # (a span that STARTED while collecting must not grow the sink
         # after set_state('stop'))
@@ -191,18 +318,78 @@ class _Span:
         # flight-recorder ring: bounded, so span completions survive
         # into black-box dumps with NO profiler running (ISSUE 5) —
         # record() is one bool read when the recorder is disarmed
+        extra = dict(self.tags) if self.tags else {}
+        if step is not None:
+            extra["step"] = step
         _bb.record("span", self.name, dur_us=int(dur * 1e6),
                    trace=self.ctx.trace_id, span=self.ctx.span_id,
-                   parent=self.parent_id)
+                   parent=self.parent_id, **extra)
 
 
-def span(name: str, parent: SpanContext = None):
+def span(name: str, parent: SpanContext = None, **tags):
     """Open a span (use as a context manager, or `.start()`/`.stop()`).
-    `parent` joins an existing trace across threads; by default the
-    innermost open span on this thread is the parent.  Returns a shared
-    no-op when telemetry is disabled; enabled, the completion reaches
-    the chrome sink and/or the flight-recorder ring per their own
-    gates (see module docstring)."""
+    `parent` joins an existing trace across threads/processes (a
+    `SpanContext` or a deserialized `TraceContext`); by default the
+    innermost open span on this thread is the parent.  Free-form
+    `tags` (e.g. ``gen=3, rank=0``) ride in the completion's args and
+    ring record.  Returns a shared no-op when telemetry is disabled;
+    enabled, the completion reaches the chrome sink and/or the
+    flight-recorder ring per their own gates (see module docstring)."""
     if not enabled():
         return _NULL
-    return _Span(name, parent)
+    return _Span(name, parent, tags or None)
+
+
+def emit_foreign(name, t0_wall, dur_s, parent=None, pid=None, tid=None,
+                 **tags):
+    """Record a COMPLETED span on behalf of another process.
+
+    The fleet's jax-free workers (decode processes) cannot import the
+    telemetry stack; they report wall-clock timing in their messages
+    and the consumer calls this on delivery — the span lands in the
+    chrome sink / flight-recorder ring with the WORKER's `pid` (its
+    own process row in the merged timeline), re-parented under
+    `parent` (default: the consumer's innermost open span), and
+    stamped with the current global step.
+
+    `t0_wall` is a `time.time()` epoch stamp from the foreign process
+    (epoch time IS comparable across processes on one host, unlike
+    `perf_counter`); `dur_s` seconds.  Returns the new span's
+    `SpanContext` (None when telemetry is disabled)."""
+    if not enabled():
+        return None
+    if parent is None:
+        parent = current()
+    if parent is not None:
+        trace = parent.trace_id
+        parent_id = parent.span_id
+    else:
+        trace = _new_id("t")
+        parent_id = None
+    ctx = SpanContext(trace, _new_id("s"))
+    args = {"trace_id": trace, "span_id": ctx.span_id}
+    if parent_id is not None:
+        args["parent_id"] = parent_id
+    step = _GSTEP["step"]
+    if step is not None:
+        args["step"] = step
+    if tags:
+        args.update(tags)
+    # map the foreign epoch stamp onto this process's perf_counter
+    # origin (the chrome sink's timebase): both clocks advance at
+    # wall rate, so the offset is (now_wall - t0_wall) ago
+    t0_perf = time.perf_counter() - max(0.0, time.time() - t0_wall)
+    _prof.add_trace_event(name, "span", t0_perf, dur_s, args=args,
+                          pid=pid, tid=tid)
+    extra = dict(tags) if tags else {}
+    if step is not None:
+        extra["step"] = step
+    if pid is not None:
+        extra["pid"] = int(pid)
+    # stamp the ring event at the interval's true END (the foreign
+    # process's clock), not at delivery — a prefetched batch's decode
+    # slice must not shift right by its queue wait in the dump view
+    _bb.record_at(t0_wall + dur_s, "span", name,
+                  dur_us=int(dur_s * 1e6), trace=trace,
+                  span=ctx.span_id, parent=parent_id, **extra)
+    return ctx

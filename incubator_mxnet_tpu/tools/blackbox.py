@@ -27,6 +27,19 @@ keep-K directory it verifies every published ``step_*`` child.  Exit
 code 0 = everything verifiable; != 0 with a per-file / per-leaf report
 on any mismatch (the same `integrity.verify_checkpoint` the trainer's
 verify-on-load runs).
+
+`merge` (ISSUE 11) joins per-process chrome traces into ONE timeline —
+a fleet's forensics are N dumps from N processes, and the question is
+always "what was everyone doing at step K":
+
+    python -m ... merge --out fleet.trace.json rank0.json worker.json
+
+Inputs are black-box dumps (their embedded trace view is extracted) or
+raw chrome-trace JSONs.  Events keep their own pid rows (process_name
+metadata is added), and the summary reports the correlation keys: how
+many trace ids and global steps have spans from MORE than one process
+— the (trace_id, step) join this PR's propagation exists to make
+possible.  Exit code 0 on a merged output, 1 when nothing merged.
 """
 from __future__ import annotations
 
@@ -35,10 +48,10 @@ import json
 import sys
 import time
 
-from .teletop import _fmt_qty
+from .teletop import _fleet_lines, _fmt_qty
 
-__all__ = ["load_dump", "render", "suspected_cause", "verify_main",
-           "main"]
+__all__ = ["load_dump", "render", "suspected_cause", "merge_traces",
+           "verify_main", "merge_main", "main"]
 
 
 def load_dump(path: str) -> dict:
@@ -112,6 +125,23 @@ def suspected_cause(doc: dict) -> str:
                 "ledgered in the io-quarantine JSONL) — see "
                 "integrity/record_corrupt events for file/offset"
                 % c["io.decode.records_corrupt"])
+    # fleet skew OUTRANKS feed stall (ISSUE 11): one slow replica
+    # drags every synchronized step, which then LOOKS like input
+    # starvation on the survivors — blame the replica the detector
+    # named, not the pipeline feeding it
+    strag = [e for e in evs if e.get("kind") == "mesh"
+             and e.get("name") == "straggler"]
+    if strag or c.get("mesh.straggler"):
+        last = strag[-1] if strag else {}
+        fleet = (doc.get("fleet") or {})
+        who = last.get("replica",
+                       (fleet.get("stragglers") or ["?"])[0])
+        return ("fleet skew: replica %s is a straggler (windowed step "
+                "time %sµs vs fleet median %sµs) — a slow replica "
+                "bounds every synchronized step; check that replica's "
+                "host before blaming the input pipeline"
+                % (who, last.get("step_us", "?"),
+                   last.get("fleet_median_us", "?")))
     stall, step = c.get("feed.stall_us", 0), c.get("feed.step_us", 0)
     if stall and step and stall > step:
         return ("input-pipeline starvation: feed stalls (%.1fs) exceed "
@@ -188,8 +218,128 @@ def render(doc: dict, events_tail=40) -> str:
         for dev in sorted(peaks):
             lines.append("%-24s %s" % (dev, _fmt_qty(peaks[dev], "B")))
 
+    # the merged per-replica fleet view (ISSUE 11) — same table
+    # teletop renders live, embedded here so a dead run's dump still
+    # answers "which replica"
+    lines += _fleet_lines(doc.get("fleet"))
+
     lines += ["", "suspected cause: " + suspected_cause(doc)]
     return "\n".join(lines)
+
+
+# -- merge (ISSUE 11) --------------------------------------------------
+def _trace_events_of(path):
+    """The chrome-trace events of one input: a black-box dump's
+    embedded trace view, or a raw chrome-trace JSON ({"traceEvents":
+    [...]} or a bare event list)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):
+        return doc
+    if doc.get("schema", "").split("/")[0] == "mxtpu-blackbox":
+        return doc.get("trace", {}).get("traceEvents", [])
+    return doc.get("traceEvents", [])
+
+
+def merge_traces(paths, out_path=None) -> dict:
+    """Join per-process chrome traces into one timeline and report the
+    cross-process correlation keys.
+
+    Events keep their own pid (each process renders as its own row;
+    `process_name` metadata events are added).  The summary counts the
+    joins the fleet-tracing layer exists for: trace ids and global
+    steps whose spans come from MORE than one process.  Returns
+    ``{events, processes, cross_process_traces, cross_process_steps,
+    timebases, out}``.
+
+    Timebases: black-box dump trace views stamp events in EPOCH µs
+    (wall clock — genuinely comparable across processes on one host),
+    while a raw `profiler.dump()` trace stamps perf_counter-relative
+    µs from its own process origin.  Mixing the two cannot be aligned
+    without an offset only the producing process knew, so the merge
+    detects the base per input (`epoch` vs `relative`), reports it in
+    the summary, and WARNS on a mix instead of silently writing a
+    timeline whose rows sit decades apart."""
+    import sys as _sys
+    events = []
+    timebases = {}
+    for p in paths:
+        evs = _trace_events_of(p)
+        ts = sorted(e.get("ts", 0) for e in evs
+                    if e.get("ph") != "M")
+        mid = ts[len(ts) // 2] if ts else 0
+        # epoch-µs stamps are ~1.7e15; perf-relative ones live in the
+        # seconds-to-hours range
+        timebases[p] = "epoch" if mid > 1e12 else "relative"
+        events.extend(evs)
+    if len(set(timebases.values())) > 1:
+        print("blackbox merge: WARNING — inputs mix timebases %s; "
+              "epoch-stamped (dump) and process-relative (profiler "
+              "dump) events cannot share one timeline without an "
+              "offset only the producer knew. Merge dumps with "
+              "dumps, or profiler traces with profiler traces."
+              % timebases, file=_sys.stderr)
+    pids, traces, steps = set(), {}, {}
+    for e in events:
+        pid = e.get("pid")
+        pids.add(pid)
+        args = e.get("args") or {}
+        # the profiler sink spells it trace_id; the flight-recorder
+        # ring's chrome view spells it trace — join on either
+        tr = args.get("trace_id", args.get("trace"))
+        if tr is not None:
+            traces.setdefault(tr, set()).add(pid)
+        st = args.get("step")
+        if st is not None:
+            steps.setdefault(int(st), set()).add(pid)
+    events.sort(key=lambda e: e.get("ts", 0))
+    meta = [{"ph": "M", "name": "process_name", "pid": p,
+             "args": {"name": "pid %s" % p}} for p in sorted(
+                 p for p in pids if p is not None)]
+    merged = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(merged, f)
+    return {
+        "events": len(events),
+        "processes": sorted(p for p in pids if p is not None),
+        "cross_process_traces": sorted(
+            t for t, ps in traces.items() if len(ps) > 1),
+        "cross_process_steps": sorted(
+            s for s, ps in steps.items() if len(ps) > 1),
+        "timebases": timebases,
+        "out": out_path,
+    }
+
+
+def merge_main(argv) -> int:
+    """``blackbox merge`` body: merge N dumps/traces into one chrome
+    trace + print the correlation summary.  rc 0 = merged events
+    written; 1 = nothing to merge."""
+    ap = argparse.ArgumentParser(
+        prog="blackbox merge",
+        description="join per-process chrome traces (black-box dumps "
+                    "or raw trace JSONs) into one timeline keyed on "
+                    "(trace_id, step)")
+    ap.add_argument("inputs", nargs="+",
+                    help="black-box dumps and/or chrome-trace JSONs")
+    ap.add_argument("--out", default="merged.trace.json",
+                    help="merged chrome-trace output path "
+                    "(default merged.trace.json)")
+    args = ap.parse_args(argv)
+    try:
+        summary = merge_traces(args.inputs, out_path=args.out)
+    except Exception as e:          # noqa: BLE001 — operator tool
+        print("blackbox merge: %s" % e, file=sys.stderr)
+        return 1
+    print("merged %d event(s) from %d input(s) -> %s"
+          % (summary["events"], len(args.inputs), args.out))
+    print("processes: %s" % (summary["processes"] or "none"))
+    print("trace ids spanning >1 process: %d"
+          % len(summary["cross_process_traces"]))
+    print("global steps spanning >1 process: %s"
+          % (summary["cross_process_steps"] or "none"))
+    return 0 if summary["events"] else 1
 
 
 def verify_main(argv) -> int:
@@ -249,10 +399,13 @@ def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "verify":
         return verify_main(argv[1:])
+    if argv and argv[0] == "merge":
+        return merge_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="blackbox",
         description="summarize a flight-recorder black-box dump "
-                    "(or: blackbox verify <ckpt_dir>)")
+                    "(or: blackbox verify <ckpt_dir> / "
+                    "blackbox merge <dumps...>)")
     ap.add_argument("dump", help="black-box dump JSON path")
     ap.add_argument("--events", type=int, default=40, metavar="N",
                     help="timeline tail length (default 40)")

@@ -85,6 +85,11 @@ def _derived(c):
     if req or rej:
         out.append(("serve rejected", "%d (%.2f%% of %d accepted+rej)"
                     % (rej, _ratio(rej, req + rej) or 0.0, req + rej)))
+    if c.get("mesh.straggler"):
+        out.append(("fleet stragglers", "%d flagged (%d recovered) — "
+                    "see the fleet table / mesh.straggler events"
+                    % (c["mesh.straggler"],
+                       c.get("mesh.straggler_recovered", 0))))
     if c.get("blackbox.dumps"):
         out.append(("blackbox dumps", "%d written this process"
                     % c["blackbox.dumps"]))
@@ -130,6 +135,34 @@ def _cost_lines(costs):
     return lines
 
 
+def _fleet_lines(fleet):
+    """The merged per-replica fleet view (ISSUE 11) as one table:
+    a row per replica — step, step/dispatch/collective µs, HBM peak,
+    aot stale count — with stragglers marked ``*SLOW*``."""
+    reps = (fleet or {}).get("replicas") or {}
+    if not reps:
+        return []
+    stragglers = {str(r) for r in fleet.get("stragglers", ())}
+    lines = ["", "fleet (per replica%s)" % (
+        ", straggler window=%s sigma=%s"
+        % (fleet.get("straggler_window", "?"),
+           fleet.get("straggler_sigma", "?"))),
+        "%-8s %8s %10s %10s %10s %10s %8s %s"
+        % ("replica", "step", "step_us", "disp_us", "coll_us",
+           "hbm_peak", "aot_st", ""),
+        "-" * 78]
+    for rid in sorted(reps, key=lambda r: int(r)):
+        row = reps[rid]
+        lines.append(
+            "%-8s %8d %10d %10d %10d %10s %8d %s"
+            % (rid, row.get("step", 0), row.get("step_us", 0),
+               row.get("dispatch_us", 0), row.get("collective_us", 0),
+               _fmt_qty(row.get("hbm_peak_bytes", 0), "B"),
+               row.get("aot_stale", 0),
+               "*SLOW*" if rid in stragglers else ""))
+    return lines
+
+
 def render(snap: dict, prefix: str = "") -> str:
     """The snapshot as one fixed-width table block."""
     counters = {k: v for k, v in snap.get("counters", {}).items()
@@ -170,6 +203,8 @@ def render(snap: dict, prefix: str = "") -> str:
         # exporter snapshot carries rows+totals — render what's there
         lines += _cost_lines(costs if "rows" in costs
                              else {"rows": [], "totals": costs})
+
+    lines += _fleet_lines(snap.get("fleet"))
 
     derived = _derived(snap.get("counters", {}))
     if derived:

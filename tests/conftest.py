@@ -96,6 +96,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "scaling: ZeRO sharding / weak-scaling tests "
         "(CPU-fast, run in tier-1 by default)")
+    # fleet observability (ISSUE 11): cross-process trace propagation,
+    # kvstore-aggregated per-replica telemetry, straggler detection
+    config.addinivalue_line(
+        "markers", "fleet: fleet-observability tests (CPU-fast, run "
+        "in tier-1 by default)")
 
 
 @pytest.fixture(autouse=True)

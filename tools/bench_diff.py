@@ -1,0 +1,214 @@
+"""bench_diff — compare two BENCH_*/MULTICHIP_* JSONs (ISSUE 11).
+
+The bench trajectory (BENCH_r01..r05, BENCH_serve, MULTICHIP_*) is a
+series of one-line JSON records nobody diffs systematically — a 20%
+serve-p99 regression rides a green PR unless a human happens to stare
+at the right key.  This tool makes the comparison mechanical:
+
+    python tools/bench_diff.py BENCH_r04.json BENCH_r05.json
+    python tools/bench_diff.py old.json new.json --threshold 15
+    python tools/bench_diff.py a.json b.json --keys serve_
+
+Both inputs are flattened to dotted numeric keys and compared
+per-key.  Direction is inferred from the key name — `_us`/`_s`/`p99`/
+`wall`/`stall`/`stale`… are lower-better, `im_s`/`eff`/`throughput`/
+`hit`/`scaling`… higher-better — and a directional key moving the BAD
+way by more than `--threshold` percent (default 10) is a REGRESSION:
+printed, counted, and reflected in the exit code (rc 1).  Keys whose
+direction the heuristic can't judge are reported as `?` and never
+gate.  Boolean keys gate directly: a `true`→`false` flip (an `ok`
+flag dying) is always a regression.
+
+Exit codes: 0 = no regression, 1 = regression(s), 2 = unusable input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+__all__ = ["flatten", "direction_of", "diff", "main"]
+
+#: failure-count fragments: unambiguously lower-better, checked FIRST
+#: (io.decode.records_corrupt must not read as higher-better because
+#: "records" also names a throughput key)
+BAD_COUNT = ("corrupt", "stale", "miss", "lost", "skipped", "shed",
+             "rejected", "expired", "restarts", "straggler", "dropped",
+             "rollback", "errors", "stall", "overhead", "dumps")
+#: unambiguous TIME fragments, checked before the rate fragments: a
+#: key ending in _us/_ms or carrying a percentile IS a duration even
+#: when a rate-ish word also appears in it (weak_scaling_breakdown.*.
+#: step_us would otherwise read higher-better via "scaling" and
+#: invert the verdict on an improved step time)
+STRONG_LOWER = ("_us", "_ms", "p50", "p90", "p99", "p999")
+#: fragments implying "bigger is better" (rates, efficiencies, hits) —
+#: checked before the WEAK time suffixes so `im_s`/`samples_s` don't
+#: read as durations
+HIGHER_BETTER = ("im_s", "imgs_s", "samples_s", "tokens_s", "_per_s",
+                 "per_sec", "throughput", "eff", "rate", "hit",
+                 "gain", "scaling", "fraction_of_synthetic",
+                 "speedup", "capacity", "records")
+#: weak lower-better fragments (ambiguous `_s` handled after the rate
+#: fragments above)
+LOWER_BETTER = ("_s", "wall", "latency", "wait", "compile")
+#: keys whose VALUES are step times even though the key name says
+#: "scaling": the MULTICHIP weak_scaling{,_legacy} dicts map replica
+#: count -> step µs
+_SCALING_TIME_RE = None     # compiled lazily (keeps import light)
+
+
+def flatten(doc, prefix="", out=None):
+    """Nested dict/list -> {dotted.key: numeric-or-bool leaf}.  Non-
+    numeric leaves (strings, None) are dropped — they carry no
+    comparable magnitude."""
+    if out is None:
+        out = {}
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            flatten(v, prefix + str(k) + ".", out)
+    elif isinstance(doc, (list, tuple)):
+        for i, v in enumerate(doc):
+            flatten(v, prefix + "%d." % i, out)
+    elif isinstance(doc, bool):
+        out[prefix[:-1]] = doc
+    elif isinstance(doc, (int, float)):
+        out[prefix[:-1]] = float(doc)
+    return out
+
+
+def direction_of(key: str):
+    """'lower' / 'higher' / None (unjudgeable).  Priority: failure
+    counts > unambiguous time units (_us/_ms/percentiles, plus the
+    MULTICHIP weak_scaling step-time dicts) > rate/efficiency
+    fragments > weak time suffixes — see the fragment-table comments
+    for the tie cases each tier resolves."""
+    global _SCALING_TIME_RE
+    import re
+    k = key.lower()
+    # identifier keys: replica/worker/step IDs are labels, not
+    # magnitudes (elastic_lost_replica 3 -> 7 is a different victim,
+    # not a regression) — never judged directionally
+    if k.endswith(("_replica", "_rid", "_wid", "_step", "_batch",
+                   "_devices", "_level", "_seed")):
+        return None
+    if any(f in k for f in BAD_COUNT):
+        return "lower"
+    if any(f in k for f in STRONG_LOWER):
+        return "lower"
+    if _SCALING_TIME_RE is None:
+        _SCALING_TIME_RE = re.compile(
+            r"(^|\.)weak_scaling(_legacy)?\.\d+$")
+    if _SCALING_TIME_RE.search(k):
+        return "lower"
+    if any(f in k for f in HIGHER_BETTER):
+        return "higher"
+    if any(f in k for f in LOWER_BETTER):
+        return "lower"
+    return None
+
+
+def diff(old: dict, new: dict, threshold_pct: float = 10.0) -> dict:
+    """Per-key deltas between two flattened docs.  Returns
+    ``{rows: [...], regressions: [...], added: [...], removed: [...]}``
+    — a row is (key, old, new, pct, direction, verdict)."""
+    rows, regressions = [], []
+    for key in sorted(set(old) & set(new)):
+        a, b = old[key], new[key]
+        if isinstance(a, bool) or isinstance(b, bool):
+            verdict = ""
+            if bool(a) and not bool(b):
+                verdict = "REGRESSION"
+                regressions.append(key)
+            elif bool(b) and not bool(a):
+                verdict = "improved"
+            rows.append((key, a, b, None, "bool", verdict))
+            continue
+        if a == b:
+            continue
+        pct = 100.0 * (b - a) / abs(a) if a else float("inf")
+        d = direction_of(key)
+        verdict = ""
+        if d is not None and abs(pct) > threshold_pct:
+            worse = pct > 0 if d == "lower" else pct < 0
+            verdict = "REGRESSION" if worse else "improved"
+            if worse:
+                regressions.append(key)
+        rows.append((key, a, b, pct, d or "?", verdict))
+    return {"rows": rows, "regressions": regressions,
+            "added": sorted(set(new) - set(old)),
+            "removed": sorted(set(old) - set(new))}
+
+
+def _fmt_val(v):
+    if isinstance(v, bool):
+        return str(v).lower()
+    if float(v).is_integer() and abs(v) < 1e15:
+        return "%d" % int(v)
+    return "%.4g" % v
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_diff",
+        description="per-key delta of two BENCH_*/MULTICHIP_* JSONs; "
+        "rc 1 when a directional key regressed past --threshold")
+    ap.add_argument("old", help="baseline JSON")
+    ap.add_argument("new", help="candidate JSON")
+    ap.add_argument("--threshold", type=float, default=10.0,
+                    metavar="PCT",
+                    help="regression threshold in percent "
+                    "(default 10)")
+    ap.add_argument("--keys", default="", metavar="PREFIX",
+                    help="only compare dotted keys with this prefix")
+    ap.add_argument("--all", action="store_true",
+                    help="print unchanged-direction rows too "
+                    "(default: only rows past the threshold or with "
+                    "a verdict)")
+    args = ap.parse_args(argv)
+    docs = []
+    for path in (args.old, args.new):
+        try:
+            with open(path) as f:
+                docs.append(flatten(json.load(f)))
+        except Exception as e:      # noqa: BLE001 — operator tool
+            print("bench_diff: cannot read %s: %s" % (path, e),
+                  file=sys.stderr)
+            return 2
+    old, new = docs
+    if args.keys:
+        old = {k: v for k, v in old.items() if k.startswith(args.keys)}
+        new = {k: v for k, v in new.items() if k.startswith(args.keys)}
+    res = diff(old, new, threshold_pct=args.threshold)
+    print("%-52s %14s %14s %9s %7s %s"
+          % ("key", "old", "new", "delta%", "dir", "verdict"))
+    print("-" * 104)
+    shown = 0
+    for key, a, b, pct, d, verdict in res["rows"]:
+        if not args.all and not verdict and \
+                (pct is None or abs(pct) <= args.threshold):
+            continue
+        shown += 1
+        print("%-52s %14s %14s %9s %7s %s"
+              % (key[:52], _fmt_val(a), _fmt_val(b),
+                 "-" if pct is None else "%+.1f" % pct, d, verdict))
+    if not shown:
+        print("(no deltas past %.1f%%)" % args.threshold)
+    if res["added"]:
+        print("added keys: %d (%s%s)"
+              % (len(res["added"]), ", ".join(res["added"][:6]),
+                 ", ..." if len(res["added"]) > 6 else ""))
+    if res["removed"]:
+        print("removed keys: %d (%s%s)"
+              % (len(res["removed"]), ", ".join(res["removed"][:6]),
+                 ", ..." if len(res["removed"]) > 6 else ""))
+    if res["regressions"]:
+        print("FAIL: %d regression(s) past %.1f%%: %s"
+              % (len(res["regressions"]), args.threshold,
+                 ", ".join(res["regressions"][:10])), file=sys.stderr)
+        return 1
+    print("OK: no regressions past %.1f%%" % args.threshold)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

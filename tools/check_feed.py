@@ -55,10 +55,12 @@ import sys
 import time
 
 # runnable as `python tools/check_feed.py` from anywhere: the repo
-# root (this file's parent's parent) must be importable
+# root (this file's parent's parent) must be importable, and tools/
+# itself for the shared gate_report helper
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if _ROOT not in sys.path:
-    sys.path.insert(0, _ROOT)
+for _p in (_ROOT, os.path.join(_ROOT, "tools")):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
 
 # the probes fork from THIS process, so it must never initialize an
 # XLA runtime first: a DMLC_* cluster env would make the package
@@ -179,14 +181,21 @@ def main(argv=None) -> int:
                     "process ceiling the service must deliver")
     args = ap.parse_args(argv)
 
+    from gate_report import write_report
+    params = {"threshold": args.threshold, "frac": args.frac,
+              "repeats": args.repeats, "trials": args.trials}
     cpu = os.cpu_count() or 1
     if cpu < 2:
         print("SKIP: single-core host (nothing to scale with)")
+        write_report("check_feed", "skip", [], rc=0, params=params,
+                     extra={"skip_reason": "single-core host"})
         return 0
     from incubator_mxnet_tpu.io.decode_service import service_available
     if not service_available():
         print("SKIP: decode service unavailable on this host "
               "(no shared memory / process spawn)")
+        write_report("check_feed", "skip", [], rc=0, params=params,
+                     extra={"skip_reason": "service unavailable"})
         return 0
     workers = args.workers or min(4, cpu)
     path = _ensure_rec()
@@ -228,6 +237,12 @@ def main(argv=None) -> int:
     print("per-trial scaling: [%s]  median=%.2fx"
           % (", ".join("%.2fx" % s for _, s, _ in results),
              statistics.median(s for _, s, _ in results)))
+    trial_rows = [{
+        "trial": t, "ceiling_x": round(c, 3), "scaling_x": round(s, 3),
+        "required_x": round(q, 3),
+        "verdict": "inconclusive" if c < 1.25
+        else ("pass" if s >= q else "fail")}
+        for t, (c, s, q) in enumerate(results)]
     measurable = [(c, s, q) for c, s, q in results if c >= 1.25]
     if not measurable:
         print("SKIP: host delivered no usable parallelism in any "
@@ -235,8 +250,16 @@ def main(argv=None) -> int:
               "shared/throttled VM"
               % (", ".join("%.2fx" % c for c, _, _ in results),
                  workers, cpu))
+        write_report("check_feed", "skip", trial_rows, rc=0,
+                     params=params,
+                     extra={"skip_reason": "no usable parallelism",
+                            "workers": workers})
         return 0
-    if not any(s >= q for _, s, q in measurable):
+    failed = not any(s >= q for _, s, q in measurable)
+    write_report("check_feed", "fail" if failed else "pass",
+                 trial_rows, rc=1 if failed else 0, params=params,
+                 extra={"workers": workers})
+    if failed:
         print("FAIL: decode-service worker scaling below threshold "
               "in all %d measurable trial(s)" % len(measurable),
               file=sys.stderr)

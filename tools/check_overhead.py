@@ -35,10 +35,12 @@ import sys
 import time
 
 # runnable as `python tools/check_overhead.py` from anywhere: the repo
-# root (this file's parent's parent) must be importable
+# root (this file's parent's parent) must be importable, and tools/
+# itself for the shared gate_report helper
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if _ROOT not in sys.path:
-    sys.path.insert(0, _ROOT)
+for _p in (_ROOT, os.path.join(_ROOT, "tools")):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
 
 
 def _build(hidden, batch, in_dim=64, classes=10, seed=11):
@@ -99,7 +101,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     import statistics
+    from gate_report import write_report
     overheads = []
+    trial_rows = []
     for t in range(max(1, args.trials)):
         best = {False: float("inf"), True: float("inf")}
         for r in range(args.repeats):
@@ -112,6 +116,12 @@ def main(argv=None) -> int:
                       % (t, r, mode, wall, args.steps / wall))
         overhead = 100.0 * (best[True] - best[False]) / best[False]
         overheads.append(overhead)
+        trial_rows.append({
+            "trial": t, "best_off_s": round(best[False], 4),
+            "best_on_s": round(best[True], 4),
+            "overhead_pct": round(overhead, 3),
+            "verdict": "pass" if overhead <= args.threshold
+            else "fail"})
         print("trial %d: best off=%.3fs on=%.3fs overhead=%.2f%% "
               "(threshold %.2f%%)"
               % (t, best[False], best[True], overhead, args.threshold))
@@ -120,7 +130,15 @@ def main(argv=None) -> int:
     print("per-trial overhead: [%s]  median=%.2f%%  best=%.2f%%"
           % (", ".join("%.2f%%" % o for o in overheads),
              statistics.median(overheads), min(overheads)))
-    if min(overheads) > args.threshold:
+    failed = min(overheads) > args.threshold
+    write_report(
+        "check_overhead", "fail" if failed else "pass", trial_rows,
+        rc=1 if failed else 0,
+        params={"threshold_pct": args.threshold, "steps": args.steps,
+                "repeats": args.repeats, "trials": args.trials},
+        extra={"median_overhead_pct": round(
+            statistics.median(overheads), 3)})
+    if failed:
         print("FAIL: flight-recorder overhead above threshold in all "
               "%d trial(s)" % len(overheads), file=sys.stderr)
         return 1

@@ -41,8 +41,9 @@ import sys
 import time
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if _ROOT not in sys.path:
-    sys.path.insert(0, _ROOT)
+for _p in (_ROOT, os.path.join(_ROOT, "tools")):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
 
 _CHILD_MARK = "_CHECK_SCALING_CHILD"
 
@@ -135,18 +136,27 @@ def main(argv=None) -> int:
                     "that serializes the collectives lands ~1.0)")
     args = ap.parse_args(argv)
 
+    from gate_report import write_report
+    params = {"replicas": args.replicas, "trials": args.trials,
+              "repeats": args.repeats, "eff_gain": args.eff_gain,
+              "step_gain": args.step_gain}
     cores = os.cpu_count() or 1
     if cores < 2:
         print("SKIP: single-core host (nothing to scale with)")
+        write_report("check_scaling", "skip", [], rc=0, params=params,
+                     extra={"skip_reason": "single-core host"})
         return 0
 
     verdicts = []
+    trial_rows = []
     for trial in range(args.trials):
         try:
             r = _run_trial(args.replicas, args.repeats)
         except Exception as e:          # noqa: BLE001
             print("trial %d: ERROR %s" % (trial, e))
             verdicts.append(None)
+            trial_rows.append({"trial": trial, "verdict": "error",
+                               "error": str(e)[:200]})
             continue
         eff_new = r["t1_overlap"] / r["tN_overlap"]
         eff_old = r["t1_legacy"] / r["tN_legacy"]
@@ -159,6 +169,13 @@ def main(argv=None) -> int:
         ok = usable and (eff_gain >= args.eff_gain
                          or step_gain >= args.step_gain)
         verdicts.append(ok if usable else None)
+        trial_rows.append({
+            "trial": trial, "eff_overlap": round(eff_new, 4),
+            "eff_legacy": round(eff_old, 4),
+            "eff_gain": round(eff_gain, 3),
+            "step_gain": round(step_gain, 3),
+            "verdict": "inconclusive" if not usable
+            else ("pass" if ok else "fail")})
         print("trial %d: eff overlap=%.3f legacy=%.3f gain=%.2fx "
               "(bar %.2f) | step@%d gain=%.2fx (bar %.2f)%s -> %s"
               % (trial, eff_new, eff_old, eff_gain, args.eff_gain,
@@ -168,12 +185,19 @@ def main(argv=None) -> int:
         if ok:
             print("PASS: overlap-first path beats the serial-dispatch "
                   "baseline")
+            write_report("check_scaling", "pass", trial_rows, rc=0,
+                         params=params)
             return 0
     if all(v is None for v in verdicts):
         print("SKIP: no trial got usable parallelism from this host")
+        write_report("check_scaling", "skip", trial_rows, rc=0,
+                     params=params,
+                     extra={"skip_reason": "no usable parallelism"})
         return 0
     print("FAIL: overlap-first path did not beat the serial-dispatch "
           "baseline in %d trials" % args.trials)
+    write_report("check_scaling", "fail", trial_rows, rc=1,
+                 params=params)
     return 1
 
 

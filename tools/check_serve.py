@@ -38,10 +38,12 @@ import threading
 import time
 
 # runnable as `python tools/check_serve.py` from anywhere: the repo
-# root (this file's parent's parent) must be importable
+# root (this file's parent's parent) must be importable, and tools/
+# itself for the shared gate_report helper
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if _ROOT not in sys.path:
-    sys.path.insert(0, _ROOT)
+for _p in (_ROOT, os.path.join(_ROOT, "tools")):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
 
 
 def _build(hidden=256, in_dim=64, classes=10, seed=7):
@@ -138,7 +140,13 @@ def _trial(t, duration, deadline_ms, hi_frac, seed):
              shed_frac, "" if measurable else "  [not measurable]"))
     ok = measurable and hi_p99_ms <= deadline_ms \
         and 0.02 <= shed_frac <= 0.98
-    return measurable, ok
+    return measurable, ok, {
+        "capacity_per_s": round(cap, 1),
+        "achieved_per_s": round(achieved, 1),
+        "hi_p99_ms": round(hi_p99_ms, 2)
+        if hi_p99_ms != float("inf") else None,
+        "deadline_ms": round(deadline_ms, 1),
+        "shed_frac": round(shed_frac, 4), "n_hi": n_hi}
 
 
 def main(argv=None) -> int:
@@ -161,24 +169,40 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=11)
     args = ap.parse_args(argv)
 
+    from gate_report import write_report
+    params = {"duration_s": args.duration,
+              "deadline_ms": args.deadline_ms,
+              "hi_frac": args.hi_frac, "trials": args.trials}
     if (os.cpu_count() or 1) < 2:
         print("SKIP: single-core host (submitter, dispatcher and "
               "executable share one core — no timing bound is "
               "meaningful)")
+        write_report("check_serve", "skip", [], rc=0, params=params,
+                     extra={"skip_reason": "single-core host"})
         return 0
 
     results = []
     for t in range(max(1, args.trials)):
         results.append(_trial(t, args.duration, args.deadline_ms,
                               args.hi_frac, args.seed))
-        if results[-1] == (True, True):
+        if results[-1][:2] == (True, True):
             break
-    measurable = [ok for m, ok in results if m]
+    trial_rows = [dict(detail, trial=t,
+                       verdict="inconclusive" if not m
+                       else ("pass" if ok else "fail"))
+                  for t, (m, ok, detail) in enumerate(results)]
+    measurable = [ok for m, ok, _ in results if m]
     if not measurable:
         print("SKIP: no trial achieved 2x overload (starved "
               "submitter) — shared/throttled VM")
+        write_report("check_serve", "skip", trial_rows, rc=0,
+                     params=params,
+                     extra={"skip_reason": "overload not achieved"})
         return 0
-    if not any(measurable):
+    failed = not any(measurable)
+    write_report("check_serve", "fail" if failed else "pass",
+                 trial_rows, rc=1 if failed else 0, params=params)
+    if failed:
         print("FAIL: hi-lane p99 or shed fraction out of bounds in "
               "all %d measurable trial(s)" % len(measurable),
               file=sys.stderr)

@@ -1,0 +1,89 @@
+"""gate_report — per-run JSON artifacts for the CI gates (ISSUE 11).
+
+check_overhead / check_feed flake ~50% on shared VMs regardless of the
+tree (a burst of stolen CPU during the measured window reads as
+overhead / anti-scaling).  Today that rate is folklore; with
+``MXNET_GATE_REPORT_DIR`` set, every gate run leaves one JSON artifact
+— per-trial numbers, each trial's pass/skip/inconclusive verdict, and
+the overall rc — so the flake rate becomes a TREND a human (or
+`bench_diff`) can read across runs:
+
+    MXNET_GATE_REPORT_DIR=/ci/gates python tools/check_overhead.py
+    ls /ci/gates   # check_overhead-20260804T101500-p1234.json, ...
+
+Files are atomically written and timestamp+pid-named, so concurrent
+and repeated runs accumulate instead of clobbering.  Unset dir = no
+artifact, no cost (the gates' default behaviour is unchanged).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+
+__all__ = ["report_dir", "write_report"]
+
+SCHEMA = "mxtpu-gate-report/1"
+
+# per-process ordinal in the artifact name: two write_report calls in
+# the same second from one process (a fast SKIP retried, a test
+# driving a gate twice) must ACCUMULATE, not os.replace each other
+_SEQ = itertools.count(1)
+
+
+def report_dir():
+    """The artifact directory (MXNET_GATE_REPORT_DIR; empty = off).
+    Read from the environment directly — the gates run standalone and
+    must not require package import for their bookkeeping."""
+    return os.environ.get("MXNET_GATE_REPORT_DIR", "")
+
+
+def write_report(gate, verdict, trials, rc=None, params=None,
+                 extra=None):
+    """Write one gate-run artifact (no-op returning None when
+    MXNET_GATE_REPORT_DIR is unset).
+
+    gate:    gate name ("check_overhead", ...)
+    verdict: "pass" | "fail" | "skip"
+    trials:  list of per-trial dicts — each should carry the trial's
+             measured numbers and its own "verdict"
+             (pass/fail/inconclusive/skip)
+    rc:      the exit code about to be returned
+    params:  the thresholds/knobs this run judged against
+    extra:   anything else worth trending (host cores, ...)
+
+    Returns the written path.  Best-effort: an unwritable dir warns on
+    stderr but never fails the gate — the artifact exists to observe
+    the gate, not to add a failure mode to it."""
+    d = report_dir()
+    if not d:
+        return None
+    doc = {
+        "schema": SCHEMA,
+        "gate": str(gate),
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "host_cores": os.cpu_count() or 0,
+        "verdict": str(verdict),
+        "rc": rc,
+        "trials": list(trials or ()),
+        "params": dict(params or {}),
+    }
+    if extra:
+        doc.update(extra)
+    path = os.path.join(d, "%s-%s-p%d-%03d.json" % (
+        gate, time.strftime("%Y%m%dT%H%M%S"), os.getpid(),
+        next(_SEQ)))
+    try:
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2, default=str)
+        os.replace(tmp, path)
+        return path
+    except OSError as e:
+        import sys
+        print("gate_report: cannot write %s: %s" % (path, e),
+              file=sys.stderr)
+        return None
