@@ -644,6 +644,13 @@ def run_serve(n_images=512, max_batch=32, seed=0, extra=None):
     base_rate = n_images / (time.perf_counter() - t0)
 
     # ---- engine: warm every bucket, then a mixed-size request stream
+    def _stale_reasons():
+        # the labeled aot.stale reason counts (ISSUE 11 satellite):
+        # {reason: cumulative count} from the classifier's labelsets
+        return {row["labels"].get("reason", "?"): row["value"]
+                for row in events.labeled_snapshot().get("aot.stale",
+                                                         ())}
+    stale0 = _stale_reasons()
     eng = net.inference_engine(ctx=ctx, max_batch=max_batch,
                                queue_cap=max(64, n_images))
     warm = eng.warmup(example_shape=(3, 32, 32), wire_dtype="float32")
@@ -693,6 +700,14 @@ def run_serve(n_images=512, max_batch=32, seed=0, extra=None):
         "serve_traces_after_warmup_delta":
             events.get("serve.traces") - traces0,
     }
+    # the labeled stale-reason split (ISSUE 12 satellite): the
+    # BENCH_serve "aot.stale: 7" smoking gun becomes per-reason keys —
+    # 'stale' is a lower-better fragment, so bench_diff trends a
+    # reason-count increase as the regression it is
+    stale = {k: v - stale0.get(k, 0) for k, v in
+             _stale_reasons().items() if v - stale0.get(k, 0)}
+    out["serve_aot_stale_reasons"] = stale
+    out["serve_aot_stale_total"] = sum(stale.values())
     # counter/percentile snapshot block (ISSUE 4): bench runs double as
     # telemetry fixtures — teletop --file renders this, and the
     # BENCH_serve.json trajectory keeps the tails next to the rates

@@ -139,6 +139,29 @@ def describe() -> str:
     return "\n".join(lines)
 
 
+def serve_lane_quota_fractions(spec, n_lanes):
+    """Per-lane queue-occupancy quota FRACTIONS from a
+    MXNET_SERVE_LANE_QUOTAS-style spec (a list/tuple of floats or a
+    comma string; empty = the auto ladder 1.0, .75, .5, … floored at
+    .25; a short list repeats its last value).  ONE definition, lives
+    here because this module is the jax-free ground both consumers
+    share: serving/engine.py turns the fractions into request caps it
+    ENFORCES, telemetry/slo.py turns them into the shed error budgets
+    it ALERTS on — parsed in two places they would silently drift."""
+    if spec and isinstance(spec, (list, tuple)):
+        fracs = [float(s) for s in spec]
+    elif spec:
+        fracs = [float(s) for s in str(spec).split(",") if s.strip()]
+    else:
+        fracs = [max(0.25, 1.0 - 0.25 * i) for i in range(n_lanes)]
+    if not fracs or any(f <= 0 for f in fracs):
+        raise ValueError("lane quotas must be positive fractions, "
+                         "got %r" % (spec,))
+    while len(fracs) < int(n_lanes):
+        fracs.append(fracs[-1])             # short list: last repeats
+    return fracs[:int(n_lanes)]
+
+
 # ---------------------------------------------------------------------------
 # the catalogue — every knob the framework honors, in one place
 # ---------------------------------------------------------------------------
@@ -483,6 +506,38 @@ register("MXNET_FLEET_PUBLISH_STEPS", int, 1,
          "stale) through the kvstore at __mesh__/telemetry/<rid> for "
          "rank 0 to merge into the FleetView.  0 disables fleet "
          "publishing/straggler detection")
+register("MXNET_HISTORY_DIR", str, "",
+         "Durable telemetry history (telemetry/history.py): directory "
+         "the per-process append-only shard files "
+         "(history-<ts>-p<pid>.jsonl) are written to at exporter-tick "
+         "cadence — counter deltas, percentile summaries, "
+         "cost-registry rows (the autotuner's persisted measured-cost "
+         "substrate), per-replica fleet rows and SLO alert "
+         "transitions, queryable across runs via telemetry.history."
+         "query and `blackbox history`.  Empty = history off (every "
+         "write is a no-op)")
+register("MXNET_HISTORY_SHARD_KB", int, 4096,
+         "Size cap in KB per history shard file; a shard past the cap "
+         "is compacted in place (newest half kept intact, older half "
+         "downsampled 2:1, atomically rewritten) so long-lived "
+         "processes bound their on-disk history while keeping its "
+         "envelope")
+register("MXNET_SLO_FAST_S", float, 60.0,
+         "SLO burn-rate FAST window in seconds (telemetry/slo.py): "
+         "the reactive window of the multi-window burn-rate rules — "
+         "an alert fires only when both the fast and slow windows "
+         "burn the error budget at >= 1x, and clears when the fast "
+         "window recovers")
+register("MXNET_SLO_SLOW_S", float, 300.0,
+         "SLO burn-rate SLOW window in seconds: the de-flaking window "
+         "of the multi-window burn-rate rules (a one-tick blip that "
+         "clears before the slow window accumulates never pages)")
+register("MXNET_SLO_SHED_BUDGET", float, 0.02,
+         "Default serving error budget (telemetry/slo.py): the "
+         "allowed shed fraction for the TOP priority lane's "
+         "burn-rate rule; lower lanes are designed to shed under "
+         "overload and budget max(this, 1 - lane quota) following "
+         "the MXNET_SERVE_LANE_QUOTAS ladder")
 register("MXNET_GATE_REPORT_DIR", str, "",
          "Directory the CI gates (check_overhead/check_feed/"
          "check_serve/check_scaling) write per-run JSON artifacts to "

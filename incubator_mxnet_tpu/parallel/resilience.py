@@ -550,6 +550,15 @@ class ResilientTrainer:
         # watermark + counter-delta samples the timeline carries
         _bb.hbm_sample(tag="checkpoint")
         _bb.sample_counters()
+        # ... and for the durable history (ISSUE 12): the marker
+        # outlives the process where the ring does not, and a trainer
+        # without a periodic exporter still leaves a trend
+        try:
+            from ..telemetry import history as _hist
+            _hist.note_event("ckpt", step=int(step))
+            _hist.tick()
+        except Exception:               # noqa: BLE001 — durability is
+            pass                        # never worth a failed ckpt
         if _tele.enabled():
             if self._tele is None:
                 self._tele = StepTelemetry(
@@ -608,6 +617,11 @@ class ResilientTrainer:
         self.bad_steps = 0
         events.incr("resilience.rollback")
         _bb.record("rollback", "bad_steps", step=self.trainer._n_step)
+        try:
+            from ..telemetry import history as _hist
+            _hist.note_event("rollback", step=int(self.trainer._n_step))
+        except Exception:               # noqa: BLE001
+            pass
         # a rollback means the run just survived something that kills
         # unguarded jobs — leave the forensic file while the evidence
         # (bad-step timeline, loss samples, counters) is still in ring
@@ -629,6 +643,12 @@ class ResilientTrainer:
             os.replace(marker_tmp,
                        os.path.join(self.ckpt_dir, _PREEMPT_MARKER))
         events.incr("resilience.preemption")
+        try:
+            from ..telemetry import history as _hist
+            _hist.note_event("preemption", step=int(step))
+            _hist.tick()        # the final durable batch — the dump
+        except Exception:       # noqa: BLE001 — below is forensics,
+            pass                # this is the trend record
         # the black box is the last thing written before the process
         # dies: it carries this preemption AND any earlier rollback
         # markers still in the ring (the acceptance scenario)

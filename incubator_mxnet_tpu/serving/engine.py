@@ -85,6 +85,7 @@ from __future__ import annotations
 import heapq
 import itertools
 import queue
+from collections import deque
 import signal
 import threading
 import time
@@ -293,18 +294,12 @@ def _parse_lanes(spec):
 def _parse_lane_quotas(spec, lanes, cap):
     """lane -> occupancy cap (requests) from the quota-fraction spec;
     the top lane defaults to the full queue (None = no lane cap), and
-    an explicit fraction >= 1 also means no extra bound."""
-    if spec and isinstance(spec, (list, tuple)):
-        fracs = [float(s) for s in spec]
-    elif spec:
-        fracs = [float(s) for s in str(spec).split(",") if s.strip()]
-    else:
-        fracs = [max(0.25, 1.0 - 0.25 * i) for i in range(len(lanes))]
-    if not fracs or any(f <= 0 for f in fracs):
-        raise ValueError("lane quotas must be positive fractions, "
-                         "got %r" % (spec,))
-    while len(fracs) < len(lanes):
-        fracs.append(fracs[-1])             # short list: last repeats
+    an explicit fraction >= 1 also means no extra bound.  Fraction
+    parsing (incl. the auto ladder) is shared with the SLO layer's
+    default shed budgets — config.serve_lane_quota_fractions — so
+    what the engine enforces and what the alerts budget cannot
+    drift."""
+    fracs = _cfg.serve_lane_quota_fractions(spec, len(lanes))
     caps = {}
     for lane, f in zip(lanes, fracs):
         caps[lane] = None if f >= 1.0 else max(1, int(f * cap))
@@ -407,6 +402,13 @@ class InferenceEngine:
         self._lock = threading.Lock()       # submit/lifecycle state
         self._exec_lock = threading.Lock()  # trace/execute (warmup vs
                                             # dispatcher share the block)
+        # RELATIVE deadlines recently observed per lane (bounded
+        # rolling windows): the SLO targets (ISSUE 12) — what callers
+        # actually asked of a lane is the honest p99 bound, not a
+        # knob someone forgot to set.  A WINDOW, not an all-time min:
+        # one misconfigured client's 1ms outlier must age out, not
+        # poison the lane's derived p99 rule until process restart
+        self._lane_deadline_s = {}  # lane -> deque of recent deadlines
         self._thread = None
         self._carry = None          # request pulled but not yet batched
         self._svc_ewma = {}         # bucket -> EWMA batch service s
@@ -676,6 +678,16 @@ class InferenceEngine:
             if tenant is not None:
                 self._tenant_q[tenant] = \
                     self._tenant_q.get(tenant, 0) + 1
+            if deadline is not None:
+                # ACCEPTED requests only (shed paths raised above):
+                # a quota-shed client's deadline never became work
+                # this lane owed.  Same lock as the enqueue — one
+                # deque append per deadlined submit
+                dq = self._lane_deadline_s.get(lane)
+                if dq is None:
+                    dq = self._lane_deadline_s[lane] = \
+                        deque(maxlen=256)
+                dq.append(float(deadline))
         if victim is not None:          # outside the lock: _finish →
             self._shed_mark(victim.lane, victim.tenant, "displaced")
             self._finish(victim, exc=Shed(  # _retire re-takes it
@@ -1129,15 +1141,20 @@ class InferenceEngine:
             # aggregate above stays authoritative, the labeled rings
             # answer "p99 for lane X / tenant Y" in /metrics + dumps
             us = int(dt * 1e6)
+            # REQUEST-denominated, matching the unlabeled aggregate
+            # (dispatcher: len(live)) and serve.shed (1 per shed) —
+            # the SLO shed burn rules ratio shed/(requests+shed), and
+            # example-denominated children would dilute that ratio by
+            # the batch size for submit_batch traffic
             if r.lane is not None:
                 events.observe("serve.e2e_us", us,
                                labels={"lane": r.lane})
-                events.incr("serve.requests", r.n,
+                events.incr("serve.requests",
                             labels={"lane": r.lane})
             if r.tenant is not None:
                 events.observe("serve.e2e_us", us,
                                labels={"tenant": r.tenant})
-                events.incr("serve.requests", r.n,
+                events.incr("serve.requests",
                             labels={"tenant": r.tenant})
 
     # -- warmup --------------------------------------------------------
@@ -1254,6 +1271,27 @@ class InferenceEngine:
             pass                        # the handler then chains prev)
 
     # -- introspection -------------------------------------------------
+    def slo_targets(self):
+        """{lane: tightest relative deadline seconds among the last
+        256 ACCEPTED deadlined requests} — the per-lane SLO targets
+        telemetry/slo.py derives its default p99-vs-deadline rules
+        from (empty until deadlined traffic has been seen; an
+        outlier-tight deadline ages out of the window instead of
+        pinning the target forever)."""
+        with self._lock:
+            return {lane: min(dq)
+                    for lane, dq in self._lane_deadline_s.items()
+                    if dq}
+
+    def slo_lane_quotas(self):
+        """{lane: occupancy quota FRACTION this engine actually
+        enforces}, reconstructed from the live caps — so the SLO
+        layer's default shed budgets honor programmatic ``lanes=`` /
+        ``lane_quotas=`` engines, not just the env knobs."""
+        cap = float(self._q.maxsize)
+        return {lane: (1.0 if c is None else c / cap)
+                for lane, c in self._lane_caps.items()}
+
     def stats(self):
         """Engine + process-wide `serve.*` counter snapshot, including
         latency percentiles (p50/p90/p99) for the observed series."""

@@ -525,6 +525,46 @@ class ModelRegistry:
         return measured
 
     # -- introspection / lifecycle -------------------------------------
+    def slo_targets(self):
+        """{lane: tightest relative deadline seconds observed across
+        every hosted model's engine} — the registry-level SLO targets
+        (ISSUE 12).  A lane's target is the MOST demanding deadline
+        any tenant asked of it; lanes that never saw a deadlined
+        request contribute nothing."""
+        with self._lock:
+            entries = [e for e in self._models.values()
+                       if e is not None]
+        out = {}
+        for e in entries:
+            for lane, t in e.engine.slo_targets().items():
+                cur = out.get(lane)
+                if cur is None or t < cur:
+                    out[lane] = t
+        return out
+
+    def slo_lane_quotas(self):
+        """{lane: most restrictive occupancy quota fraction enforced
+        by any hosted engine} — the budgets the default shed burn
+        rules derive from (see `InferenceEngine.slo_lane_quotas`)."""
+        with self._lock:
+            entries = [e for e in self._models.values()
+                       if e is not None]
+        out = {}
+        for e in entries:
+            for lane, f in e.engine.slo_lane_quotas().items():
+                cur = out.get(lane)
+                out[lane] = f if cur is None else min(cur, f)
+        return out
+
+    def install_slo_rules(self, **kw):
+        """Build + register the default serving SLO rules
+        (telemetry/slo.py) with this registry's observed per-lane
+        deadline targets: per-lane shed burn-rate + p99-vs-deadline.
+        Returns the registered rule names; call again after traffic
+        has established deadlines to pick up tighter targets."""
+        from ..telemetry import slo as _slo
+        return _slo.install_default_serving_rules(registry=self, **kw)
+
     def stats(self):
         with self._lock:
             models = {

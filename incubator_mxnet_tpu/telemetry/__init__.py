@@ -33,6 +33,20 @@ replace when a run dies:
 
 `python -m incubator_mxnet_tpu.tools.blackbox <dump>` summarizes a
 dump.
+
+ISSUE 12 makes the telemetry DURABLE and JUDGED:
+
+- `telemetry.history` — an append-only, bounded on-disk time series
+  (MXNET_HISTORY_DIR): the periodic exporter tick writes counter
+  deltas, percentile summaries, cost-registry rows and per-replica
+  fleet rows to per-process shard files, queryable across runs
+  (`history.query`; `blackbox history` renders the trends).
+- `telemetry.slo` — declarative SLO/alert rules (static thresholds,
+  multi-window burn-rate over an error budget, MAD anomaly vs
+  history baselines) evaluated each exporter tick; a firing rule is
+  a typed event: `slo.*` counters, a ring event, the
+  `mxnet_alert_active{rule=}` gauge, and a PROACTIVE black-box dump
+  naming the rule.
 """
 from __future__ import annotations
 
@@ -44,23 +58,28 @@ from .stepstats import StepTelemetry
 from . import costs
 from . import flightrec
 from . import fleet
+from . import history
+from . import slo
 from .fleet import (FleetReporter, FleetTelemetry, FleetView,
                     StragglerDetector)
 from .flightrec import dump_blackbox, install_crash_hooks
+from .slo import (AnomalyRule, BurnRateRule, ThresholdRule,
+                  register_rule)
 
 __all__ = ["SpanContext", "TraceContext", "span", "current", "enable",
            "enabled", "recording", "propagate", "set_global_step",
            "get_global_step", "emit_foreign", "MetricsExporter",
            "StepTelemetry", "start", "stop", "get_exporter",
-           "snapshot_dict", "costs", "flightrec", "fleet",
-           "FleetReporter", "FleetView", "FleetTelemetry",
-           "StragglerDetector", "dump_blackbox",
+           "snapshot_dict", "costs", "flightrec", "fleet", "history",
+           "slo", "FleetReporter", "FleetView", "FleetTelemetry",
+           "StragglerDetector", "ThresholdRule", "BurnRateRule",
+           "AnomalyRule", "register_rule", "dump_blackbox",
            "install_crash_hooks"]
 
 #: counter families the condensed snapshot (bench.py JSON) carries
 SNAPSHOT_PREFIXES = ("serve.", "feed.", "train.", "aot.",
                      "resilience.", "mem.", "fault.", "blackbox.",
-                     "mesh.", "fleet.")
+                     "mesh.", "fleet.", "slo.", "history.")
 
 _exporter = None
 

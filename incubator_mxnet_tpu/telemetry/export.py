@@ -130,6 +130,25 @@ class MetricsExporter:
                                 r["key"], _fmt(r[field])))
         return lines
 
+    @staticmethod
+    def _slo_lines(prefix):
+        """The SLO alert gauge (ISSUE 12): one ``<prefix>alert_active``
+        child per REGISTERED rule — 1 while firing, 0 while clear, so
+        a scrape sees alerts clear (an active-only family would just
+        go stale)."""
+        from . import slo as _slo
+        names = set(_slo.rules())
+        active = set(_slo.active_alerts())
+        if not names and not active:
+            return []
+        m = _metric_name(prefix, "alert_active")
+        lines = ["# TYPE %s gauge" % m]
+        for name in sorted(names | active):
+            lines.append('%s{rule="%s"} %d'
+                         % (m, MetricsExporter._escape_label(name),
+                            1 if name in active else 0))
+        return lines
+
     def prometheus_text(self) -> str:
         """Prometheus exposition text (version 0.0.4): counters +
         quantile summaries for every observed sample series (labeled
@@ -198,6 +217,10 @@ class MetricsExporter:
                 lines += self._cost_lines(self._prefix)
             except Exception:       # noqa: BLE001 — cost attribution
                 pass                # must never break a scrape
+            try:
+                lines += self._slo_lines(self._prefix)
+            except Exception:       # noqa: BLE001 — alerting must
+                pass                # never break a scrape either
         return "\n".join(lines) + "\n"
 
     def json_dict(self) -> dict:
@@ -227,6 +250,16 @@ class MetricsExporter:
                 fleet = _bb.fleet_block()
                 if fleet and fleet.get("replicas"):
                     out["fleet"] = fleet
+            except Exception:       # noqa: BLE001
+                pass
+            # the SLO rule/alert state (ISSUE 12): teletop renders the
+            # alert rows, and a scraped snapshot answers "is anything
+            # firing" without the Prometheus surface
+            try:
+                from . import slo as _slo
+                sblock = _slo.block()
+                if sblock:
+                    out["slo"] = sblock
             except Exception:       # noqa: BLE001
                 pass
         return out
@@ -273,6 +306,23 @@ class MetricsExporter:
                 _bb.hbm_sample(tag="export")
             except Exception:           # noqa: BLE001
                 pass
+            # the durable layer rides the same cadence (ISSUE 12):
+            # one history batch per tick, then the SLO rules judged
+            # against the snapshots the batch just captured — both
+            # off every hot path by construction.  SEPARATE guards:
+            # a full/unwritable history disk raising every tick must
+            # not also silence alerting — disk trouble is exactly
+            # when the alerts are needed
+            try:
+                from . import history as _hist
+                _hist.tick()
+            except Exception:           # noqa: BLE001 — durability is
+                pass                    # best-effort
+            try:
+                from . import slo as _slo
+                _slo.evaluate()
+            except Exception:           # noqa: BLE001 — and a broken
+                pass                    # rule set must not kill export
             del exp
 
     def start(self, path=None, period_s=None):

@@ -62,7 +62,22 @@ from . import flightrec as _bb
 from . import spans as _tele
 
 __all__ = ["FIELDS", "FleetReporter", "FleetView", "StragglerDetector",
-           "FleetTelemetry", "telemetry_key"]
+           "FleetTelemetry", "telemetry_key", "robust_threshold"]
+
+
+def robust_threshold(values, sigma, rel_floor=0.5):
+    """``median + max(sigma·1.4826·MAD, rel_floor·median)`` over
+    `values` — the outlier line the straggler detector judges replicas
+    against, factored out so the SLO anomaly rules (telemetry/slo.py)
+    can point the SAME math at history baselines instead of at other
+    replicas.  The MAD term adapts to a naturally-noisy population;
+    the relative floor keeps a uniform one (MAD ≈ 0) from flagging
+    micro-skew."""
+    vals = [float(v) for v in values]
+    med = statistics.median(vals)
+    mad = statistics.median(abs(x - med) for x in vals)
+    return med + max(float(sigma) * 1.4826 * mad,
+                     float(rel_floor) * med)
 
 #: the fixed wire schema: one float64 per field, in this order.  A
 #: fixed schema (not pickles) keeps the payload a dozen numbers, makes
@@ -226,9 +241,8 @@ class StragglerDetector:
         for rid, v in stats.items():
             others = [x for r, x in stats.items() if r != rid]
             med = statistics.median(others)
-            mad = statistics.median(abs(x - med) for x in others)
-            thresh = med + max(self.sigma * 1.4826 * mad,
-                               self.REL_FLOOR * med)
+            thresh = robust_threshold(others, self.sigma,
+                                      rel_floor=self.REL_FLOOR)
             baseline[rid] = (med, thresh)
             if v > thresh:
                 self._over[rid] = self._over.get(rid, 0) + 1
@@ -353,6 +367,15 @@ class FleetTelemetry:
             events.observe("fleet.step_us", us,
                            labels={"replica": str(rid)})
         out = self.detector.observe(step, per_us)
+        # the rank-0 merge is also the durable per-replica record
+        # (ISSUE 12): one history row per replica at publish cadence —
+        # already off the step critical path, and a no-op when
+        # MXNET_HISTORY_DIR is unset
+        try:
+            from . import history as _hist
+            _hist.record_fleet(merged, step=step, stragglers=out)
+        except Exception:           # noqa: BLE001 — durability is
+            pass                    # best-effort, never a step cost
         # the fleet layer meters ITSELF: publish+refresh+detect wall
         # per round, so "what does fleet telemetry cost" is a counter
         # you read, not a claim you trust

@@ -360,6 +360,14 @@ def dump_blackbox(path=None, reason="manual", exc=None, last=None):
     except Exception:               # noqa: BLE001 — cost attribution
         cost_block = {"rows": [], "totals": {}}  # is best-effort
     fleet = fleet_block()
+    # the SLO rule/alert state (ISSUE 12): a dump triggered BY an
+    # alert (reason "slo:<rule>") carries the firing evidence; any
+    # other dump still answers "was anything firing when this died"
+    try:
+        from . import slo as _slo
+        slo_block = _slo.block() or None
+    except Exception:               # noqa: BLE001 — forensic garnish
+        slo_block = None
     evs = ring_snapshot(last=last)
     doc = {
         "schema": SCHEMA,
@@ -373,6 +381,7 @@ def dump_blackbox(path=None, reason="manual", exc=None, last=None):
         "labeled": labeled,
         "costs": cost_block,
         "fleet": fleet,
+        "slo": slo_block,
         "hbm": {"peaks": hbm_peaks()},
         "events": evs,
         "trace": {"traceEvents": _chrome_view(evs),

@@ -40,6 +40,24 @@ metadata is added), and the summary reports the correlation keys: how
 many trace ids and global steps have spans from MORE than one process
 — the (trace_id, step) join this PR's propagation exists to make
 possible.  Exit code 0 on a merged output, 1 when nothing merged.
+
+`history` (ISSUE 12) renders the durable on-disk telemetry history
+(MXNET_HISTORY_DIR shards, telemetry/history.py) as cross-run trends:
+
+    python -m ... history                         # per-run summary
+    python -m ... history --name serve.           # trend + sparkline
+    python -m ... history --kind cost --name serve.infer
+    python -m ... history --diff                  # newest two runs
+    python -m ... history --diff RUN_A RUN_B --threshold 15
+
+Without ``--name`` it lists the runs (rows, span, alerts fired) in
+the directory.  With one, each matching series gets a row per run —
+last value, delta vs the previous run, and a sparkline over the run's
+samples.  ``--diff`` compares the last-value-per-series of two runs
+using `tools/bench_diff.py`'s direction heuristics (``*_us``/``p99``/
+``stale`` lower-better, throughput/hit higher-better), prints the
+regressions, and exits 1 when any directional series regressed past
+``--threshold`` percent.
 """
 from __future__ import annotations
 
@@ -48,10 +66,11 @@ import json
 import sys
 import time
 
-from .teletop import _fleet_lines, _fmt_qty
+from .teletop import _fleet_lines, _fmt_qty, _slo_lines
 
 __all__ = ["load_dump", "render", "suspected_cause", "merge_traces",
-           "verify_main", "merge_main", "main"]
+           "verify_main", "merge_main", "history_main", "sparkline",
+           "main"]
 
 
 def load_dump(path: str) -> dict:
@@ -74,6 +93,18 @@ def suspected_cause(doc: dict) -> str:
     if exc:
         return ("uncaught %s: %s" % (exc.get("type"),
                                      (exc.get("message") or "")[:120]))
+    if reason.startswith("slo:"):
+        info = (doc.get("slo") or {}).get("active", {}).get(
+            reason[4:], {})
+        return ("SLO alert %r fired — PROACTIVE dump, the run was "
+                "still alive (%s); read the slo block and the slo.* "
+                "ring events"
+                % (reason[4:],
+                   " ".join("%s=%s" % (k, info[k]) for k in
+                            sorted(info)
+                            if isinstance(info[k],
+                                          (int, float, str)))[:100]
+                   or "no evidence recorded"))
     # integrity family first: silent corruption outranks everything a
     # run can do to itself — the bytes were wrong
     sdc = [e for e in evs
@@ -222,6 +253,9 @@ def render(doc: dict, events_tail=40) -> str:
     # teletop renders live, embedded here so a dead run's dump still
     # answers "which replica"
     lines += _fleet_lines(doc.get("fleet"))
+    # the SLO rule/alert state (ISSUE 12): a proactive slo:<rule>
+    # dump's firing evidence, or "was anything firing" for any other
+    lines += _slo_lines(doc.get("slo"))
 
     lines += ["", "suspected cause: " + suspected_cause(doc)]
     return "\n".join(lines)
@@ -342,6 +376,245 @@ def merge_main(argv) -> int:
     return 0 if summary["events"] else 1
 
 
+# -- history trends (ISSUE 12) -----------------------------------------
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width=24) -> str:
+    """A unicode block sparkline of a value series (downsampled to
+    `width` by last-value-per-bin; a flat series renders mid-height so
+    'no variance' doesn't read as 'no data')."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        step = len(vals) / float(width)
+        vals = [vals[min(len(vals) - 1, int((i + 1) * step) - 1)]
+                for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK[3] * len(vals)
+    return "".join(_SPARK[min(len(_SPARK) - 1,
+                              int((v - lo) / (hi - lo)
+                                  * (len(_SPARK) - 1)))]
+                   for v in vals)
+
+
+def _bench_diff_mod():
+    """tools/bench_diff.py (repo root, not a package) loaded by path —
+    the `--diff` direction heuristics are DEFINED there so the two
+    trend tools cannot drift apart.  None when the file isn't present
+    (an installed package without the repo checkout)."""
+    import importlib.util
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(root, "tools", "bench_diff.py")
+    if not os.path.exists(path):
+        return None
+    spec = importlib.util.spec_from_file_location("_bench_diff", path)
+    mod = importlib.util.module_from_spec(spec)
+    try:
+        spec.loader.exec_module(mod)
+    except Exception:               # noqa: BLE001 — operator tool
+        return None
+    return mod
+
+
+def _series_key(row):
+    """kind-qualified series key: a name can exist as BOTH a counter
+    and a pct series (observe_time's convention — serve.e2e_us), and
+    collapsing them would interleave per-tick deltas with p99s in one
+    trend row."""
+    labels = row.get("labels") or {}
+    name = "%s:%s" % (row.get("kind", "?"), row.get("name", "?"))
+    if not labels:
+        return name
+    return "%s{%s}" % (name, ",".join("%s=%s" % kv
+                                      for kv in sorted(labels.items())))
+
+
+def _row_value(row):
+    """The trendable scalar of one row: counters by their CUMULATIVE
+    total (the per-tick delta is an arbitrary single-tick sample),
+    everything else by the row value — ONE definition for the trend
+    table and --diff so the two subcommands cannot disagree."""
+    if row.get("kind") == "counter":
+        return float(row.get("total", row.get("v", 0)))
+    return float(row.get("v", 0))
+
+
+def _history_runs_table(hist, directory):
+    lines = ["%-28s %7s %9s %7s %7s %s"
+             % ("run", "rows", "span_s", "alerts", "marks", "kinds"),
+             "-" * 78]
+    for run in hist.runs(directory):
+        rows = hist.query(directory=directory, run=run)
+        if not rows:
+            lines.append("%-28s %7d" % (run, 0))
+            continue
+        ts = [r.get("ts", 0) for r in rows]
+        kinds = {}
+        for r in rows:
+            kinds[r.get("kind", "?")] = kinds.get(r.get("kind", "?"),
+                                                  0) + 1
+        fired = sum(1 for r in rows if r.get("kind") == "slo"
+                    and r.get("event") == "fired")
+        lines.append("%-28s %7d %9.1f %7d %7d %s"
+                     % (run, len(rows), max(ts) - min(ts), fired,
+                        kinds.get("marker", 0),
+                        ",".join("%s:%d" % kv
+                                 for kv in sorted(kinds.items()))))
+    return lines
+
+
+def history_main(argv) -> int:
+    """``blackbox history`` body: cross-run trend tables (and
+    ``--diff``) over the durable history shards.  rc 0 = rendered;
+    1 = --diff found regressions; 2 = unusable directory."""
+    ap = argparse.ArgumentParser(
+        prog="blackbox history",
+        description="cross-run trend tables over the durable "
+                    "telemetry history (MXNET_HISTORY_DIR shards)")
+    ap.add_argument("--dir", default=None,
+                    help="history directory (default "
+                    "MXNET_HISTORY_DIR)")
+    ap.add_argument("--name", default=None, metavar="PREFIX",
+                    help="series name prefix to trend (without it: "
+                    "per-run summary table)")
+    ap.add_argument("--kind", default=None,
+                    help="restrict to one row kind "
+                    "(counter/pct/cost/fleet/marker/slo)")
+    ap.add_argument("--runs", type=int, default=8, metavar="N",
+                    help="newest N runs to show (default 8)")
+    ap.add_argument("--diff", nargs="*", metavar="RUN", default=None,
+                    help="compare two runs' last-value-per-series "
+                    "(default: the newest two) with bench_diff's "
+                    "direction heuristics; rc 1 on regression")
+    ap.add_argument("--threshold", type=float, default=10.0,
+                    metavar="PCT", help="--diff regression threshold "
+                    "percent (default 10)")
+    args = ap.parse_args(argv)
+    from ..telemetry import history as hist
+    directory = args.dir if args.dir is not None else \
+        hist.history_dir()
+    if not directory:
+        print("blackbox history: no directory (--dir or "
+              "MXNET_HISTORY_DIR)", file=sys.stderr)
+        return 2
+    all_runs = hist.runs(directory)
+    if not all_runs:
+        print("blackbox history: no history-*.jsonl shards under %s"
+              % directory, file=sys.stderr)
+        return 2
+
+    if args.diff is not None:
+        if len(args.diff) == 2:
+            run_a, run_b = args.diff
+        elif len(args.diff) == 0 and len(all_runs) >= 2:
+            run_a, run_b = all_runs[-2], all_runs[-1]
+        else:
+            print("blackbox history --diff needs two runs (or a "
+                  "directory holding at least two)", file=sys.stderr)
+            return 2
+        missing = [r for r in (run_a, run_b) if r not in all_runs]
+        if missing:
+            # a typo'd run id must be a loud usage error, not an
+            # empty intersection reading as "no regressions"
+            print("blackbox history --diff: no shard for run(s) %s "
+                  "under %s (known: %s)"
+                  % (", ".join(missing), directory,
+                     ", ".join(all_runs[-6:])), file=sys.stderr)
+            return 2
+        bd = _bench_diff_mod()
+        if bd is None:
+            # without the direction heuristics nothing can be judged
+            # a regression — 'OK' here would be a silent false pass
+            # for any CI job relying on the rc-1 contract
+            print("blackbox history --diff: tools/bench_diff.py not "
+                  "loadable (no repo checkout?) — cannot judge "
+                  "directions", file=sys.stderr)
+            return 2
+        last = {}
+        for tag, run in (("a", run_a), ("b", run_b)):
+            per = {}
+            for r in hist.query(args.name, kind=args.kind,
+                                directory=directory, run=run):
+                per[_series_key(r)] = _row_value(r)
+            last[tag] = per
+        print("history diff: %s -> %s" % (run_a, run_b))
+        print("%-52s %12s %12s %9s %7s %s"
+              % ("series", "old", "new", "delta%", "dir", "verdict"))
+        print("-" * 100)
+        regressions = []
+        for key in sorted(set(last["a"]) & set(last["b"])):
+            a, b = last["a"][key], last["b"][key]
+            if a == b:
+                continue
+            pct = 100.0 * (b - a) / abs(a) if a else float("inf")
+            d = bd.direction_of(key) if bd is not None else None
+            verdict = ""
+            if d is not None and abs(pct) > args.threshold:
+                worse = pct > 0 if d == "lower" else pct < 0
+                verdict = "REGRESSION" if worse else "improved"
+                if worse:
+                    regressions.append(key)
+            if verdict or abs(pct) > args.threshold:
+                print("%-52s %12g %12g %+8.1f%% %7s %s"
+                      % (key[:52], a, b, pct, d or "?", verdict))
+        # bench_diff parity: series present in only one run are
+        # surfaced, not silently dropped from the comparison — a
+        # vanished SLO metric must not read as a pass
+        gone = sorted(set(last["a"]) - set(last["b"]))
+        new = sorted(set(last["b"]) - set(last["a"]))
+        if gone:
+            print("series VANISHED in %s: %d (%s%s)"
+                  % (run_b, len(gone), ", ".join(gone[:6]),
+                     ", ..." if len(gone) > 6 else ""))
+        if new:
+            print("series added in %s: %d (%s%s)"
+                  % (run_b, len(new), ", ".join(new[:6]),
+                     ", ..." if len(new) > 6 else ""))
+        if regressions:
+            print("FAIL: %d series regressed past %.1f%%: %s"
+                  % (len(regressions), args.threshold,
+                     ", ".join(regressions[:8])), file=sys.stderr)
+            return 1
+        print("OK: no regressions past %.1f%%" % args.threshold)
+        return 0
+
+    if args.name is None and args.kind is None:
+        print("\n".join(_history_runs_table(hist, directory)))
+        return 0
+
+    runs = all_runs[-max(1, args.runs):]
+    print("%-44s %-28s %5s %12s %8s %s"
+          % ("series", "run", "n", "last", "delta%", "trend"))
+    print("-" * 110)
+    prev_last = {}
+    shown = 0
+    for run in runs:
+        per = {}
+        for r in hist.query(args.name, kind=args.kind,
+                            directory=directory, run=run):
+            per.setdefault(_series_key(r), []).append(_row_value(r))
+        for key in sorted(per):
+            vals = per[key]
+            lastv = vals[-1]
+            delta = ""
+            if key in prev_last and prev_last[key]:
+                delta = "%+.1f" % (100.0 * (lastv - prev_last[key])
+                                   / abs(prev_last[key]))
+            print("%-44s %-28s %5d %12g %8s %s"
+                  % (key[:44], run[:28], len(vals), lastv, delta,
+                     sparkline(vals)))
+            prev_last[key] = lastv
+            shown += 1
+    if not shown:
+        print("(no matching rows)")
+    return 0
+
+
 def verify_main(argv) -> int:
     """``blackbox verify <dir>`` body: verify one checkpoint (a dir
     holding an integrity manifest) or every ``step_*`` child of a
@@ -401,11 +674,13 @@ def main(argv=None) -> int:
         return verify_main(argv[1:])
     if argv and argv[0] == "merge":
         return merge_main(argv[1:])
+    if argv and argv[0] == "history":
+        return history_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="blackbox",
         description="summarize a flight-recorder black-box dump "
                     "(or: blackbox verify <ckpt_dir> / "
-                    "blackbox merge <dumps...>)")
+                    "blackbox merge <dumps...> / blackbox history)")
     ap.add_argument("dump", help="black-box dump JSON path")
     ap.add_argument("--events", type=int, default=40, metavar="N",
                     help="timeline tail length (default 40)")

@@ -163,6 +163,33 @@ def _fleet_lines(fleet):
     return lines
 
 
+def _slo_lines(slo):
+    """The SLO rule/alert block (ISSUE 12) as table rows: one ALERT
+    line per firing rule (with its evidence), one quiet line per
+    registered-but-clear rule — an operator's eye lands on the
+    alerts, and 'no rules registered' is distinguishable from 'all
+    clear'."""
+    if not slo or not (slo.get("rules") or slo.get("active")):
+        return []
+    active = slo.get("active") or {}
+    rules = slo.get("rules") or []
+    lines = ["", "slo (%d rule(s), %d firing)"
+             % (len(rules), len(active)), "-" * 46]
+    for name in sorted(active):
+        info = active[name]
+        extra = " ".join(
+            "%s=%s" % (k, info[k]) for k in sorted(info)
+            if k != "since" and isinstance(info[k],
+                                           (int, float, str, bool)))
+        lines.append("ALERT  %-28s %s" % (name, extra[:44]))
+    for r in rules:
+        if r.get("rule") in active:
+            continue
+        lines.append("ok     %-28s %s" % (r.get("rule", "?"),
+                                          r.get("kind", "")))
+    return lines
+
+
 def render(snap: dict, prefix: str = "") -> str:
     """The snapshot as one fixed-width table block."""
     counters = {k: v for k, v in snap.get("counters", {}).items()
@@ -205,6 +232,7 @@ def render(snap: dict, prefix: str = "") -> str:
                              else {"rows": [], "totals": costs})
 
     lines += _fleet_lines(snap.get("fleet"))
+    lines += _slo_lines(snap.get("slo"))
 
     derived = _derived(snap.get("counters", {}))
     if derived:

@@ -16,6 +16,13 @@ if "MXNET_BLACKBOX_DIR" not in os.environ:
     os.environ["MXNET_BLACKBOX_DIR"] = \
         tempfile.mkdtemp(prefix="mxtpu-blackbox-")
 
+# durable-telemetry history shards (ISSUE 12): same reasoning — tests
+# that enable history (or trainers that checkpoint with it on) must
+# write their history-*.jsonl shards into scratch, never the checkout
+if "MXNET_HISTORY_DIR" not in os.environ:
+    os.environ["MXNET_HISTORY_DIR"] = \
+        tempfile.mkdtemp(prefix="mxtpu-history-")
+
 # must happen before jax backend initialisation
 if os.environ.get("MXNET_TEST_DEVICE", "cpu") == "cpu":
     flags = os.environ.get("XLA_FLAGS", "")
@@ -101,6 +108,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "fleet: fleet-observability tests (CPU-fast, run "
         "in tier-1 by default)")
+    # durable telemetry (ISSUE 12): on-disk metrics history, SLO /
+    # burn-rate alerting, cross-run trend tooling
+    config.addinivalue_line(
+        "markers", "slo: durable-telemetry history + SLO alerting "
+        "tests (CPU-fast, run in tier-1 by default)")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,146 @@
+"""gate_trend — aggregate gate_report artifacts into a flake trend
+(ISSUE 12 satellite).
+
+PR 11's `gate_report.py` made every check_overhead / check_feed /
+check_serve / check_scaling run leave a per-run JSON artifact under
+``MXNET_GATE_REPORT_DIR``; this tool turns the accumulated artifacts
+into the table the artifacts exist for — per gate: how many runs,
+how many passed / failed / skipped, the flake rate (failed runs among
+non-skip runs), and the recent verdict string (oldest→newest, so the
+~50% VM flake on check_overhead/check_feed is a readable trend
+instead of lore):
+
+    MXNET_GATE_REPORT_DIR=/ci/gates python tools/gate_trend.py
+    python tools/gate_trend.py /ci/gates --window 5
+
+Exit code: 0 normally; **1 when any gate's recent window (the last
+``--window`` runs, default 3, only judged once the window is full) is
+ALL-fail** — a persistent failure is a regression, not a flake, no
+matter how flaky the gate's history is.  2 = no artifacts to read.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+__all__ = ["load_reports", "trend", "main"]
+
+#: verdict -> single char for the recent-runs string (oldest→newest)
+_CHARS = {"pass": "P", "fail": "F", "skip": "s"}
+
+
+def load_reports(directory):
+    """{gate: [report dicts, oldest first]} from every readable
+    ``<gate>-<ts>-p<pid>[-seq].json`` artifact in the directory.
+    Unreadable / non-gate-report files are skipped, never raised."""
+    out = {}
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if str(doc.get("schema", "")).split("/")[0] != \
+                "mxtpu-gate-report":
+            continue
+        doc["_file"] = name
+        out.setdefault(str(doc.get("gate", "?")), []).append(doc)
+    for reports in out.values():
+        reports.sort(key=lambda d: (d.get("ts", 0), d["_file"]))
+    return out
+
+
+def trend(reports_by_gate, window=3):
+    """Per-gate summary rows.  A row:
+    ``{gate, runs, passed, failed, skipped, inconclusive_trials,
+    flake_pct, recent, all_fail_window}`` — ``flake_pct`` is fails
+    over non-skip runs (a skip is an environment verdict, not a
+    flake), ``recent`` the last-`window` verdict chars oldest→newest,
+    and ``all_fail_window`` True only when the window is FULL and
+    every run in it failed."""
+    rows = []
+    for gate in sorted(reports_by_gate):
+        reports = reports_by_gate[gate]
+        verdicts = [str(d.get("verdict", "?")) for d in reports]
+        passed = sum(1 for v in verdicts if v == "pass")
+        failed = sum(1 for v in verdicts if v == "fail")
+        skipped = sum(1 for v in verdicts if v == "skip")
+        judged = passed + failed
+        inconclusive = sum(
+            1 for d in reports for t in d.get("trials", ())
+            if str(t.get("verdict", "")) == "inconclusive")
+        recent = verdicts[-int(window):]
+        rows.append({
+            "gate": gate,
+            "runs": len(reports),
+            "passed": passed,
+            "failed": failed,
+            "skipped": skipped,
+            "inconclusive_trials": inconclusive,
+            "flake_pct": round(100.0 * failed / judged, 1)
+            if judged else None,
+            "recent": "".join(_CHARS.get(v, "?") for v in recent),
+            "all_fail_window": (len(recent) >= int(window)
+                                and all(v == "fail" for v in recent)),
+        })
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="gate_trend",
+        description="per-gate pass/fail/flake trend over the "
+        "gate_report artifacts; rc 1 when a gate's recent window is "
+        "all-fail")
+    ap.add_argument("dir", nargs="?", default=None,
+                    help="artifact directory (default "
+                    "MXNET_GATE_REPORT_DIR)")
+    ap.add_argument("--window", type=int, default=3, metavar="N",
+                    help="recent-runs window judged for all-fail "
+                    "(default 3; only judged when full)")
+    args = ap.parse_args(argv)
+    directory = args.dir or os.environ.get("MXNET_GATE_REPORT_DIR", "")
+    if not directory:
+        print("gate_trend: no directory (argument or "
+              "MXNET_GATE_REPORT_DIR)", file=sys.stderr)
+        return 2
+    by_gate = load_reports(directory)
+    if not by_gate:
+        print("gate_trend: no gate-report artifacts under %s"
+              % directory, file=sys.stderr)
+        return 2
+    rows = trend(by_gate, window=args.window)
+    print("%-18s %5s %5s %5s %5s %7s %7s  %-*s %s"
+          % ("gate", "runs", "pass", "fail", "skip", "inconc",
+             "flake%", max(8, args.window), "recent", ""))
+    print("-" * 78)
+    bad = []
+    for r in rows:
+        mark = ""
+        if r["all_fail_window"]:
+            mark = "<-- ALL-FAIL (last %d)" % args.window
+            bad.append(r["gate"])
+        print("%-18s %5d %5d %5d %5d %7d %7s  %-*s %s"
+              % (r["gate"], r["runs"], r["passed"], r["failed"],
+                 r["skipped"], r["inconclusive_trials"],
+                 "-" if r["flake_pct"] is None
+                 else "%.1f" % r["flake_pct"],
+                 max(8, args.window), r["recent"], mark))
+    if bad:
+        print("FAIL: gate(s) all-fail over the last %d run(s): %s"
+              % (args.window, ", ".join(bad)), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
