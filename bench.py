@@ -884,6 +884,227 @@ def run_serve_overload(duration_s=6.0, capacity_s=2.0, hi_frac=0.2,
     return out
 
 
+def build_generation_model(vocab=31, seed=0):
+    """Small Seq2Seq generation model + priming forward — shared by
+    `bench.py generate` and tools/check_decode.py so the CI gate and
+    the bench measure the same workload."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.models import Seq2Seq
+    mx.random.seed(seed)
+    net = Seq2Seq(vocab, vocab, embed_dim=24, hidden=32, num_layers=2)
+    net.initialize(force_reinit=True)
+    net(nd.array(np.ones((1, 4), np.int32)),
+        nd.array(np.ones((1, 1), np.int32)))        # concrete shapes
+    return net
+
+
+def measure_generate_capacity(eng, prompts, seconds, max_new,
+                              lane=None):
+    """Closed-loop generation saturation (requests/s) with bounded
+    outstanding work — the denominator the 2x open-loop offer is
+    derived from.  Shared with tools/check_decode.py."""
+    t0 = time.perf_counter()
+    streams, done, i = [], 0, 0
+    depth = max(4, eng.stats()["slots"] * 2)
+    while time.perf_counter() < t0 + seconds:
+        streams.append(eng.submit(prompts[i % len(prompts)],
+                                  max_new_tokens=max_new, lane=lane))
+        i += 1
+        if len(streams) >= depth:
+            streams.pop(0).result(timeout=120)
+            done += 1
+    for s in streams:
+        s.result(timeout=120)
+        done += 1
+    return done / (time.perf_counter() - t0)
+
+
+def _generate_overload(eng, prompts, rate, duration_s, hi_frac,
+                       hi_lane, lo_lane, hi_deadline, lo_deadline,
+                       max_new, rs):
+    """Open-loop Poisson generation traffic at `rate` req/s: the
+    client never slows down with the server, so the overload is real.
+    Generation lengths are HETEROGENEOUS (uniform in [3, max_new] per
+    request, drawn from the shared schedule RNG so both engines see
+    identical work) — the regime continuous batching exists for: a
+    drain batch holds every freed slot hostage to its longest
+    sequence, a continuous batch backfills it immediately.  Returns
+    (offered, served, shed, wall)."""
+    from incubator_mxnet_tpu.serving import (Shed, QueueFull,
+                                             DeadlineExceeded)
+    served = {hi_lane: 0, lo_lane: 0}
+    shed = {hi_lane: 0, lo_lane: 0}
+    pending = []
+    t0 = time.perf_counter()
+    next_t, n_offered = t0, 0
+    while True:
+        now = time.perf_counter()
+        if now >= t0 + duration_s:
+            break
+        if now < next_t:
+            time.sleep(min(next_t - now, 0.002))
+            continue
+        next_t += rs.exponential(1.0 / rate)
+        lane = hi_lane if rs.rand() < hi_frac else lo_lane
+        dl = hi_deadline if lane == hi_lane else lo_deadline
+        mn = int(rs.randint(3, max_new + 1))
+        n_offered += 1
+        try:
+            pending.append((lane, eng.submit(
+                prompts[n_offered % len(prompts)],
+                max_new_tokens=mn, deadline=dl, lane=lane)))
+        except (Shed, QueueFull, DeadlineExceeded):
+            shed[lane] += 1
+    wall = time.perf_counter() - t0
+    for lane, s in pending:
+        try:
+            s.result(timeout=120)
+            served[lane] += 1
+        except (Shed, QueueFull, DeadlineExceeded):
+            shed[lane] += 1
+    return n_offered, served, shed, wall
+
+
+def run_generate(duration_s=5.0, capacity_s=1.5, hi_frac=0.2,
+                 slots=4, max_len=24, max_new=12, seed=0, extra=None):
+    """Generation serving bench (ISSUE 14): the KV-cached
+    continuous-batching GenerationEngine under open-loop Poisson
+    traffic at 2x its MEASURED capacity, 20/80 hi/lo lane mix.
+
+    Reports tokens/s, per-lane TTFT p50/p99 and inter-token p99 (the
+    generation tails users feel), the zero-recompile check, and the
+    tentpole A/B: the SAME Poisson schedule driven at a drain-batching
+    engine (continuous=False — a new batch only forms when every slot
+    is free).  Continuous batching must beat drain on TTFT p99 under
+    overload: that win is what `generate_ok` gates (judged only when
+    the open loop actually achieved 2x — a starved submitter proves
+    nothing)."""
+    from incubator_mxnet_tpu.monitor import events
+    from incubator_mxnet_tpu.serving import GenerationEngine
+
+    net = build_generation_model(seed=seed)
+    rs = np.random.RandomState(seed)
+    prompts = [rs.randint(3, 31, (int(n),))
+               for n in rs.choice((3, 4, 5, 6, 7, 8), 64)]
+
+    out = {"generate_model": "seq2seq_small_v31",
+           "generate_slots": slots, "generate_max_len": max_len,
+           "generate_max_new_tokens": max_new}
+    results = {}
+    # continuous first (it also supplies the measured capacity the
+    # drain phase's offered rate reuses — same schedule, same rate)
+    capacity = None
+    for mode, lanes in (("cb", ("cap", "hi", "lo")),
+                        ("drain", ("dcap", "dhi", "dlo"))):
+        eng = GenerationEngine(
+            net, bos=1, eos=2, slots=slots, max_len=max_len,
+            prompt_buckets=(4, 8), queue_cap=64,
+            lanes=lanes, lane_quotas=(1.0, 1.0, 0.5),
+            continuous=(mode == "cb"))
+        warm = eng.warmup()
+        traces0 = events.get("serve.traces")
+        if capacity is None:
+            capacity = measure_generate_capacity(
+                eng, prompts, capacity_s, max_new)
+            # deadline self-calibrated to the measured per-request
+            # service wall (the overload_deadline_s discipline)
+            svc = 1.0 / max(capacity / slots, 1e-6)
+            hi_deadline = max(0.5, 3.5 * svc)
+            lo_deadline = 2.0 * hi_deadline
+            out["generate_capacity_rps"] = round(capacity, 2)
+            out["generate_hi_deadline_ms"] = round(hi_deadline * 1e3, 1)
+            out["generate_warmup_wall_s"] = warm["wall_s"]
+            out["generate_kv_cache_bytes"] = warm["kv_cache"]["total"]
+        rate = 2.0 * capacity
+        tok0 = events.get("gen.tokens")
+        rs_phase = np.random.RandomState(seed + 17)     # SAME schedule
+        offered, served, shed, wall = _generate_overload(
+            eng, prompts, rate, duration_s, hi_frac,
+            lanes[1], lanes[2], hi_deadline, lo_deadline, max_new,
+            rs_phase)
+        traces_delta = events.get("serve.traces") - traces0
+        toks = events.get("gen.tokens") - tok0
+        eng.close()
+        lanes_pct = {r["labels"]["lane"]: r
+                     for r in events.labeled_percentiles(
+                         "gen.ttft_us", (50, 99))
+                     if r["labels"].get("lane") in (lanes[1], lanes[2])}
+        hi = lanes_pct.get(lanes[1], {})
+        # inter-token from THIS phase's hi-lane labeled ring — the
+        # unlabeled aggregate mixes capacity/drain-phase samples (the
+        # same leak check_decode avoids via unique lane names)
+        it_pct = {r["labels"]["lane"]: r
+                  for r in events.labeled_percentiles(
+                      "gen.intertoken_us", (50, 99))}
+        it_hi = it_pct.get(lanes[1], {})
+        results[mode] = {
+            "intertoken_p50_ms": it_hi.get("p50", 0) / 1e3,
+            "intertoken_p99_ms": it_hi.get("p99", 0) / 1e3,
+            "offered": offered, "wall": wall,
+            "achieved_rps": offered / max(wall, 1e-9),
+            "served_hi": served[lanes[1]], "served_lo": served[lanes[2]],
+            "shed_hi": shed[lanes[1]], "shed_lo": shed[lanes[2]],
+            "tokens": toks, "tokens_per_sec": toks / max(wall, 1e-9),
+            "ttft_hi_p50_ms": hi.get("p50", 0) / 1e3,
+            "ttft_hi_p99_ms": hi.get("p99", 0) / 1e3,
+            "traces_delta": traces_delta,
+        }
+    cb, dr = results["cb"], results["drain"]
+    out.update({
+        "generate_offered_rps": round(2.0 * capacity, 2),
+        "generate_achieved_rps": round(cb["achieved_rps"], 2),
+        "generate_tokens_per_sec": round(cb["tokens_per_sec"], 1),
+        "generate_ttft_p50_ms": round(cb["ttft_hi_p50_ms"], 2),
+        "generate_ttft_p99_ms": round(cb["ttft_hi_p99_ms"], 2),
+        "generate_intertoken_p50_ms": round(
+            cb["intertoken_p50_ms"], 3),
+        "generate_intertoken_p99_ms": round(
+            cb["intertoken_p99_ms"], 3),
+        "generate_shed_fraction": round(
+            (cb["shed_hi"] + cb["shed_lo"]) / max(1, cb["offered"]), 3),
+        "generate_traces_after_warmup_delta": cb["traces_delta"],
+        "generate_cb_ttft_p99_ms": round(cb["ttft_hi_p99_ms"], 2),
+        "generate_drain_ttft_p99_ms": round(dr["ttft_hi_p99_ms"], 2),
+        "generate_drain_tokens_per_sec": round(dr["tokens_per_sec"], 1),
+        "generate_cb_win": bool(
+            cb["ttft_hi_p99_ms"] < dr["ttft_hi_p99_ms"]),
+    })
+    # the aot load-path breaker verdict rides along (ISSUE 14
+    # satellite): a backend whose deserialize path is broken now says
+    # so once instead of a stale storm
+    out["generate_aot_load_disabled"] = \
+        events.get("aot.load_disabled") or 0
+    achieved_2x = (cb["achieved_rps"] >= 1.3 * capacity
+                   and dr["achieved_rps"] >= 1.3 * capacity)
+    if achieved_2x:
+        out["generate_ok"] = bool(
+            out["generate_cb_win"]
+            and cb["traces_delta"] == 0
+            and cb["ttft_hi_p99_ms"] <= hi_deadline * 1e3)
+    else:
+        out["generate_ok"] = None       # never actually overloaded
+    if extra is not None:
+        extra.update(out)
+    return out
+
+
+def _merge_bench_serve(patch, rc=0):
+    """Merge `patch` keys into BENCH_serve.json's parsed record
+    (creating it if absent) — `bench.py generate` rides in the same
+    trajectory file as the one-shot serve numbers."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, "BENCH_serve.json")
+    parsed = {}
+    try:
+        with open(path) as fh:
+            parsed = json.load(fh).get("parsed", {})
+    except Exception:
+        pass
+    parsed.update(patch)
+    return _write_bench_serve(parsed, rc=rc)
+
+
 def _write_bench_serve(parsed, rc=0):
     """BENCH_serve.json in the BENCH_r* schema ({n, cmd, rc, tail,
     parsed}) so the perf-trajectory tooling picks the serving numbers
@@ -2006,6 +2227,7 @@ _CONFIGS = {
         "resnet50_int8_infer_images_per_sec", run_int8_infer, (64, 32)),
     "quality": lambda b=None: run_quality(),
     "serve": lambda b=None: _cfg_serve(),
+    "generate": lambda b=None: _cfg_generate(),
     "elastic": lambda b=None: _cfg_elastic(),
     "integrity": lambda b=None: _cfg_integrity(),
     "multichip": lambda b=None: _cfg_multichip(),
@@ -2102,6 +2324,15 @@ def _cfg_serve():
     return parsed
 
 
+def _cfg_generate():
+    parsed = run_generate()
+    try:
+        _merge_bench_serve(parsed)      # generate_* keys ride in the
+    except Exception:                   # serve trajectory file
+        pass
+    return parsed
+
+
 def _cfg_elastic():
     parsed = run_elastic()
     try:
@@ -2155,15 +2386,15 @@ def main():
     times = {}
     required = ("resnet", "bert", "ssd512", "rcnn", "gnmt",
                 "transformer_nmt", "wide_deep")
-    optional = ("io", "serve", "sharded", "elastic", "multichip",
-                "quality", "int8")
+    optional = ("io", "serve", "generate", "sharded", "elastic",
+                "multichip", "quality", "int8")
 
     # optional configs need this much budget left to be worth starting
     # (below it they'd time out AT the budget edge instead of skipping
     # cleanly — int8's quantization calibration alone needs ~4 min cold)
-    optional_min = {"io": 30, "serve": 90, "sharded": 90,
-                    "elastic": 60, "multichip": 90, "quality": 120,
-                    "int8": 250}
+    optional_min = {"io": 30, "serve": 90, "generate": 60,
+                    "sharded": 90, "elastic": 60, "multichip": 90,
+                    "quality": 120, "int8": 250}
 
     for name in required + optional:
         remaining = budget - (time.perf_counter() - t_start)
@@ -2273,6 +2504,29 @@ if __name__ == "__main__":
                 else 1
         except Exception as e:
             parsed, rc = {"serve_overload_error": str(e)[:160]}, 1
+        print(json.dumps(parsed))
+        sys.exit(rc)
+    if len(sys.argv) >= 2 and sys.argv[1] == "generate":
+        # standalone generation bench (ISSUE 14): ONE JSON line;
+        # generate_* keys merged into BENCH_serve.json.  rc 1 only
+        # when the scenario RAN overloaded and the contract broke
+        # (drain beat continuous on TTFT p99, a recompile leaked into
+        # steady state, or hi TTFT p99 blew its deadline)
+        try:
+            parsed = run_generate()
+            rc = 0 if parsed.get("generate_ok") is not False else 1
+        except Exception as e:
+            parsed, rc = {"generate_error": str(e)[:160]}, 1
+            try:
+                from incubator_mxnet_tpu import telemetry
+                parsed["generate_blackbox"] = telemetry.dump_blackbox(
+                    reason="bench.generate", exc=e)
+            except Exception:
+                pass
+        try:
+            _merge_bench_serve(parsed, rc=rc)
+        except Exception:
+            pass
         print(json.dumps(parsed))
         sys.exit(rc)
     if len(sys.argv) >= 2 and sys.argv[1] == "serve":

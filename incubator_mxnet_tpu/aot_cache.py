@@ -43,6 +43,46 @@ _EXEC_DEVICES_KW = None     # lazy: does this jax's deserialize_and_load
                             # accept execution_devices=? (one signature
                             # reflection per process, not per load)
 
+# Disk-load circuit breaker (ISSUE 14 satellite).  The BENCH_serve
+# smoking gun (aot.stale: 7 = aot.miss: 7, every reason
+# deserialize_error) is a backend whose deserialize path fails
+# DETERMINISTICALLY — each executable then pays a doomed read+
+# deserialize before recompiling, every run, and the warm path never
+# engages.  Two defenses, both per-process:
+#   1. `_LOAD_BREAKER_FAILS` consecutive deserialize_error stales trip
+#      the breaker: remaining executables skip the load attempt
+#      entirely (aot.load_skipped) — one classified verdict
+#      (aot.load_disabled + ring event + warning) instead of N failed
+#      loads.  Any successful load resets the streak.
+#   2. After the FIRST store, the just-written blob is read back and
+#      deserialized once (self-verify): a backend that cannot load its
+#      own serializations is caught in the run that WROTE the cache,
+#      not discovered as a stale storm in the next one.
+_LOAD_FAILS = [0]           # consecutive deserialize_error count
+_LOAD_FAIL_DIR = [None]     # cache dir the streak was observed in —
+                            # a dir change (tests point at fresh tmp
+                            # dirs) is a different cache, not more
+                            # evidence against this backend
+_LOADS_DISABLED = [None]    # reason string once tripped
+_SELF_VERIFIED = [False]    # one post-store verify per process
+_LOAD_BREAKER_FAILS = 2
+
+
+def _disable_loads(reason, detail=""):
+    if _LOADS_DISABLED[0] is not None:
+        return
+    _LOADS_DISABLED[0] = str(reason)
+    events.incr("aot.load_disabled")
+    _bb.record("aot", "load_disabled", reason=str(reason),
+               detail=str(detail)[:200])
+    import warnings
+    warnings.warn(
+        "aot_cache: disk-load path disabled for this process (%s%s) "
+        "— executables still compile and re-serialize, but "
+        "deserialization on this backend fails deterministically; "
+        "loads will be skipped instead of failing one by one"
+        % (reason, (": " + str(detail)[:120]) if detail else ""))
+
 
 def cache_dir():
     return _cfg.get("MXNET_AOT_CACHE_DIR") or ""
@@ -240,7 +280,15 @@ class _AotJitted:
             cache_dir(),
             _key_for(lowered, dev) + ".d%d.pjrtx" % getattr(dev, "id", 0))
         t2 = _t.perf_counter()
-        if os.path.exists(path):
+        if os.path.exists(path) and _LOADS_DISABLED[0] is not None:
+            # breaker open: this backend's deserialize fails
+            # deterministically — skip the doomed read+load instead of
+            # adding another stale to the storm
+            events.incr("aot.load_skipped")
+            if dbg:
+                print("[aot] LOAD-SKIP (%s) %s"
+                      % (_LOADS_DISABLED[0], os.path.basename(path)))
+        elif os.path.exists(path):
             try:
                 with _tele.span("aot.load"):
                     with open(path, "rb") as f:
@@ -254,7 +302,8 @@ class _AotJitted:
                     os.utime(path)
                 except OSError:
                     pass
-                events.incr("aot.hit")
+                _LOAD_FAILS[0] = 0      # a working load path resets
+                events.incr("aot.hit")  # the breaker streak
                 events.observe_time("aot.load_us",
                                     _t.perf_counter() - t2)
                 self._note_cost(sig, lowered, out,
@@ -277,6 +326,20 @@ class _AotJitted:
                                type(stale_exc).__name__,
                                stale_exc))[:160],
                            blob=os.path.basename(path))
+                if reason == "deserialize_error":
+                    # version/backend/key mismatches are honest one-off
+                    # staleness; repeated DESERIALIZE failures against
+                    # ONE cache dir are a broken load path — trip the
+                    # breaker (a dir change restarts the evidence)
+                    if _LOAD_FAIL_DIR[0] != cache_dir():
+                        _LOAD_FAIL_DIR[0] = cache_dir()
+                        _LOAD_FAILS[0] = 0
+                    _LOAD_FAILS[0] += 1
+                    if _LOAD_FAILS[0] >= _LOAD_BREAKER_FAILS:
+                        _disable_loads(
+                            "deserialize_error x%d" % _LOAD_FAILS[0],
+                            detail="%s: %s" % (
+                                type(stale_exc).__name__, stale_exc))
                 if dbg:
                     print("[aot] STALE (%s) %s"
                           % (reason, os.path.basename(path)))
@@ -298,6 +361,23 @@ class _AotJitted:
                 f.write(blob)
             os.replace(tmp, path)       # atomic: concurrent procs race safely
             trim_cache()                # keep-K bound (MXNET_AOT_CACHE_MAX)
+            if not _SELF_VERIFIED[0]:
+                # one round trip per process: prove THIS backend can
+                # load its own serializations in the run that writes
+                # the cache, instead of discovering a stale storm on
+                # the warm run (the deserialize_error:6 smoking gun)
+                _SELF_VERIFIED[0] = True
+                try:
+                    in_tree = tu.tree_structure((tuple(args), {}))
+                    out_tree = tu.tree_structure(lowered.out_info)
+                    self._deserialize(blob, in_tree, out_tree, dev)
+                    events.incr("aot.selfcheck_ok")
+                except Exception as ver_exc:    # noqa: BLE001
+                    events.incr("aot.selfcheck_failed")
+                    _disable_loads("self_verify",
+                                   detail="%s: %s" % (
+                                       type(ver_exc).__name__,
+                                       ver_exc))
         except Exception:
             pass                        # cache write is best-effort
         return compiled

@@ -355,6 +355,24 @@ register("MXNET_SERVE_TENANT_QUOTA", int, 0,
          "serve.shed counter labeled by tenant) so one tenant's burst "
          "cannot starve the queue for everyone. 0 = no per-tenant "
          "bound")
+register("MXNET_GEN_SLOTS", int, 4,
+         "GenerationEngine (serving.generation): decode-batch slot "
+         "count — the fixed sequence capacity ONE decode executable "
+         "is specialized to.  Finished sequences free their slot at a "
+         "step boundary and queued requests join immediately "
+         "(continuous batching); HBM grows with slots × per-slot KV "
+         "bytes, which generation admission accounts for")
+register("MXNET_GEN_MAX_LEN", int, 64,
+         "GenerationEngine: max_len bucket — the per-slot KV/state "
+         "buffer length the decode executable is specialized to; "
+         "bounds prompt length and emitted tokens per request.  Must "
+         "not exceed the model's positional table")
+register("MXNET_GEN_BUCKETS", str, "",
+         "GenerationEngine: comma-separated PROMPT-length buckets "
+         "(prefill executables; prompts pad up to a bucket).  Empty "
+         "= powers of two from 8 up to MXNET_GEN_MAX_LEN.  The set "
+         "is CLOSED: after warmup() no prompt length ever traces a "
+         "new executable (serve.traces stays flat)")
 register("MXNET_SERVE_HBM_BUDGET", int, 0,
          "ModelRegistry: per-device HBM budget in bytes for serving "
          "admission control. 0 = auto (the device's PJRT bytes_limit "

@@ -63,6 +63,68 @@ class Seq2Seq(HybridBlock):
         mix = self.att_dense(ctx) + q
         return self.proj(mix)                                 # (B, Tt, V)
 
+    # -- explicit-cache decode (serving.generation contract) -----------
+    # Every cache leaf is SLOT-MAJOR (axis 0 = request/slot), so the
+    # GenerationEngine's join/retire are cheap masked updates along one
+    # axis.  Exactness under right-padding: RNN_varlen freezes the
+    # encoder recurrence at src_valid_len (the decoder init state is
+    # the state AT the prompt's real end, not after the pad tail), the
+    # zeroed pad outputs are additionally masked out of the attention
+    # softmax with -1e9 (exp underflows to exactly 0), so a padded
+    # prompt decodes token-identically to the unpadded forward —
+    # the contrib.text.decode greedy-parity oracle rides on this.
+
+    def init_cache(self, src, src_valid_len, max_len=None, mem_len=None):
+        """Prefill: encode `src` (B, Ts) int ids with valid lengths
+        `src_valid_len` (B,) and return the decode cache.  `mem_len`
+        pads the attention memory out to a fixed length so every
+        prompt bucket yields ONE decode-executable signature
+        (`max_len` is unused — LSTM decode state is O(1) in emitted
+        tokens).  Leaves: enc_k (B, M, H), src_len (B,), h/c
+        (B, L, H)."""
+        from .. import ndarray as F
+        B = src.shape[0]
+        Ts = src.shape[1]
+        enc_in = self.src_embed(src).transpose((1, 0, 2))   # (Ts, B, E)
+        s0 = self.encoder.begin_state(batch_size=B)
+        enc_out, h, c = F.RNN_varlen(
+            enc_in, self.encoder.parameters.data(), s0[0], s0[1],
+            src_valid_len, state_size=self._hidden,
+            num_layers=self.encoder._num_layers, mode="lstm")
+        k = enc_out.transpose((1, 0, 2))                    # (B, Ts, H)
+        if mem_len is not None and int(mem_len) > int(Ts):
+            k = F.concat(k, F.zeros((B, int(mem_len) - int(Ts),
+                                     self._hidden)), dim=1)
+        return {"enc_k": k,
+                "src_len": src_valid_len.reshape((-1,)),
+                "h": h.transpose((1, 0, 2)),                # (B, L, H)
+                "c": c.transpose((1, 0, 2))}
+
+    def decode_step(self, tok, pos, cache):
+        """One decode step: feed token `tok` (B,) at target position
+        `pos` (B,; unused — LSTM state carries position) and return
+        (next-token logits (B, V), updated cache)."""
+        from .. import ndarray as F
+        x = self.tgt_embed(tok.reshape((-1, 1)))            # (B, 1, E)
+        x = x.transpose((1, 0, 2))                          # (1, B, E)
+        states = [cache["h"].transpose((1, 0, 2)),
+                  cache["c"].transpose((1, 0, 2))]
+        dec_out, new_states = self.decoder(x, states)       # (1, B, H)
+        q = dec_out.transpose((1, 0, 2))                    # (B, 1, H)
+        k = cache["enc_k"]                                  # (B, M, H)
+        scores = F.batch_dot(q, k, transpose_b=True)        # (B, 1, M)
+        M = k.shape[1]
+        steps = F.arange(0, M).reshape((1, 1, M))
+        invalid = steps >= cache["src_len"].reshape((-1, 1, 1))
+        attn = F.softmax(scores + invalid * -1e9, axis=-1)
+        ctx = F.batch_dot(attn, k)                          # (B, 1, H)
+        mix = self.att_dense(ctx) + q
+        logits = self.proj(mix)                             # (B, 1, V)
+        new_cache = dict(cache)
+        new_cache["h"] = new_states[0].transpose((1, 0, 2))
+        new_cache["c"] = new_states[1].transpose((1, 0, 2))
+        return logits.reshape((0, -1)), new_cache
+
 
 class GNMT(HybridBlock):
     """GNMT-architecture LSTM seq2seq at reference geometry (BASELINE

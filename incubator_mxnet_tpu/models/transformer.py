@@ -461,6 +461,7 @@ class TransformerNMT(HybridBlock):
                  dropout=0.1, output_hidden=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
+        self._num_heads = num_heads
         self._max_length = max_length
         self.src_embed = nn.Embedding(src_vocab, units)
         self.tgt_embed = nn.Embedding(tgt_vocab, units)
@@ -508,6 +509,114 @@ class TransformerNMT(HybridBlock):
         h = self.decoder(self._embed(self.tgt_embed, self.dec_ln, tgt),
                          memory, mem_mask)
         return h if self.out_proj is None else self.out_proj(h)
+
+
+def _mem_mask_for(F, src, src_valid_len):
+    """The additive (B, 1, 1, Ts) source-padding mask `forward` builds
+    from src_valid_length — ONE definition shared with the cache
+    path."""
+    B = src.shape[0]
+    steps = F.reshape(_position_ids(F, src), (1, -1))       # (1, Ts)
+    keep = F.broadcast_lesser(
+        steps, F.reshape(src_valid_len, (-1, 1)))           # (B, Ts)
+    return F.expand_dims(F.expand_dims((keep - 1.0) * 1e9,
+                                       axis=1), axis=1)
+
+
+# -- explicit-cache decode (serving.generation contract) ---------------
+# TransformerNMT grows init_cache/decode_step: per-decoder-layer
+# self-attention K/V buffers pre-allocated at (B, max_len, U) written
+# by one-hot masked updates at each slot's own position (continuous
+# batching = slots at DIFFERENT positions in one fixed-shape
+# executable), plus cross-attention K/V precomputed from the encoder
+# memory once at prefill.  All cache leaves are slot-major.  Padding
+# is exactly neutral: attention masks underflow pad weights to 0 and
+# every other op is position-wise.
+
+def _nmt_init_cache(self, src, src_valid_len, max_len, mem_len=None):
+    """Prefill: run the encoder over `src` (B, Ts) with the padding
+    mask, precompute each decoder layer's cross-attention K/V, and
+    allocate zeroed self-attention K/V buffers for `max_len` decode
+    positions.  `mem_len` pads the memory axis so every prompt bucket
+    produces ONE decode signature."""
+    from .. import ndarray as F
+    B = src.shape[0]
+    Ts = src.shape[1]
+    mem_mask = _mem_mask_for(F, src, src_valid_len)
+    memory = self.encoder(self._embed(self.src_embed, self.enc_ln,
+                                      src), mask=mem_mask)  # (B, Ts, U)
+    if mem_len is not None and int(mem_len) > int(Ts):
+        memory = F.concat(
+            memory, F.zeros((B, int(mem_len) - int(Ts), self._units)),
+            dim=1)
+    cache = {"src_len": src_valid_len.reshape((-1,))}
+    zeros = F.zeros((B, int(max_len), self._units))
+    for i, layer in enumerate(self.decoder.layers._children.values()):
+        ca = layer.cross_attn
+        cache["mem_k%d" % i] = ca.key(memory)               # (B, M, U)
+        cache["mem_v%d" % i] = ca.value(memory)
+        cache["k%d" % i] = zeros
+        cache["v%d" % i] = zeros
+    return cache
+
+
+def _nmt_decode_step(self, tok, pos, cache):
+    """One decode step: token `tok` (B,) at target position `pos`
+    (B,) against the cached K/V.  Returns (logits (B, V), updated
+    cache).  The K/V write is a one-hot masked update at each row's
+    own position — no reshape, no gather/scatter with dynamic
+    shapes."""
+    from .. import ndarray as F
+    H = self._num_heads
+    L = cache["k0"].shape[1]
+    M = cache["mem_k0"].shape[1]
+    scale = 1.0 / math.sqrt(self._units // H)
+    x = self.tgt_embed(tok.reshape((-1, 1))) \
+        * math.sqrt(self._units) \
+        + self.pos_embed(pos.reshape((-1, 1)))              # (B, 1, U)
+    x = self.dec_ln(x)
+    # additive masks: self-attention sees positions <= pos (one query
+    # row per slot, each at its OWN position — the continuous-batching
+    # point), cross-attention sees the real source positions
+    steps = F.arange(0, L).reshape((1, 1, 1, L))
+    self_mask = (steps > pos.reshape((-1, 1, 1, 1))) * -1e9
+    msteps = F.arange(0, M).reshape((1, 1, 1, M))
+    mem_mask = (msteps >=
+                cache["src_len"].reshape((-1, 1, 1, 1))) * -1e9
+    oh = F.expand_dims(F.one_hot(pos, L), axis=2)           # (B, L, 1)
+    new_cache = dict(cache)
+
+    def _attend(q, k, v, mask):
+        qh = _split_heads(F, q, H)                          # (B·H, 1, d)
+        kh = _split_heads(F, k, H)
+        vh = _split_heads(F, v, H)
+        sc = F.batch_dot(qh, kh, transpose_b=True) * scale  # (B·H,1,T)
+        sc = F.reshape(sc, (-4, -1, H, 0, 0)) + mask        # (B,H,1,T)
+        at = F.reshape(F.softmax(sc, axis=-1), (-3, 0, 0))
+        return F.batch_dot(at, vh)                          # (B·H, 1, d)
+
+    for i, layer in enumerate(self.decoder.layers._children.values()):
+        sa = layer.self_attn
+        kc = cache["k%d" % i] * (1.0 - oh) + sa.key(x) * oh
+        vc = cache["v%d" % i] * (1.0 - oh) + sa.value(x) * oh
+        new_cache["k%d" % i] = kc
+        new_cache["v%d" % i] = vc
+        ctx = _attend(sa.query(x), kc, vc, self_mask)
+        x = layer.ln1(x + sa.proj(_merge_heads(F, ctx, H)))
+        ca = layer.cross_attn
+        ctx = _attend(ca.query(x), cache["mem_k%d" % i],
+                      cache["mem_v%d" % i], mem_mask)
+        x = layer.ln2(x + ca.proj(_merge_heads(F, ctx, H)))
+        x = layer.ln3(x + layer.ffn(x))
+    if self.out_proj is None:
+        raise ValueError("decode_step needs the vocab projection "
+                         "(build TransformerNMT without "
+                         "output_hidden=True for generation)")
+    return self.out_proj(x).reshape((0, -1)), new_cache
+
+
+TransformerNMT.init_cache = _nmt_init_cache
+TransformerNMT.decode_step = _nmt_decode_step
 
 
 def transformer_nmt_base(src_vocab, tgt_vocab, **kwargs):

@@ -92,12 +92,48 @@ def _cell_step(mode, state_size):
     return step
 
 
-def _run_layer(x, h0, c0, wx, wh, bx, bh, mode, reverse=False):
-    """x: (T, B, I). Returns (outputs (T,B,H), hT, cT)."""
+def _run_layer(x, h0, c0, wx, wh, bx, bh, mode, reverse=False,
+               seq_len=None):
+    """x: (T, B, I). Returns (outputs (T,B,H), hT, cT).
+
+    seq_len (B,) int — cuDNN-style variable-length semantics (ref:
+    rnn-inl.h use_sequence_length): per-row state updates FREEZE past
+    that row's length (so hT/cT are the states AT each sequence's end,
+    not after running over padding) and outputs at padded positions
+    are zeroed.  For the reverse direction the padded prefix of the
+    flipped sequence is skipped the same way, so a reversed scan sees
+    exactly the real tokens in reverse order.  This is the exactness
+    contract generation prefill rides on: right-padding a prompt to a
+    shape bucket must not change the encoder state handed to decode."""
+    T = x.shape[0]
     state_size = wh.shape[1]
     xg = jnp.einsum("tbi,gi->tbg", x, wx) + bx     # (T, B, G*H) — MXU
     if reverse:
         xg = jnp.flip(xg, axis=0)
+    if seq_len is None:
+        keep = None
+    else:
+        # valid step mask per (t, row): forward keeps t < len; in the
+        # flipped order pads come FIRST, so reverse keeps t >= T - len
+        t_idx = jnp.arange(T)[:, None]              # (T, 1)
+        sl = seq_len.astype(jnp.int32)[None, :]     # (1, B)
+        keep = (t_idx >= T - sl) if reverse else (t_idx < sl)
+        keep = keep[:, :, None]                     # (T, B, 1)
+
+    def _freeze(step):
+        """Wrap a scan body: frozen rows keep their carry and emit 0."""
+        if keep is None:
+            return lambda carry, inp: step(carry, inp)
+
+        def frozen(carry, inp):
+            xg_t, k_t = inp
+            new, y = step(carry, xg_t)
+            new = tuple(jnp.where(k_t, n, o)
+                        for n, o in zip(new, carry))
+            return new, jnp.where(k_t, y, jnp.zeros_like(y))
+        return frozen
+
+    xs = xg if keep is None else (xg, keep)
 
     if mode == "gru":
         def step(carry, xg_t):
@@ -110,7 +146,7 @@ def _run_layer(x, h0, c0, wx, wh, bx, bh, mode, reverse=False):
             n = jnp.tanh(xn + r * hn)
             new_h = (1 - z) * n + z * h
             return (new_h,), new_h
-        (hT,), ys = lax.scan(step, (h0,), xg)
+        (hT,), ys = lax.scan(_freeze(step), (h0,), xs)
         cT = None
     elif mode == "lstm":
         cell = _cell_step(mode, state_size)
@@ -120,7 +156,7 @@ def _run_layer(x, h0, c0, wx, wh, bx, bh, mode, reverse=False):
             gates = xg_t + jnp.matmul(h, wh.T) + bh
             new = cell((h, c), gates)
             return new, new[0]
-        (hT, cT), ys = lax.scan(step, (h0, c0), xg)
+        (hT, cT), ys = lax.scan(_freeze(step), (h0, c0), xs)
     else:
         cell = _cell_step(mode, state_size)
 
@@ -129,7 +165,7 @@ def _run_layer(x, h0, c0, wx, wh, bx, bh, mode, reverse=False):
             gates = xg_t + jnp.matmul(h, wh.T) + bh
             new = cell((h,), gates)
             return new, new[0]
-        (hT,), ys = lax.scan(step, (h0,), xg)
+        (hT,), ys = lax.scan(_freeze(step), (h0,), xs)
         cT = None
     if reverse:
         ys = jnp.flip(ys, axis=0)
@@ -156,11 +192,21 @@ def rnn(data, parameters, state, state_cell=None, state_size=0,
         sequence_length=None, lstm_state_clip_min=None,
         lstm_state_clip_max=None, _training=True, _rng_key=None):
     """data: (T, B, I) (TNC layout, as the reference's default `rnn` call
-    from gluon.rnn_layer).  state: (L*D, B, H); lstm also state_cell."""
+    from gluon.rnn_layer).  state: (L*D, B, H); lstm also state_cell.
+
+    use_sequence_length + sequence_length (B,): cuDNN variable-length
+    semantics — per-row recurrence freezes at that row's length (final
+    states are the states AT the length), outputs past it are zeroed,
+    and the reverse direction of a bidirectional stack starts at each
+    row's last REAL token.  Right-padding then cannot perturb any
+    valid position (the generation-prefill exactness contract)."""
     T, B, I = data.shape
     d = 2 if bidirectional else 1
     ws, bs = _unpack(parameters, mode, num_layers, I, state_size,
                      bidirectional)
+    seq_len = None
+    if use_sequence_length and sequence_length is not None:
+        seq_len = jnp.reshape(sequence_length, (-1,))
     hs_out, cs_out = [], []
     x = data
     key = _rng_key
@@ -173,7 +219,8 @@ def rnn(data, parameters, state, state_cell=None, state_size=0,
             h0 = state[idx]
             c0 = state_cell[idx] if state_cell is not None else None
             ys, hT, cT = _run_layer(x, h0, c0, wx, wh, bx, bh, mode,
-                                    reverse=(direction == 1))
+                                    reverse=(direction == 1),
+                                    seq_len=seq_len)
             outs.append(ys)
             hs_out.append(hT)
             if cT is not None:
@@ -189,3 +236,27 @@ def rnn(data, parameters, state, state_cell=None, state_size=0,
         if mode == "lstm":
             outputs.append(jnp.stack(cs_out, axis=0))
     return tuple(outputs) if len(outputs) > 1 else outputs[0]
+
+
+@register("RNN_varlen",
+          ndarray_inputs=("data", "parameters", "state", "state_cell",
+                          "sequence_length"),
+          num_outputs=-1, num_outputs_fn=_rnn_num_outputs,
+          needs_rng=True, jit=True)
+def rnn_varlen(data, parameters, state, state_cell=None,
+               sequence_length=None, state_size=0, num_layers=1,
+               bidirectional=False, mode="lstm", p=0.0,
+               state_outputs=True, _training=True, _rng_key=None):
+    """Variable-length `RNN`: `sequence_length` (B,) int rides as a
+    POSITIONAL tensor input (imperative dispatch unwraps positional
+    NDArrays only, so the length vector cannot be a keyword attr).
+    Same semantics as `RNN(use_sequence_length=True, ...)`: per-row
+    state freezing at the length, zeroed outputs past it, reverse
+    direction anchored at each row's last real token.  Non-lstm modes
+    pass ``state_cell=None`` positionally."""
+    return rnn(data, parameters, state, state_cell=state_cell,
+               state_size=state_size, num_layers=num_layers,
+               bidirectional=bidirectional, mode=mode, p=p,
+               state_outputs=state_outputs, use_sequence_length=True,
+               sequence_length=sequence_length, _training=_training,
+               _rng_key=_rng_key)

@@ -26,8 +26,12 @@ from .engine import (InferenceEngine, QueueFull, DeadlineExceeded,
                      EngineClosed, Shed, serve_counters)
 from .registry import (ModelRegistry, AdmissionDenied, CircuitOpen,
                        UnknownModel, project_footprint)
+from .generation import (GenerationEngine, GenerationStream,
+                         project_generation_footprint)
 
 __all__ = ["InferenceEngine", "QueueFull", "DeadlineExceeded",
            "EngineClosed", "Shed", "serve_counters",
            "ModelRegistry", "AdmissionDenied", "CircuitOpen",
-           "UnknownModel", "project_footprint"]
+           "UnknownModel", "project_footprint",
+           "GenerationEngine", "GenerationStream",
+           "project_generation_footprint"]

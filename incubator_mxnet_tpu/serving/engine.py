@@ -170,6 +170,12 @@ class _LaneQueue:
     task_done/qsize/maxsize/unfinished_tasks/all_tasks_done), so the
     drain()/close() exactly-once contract carries over unchanged.
 
+    SHARED INFRASTRUCTURE: `serving.generation.GenerationEngine`
+    (ISSUE 14) admits decode-slot joins through this same queue (and
+    `_parse_lanes`/`_parse_lane_quotas`/`_OverQuota`) — one admission
+    policy, one set of typed errors, two engines.  Changes here have
+    two consumers.
+
     Ordering (ISSUE 8): strict priority ACROSS lanes (the dispatcher
     never serves a lower lane while a higher one has work) and
     earliest-deadline-first WITHIN a lane (no-deadline requests keep

@@ -221,10 +221,10 @@ class _Breaker:
 
 class _Entry:
     __slots__ = ("name", "engine", "breaker", "footprint", "basis",
-                 "devices", "detail")
+                 "devices", "detail", "cost_labels")
 
     def __init__(self, name, engine, breaker, footprint, basis,
-                 devices, detail):
+                 devices, detail, cost_labels=None):
         self.name = name
         self.engine = engine
         self.breaker = breaker
@@ -232,6 +232,10 @@ class _Entry:
         self.basis = basis          # "measured" | "projected"
         self.devices = devices      # indices into the registry pool
         self.detail = detail
+        # cost-registry label families this entry's measured footprint
+        # is read from (one for one-shot engines; prefill/decode_step/
+        # join for generation engines)
+        self.cost_labels = cost_labels or ["serve.infer:%s" % name]
 
 
 class ModelRegistry:
@@ -274,11 +278,14 @@ class ModelRegistry:
             return 0
 
     # -- admission -----------------------------------------------------
-    def _place(self, name, footprint, replicas):
+    def _place(self, name, footprint, replicas, kv_detail=None):
         """Best-fit decreasing bin-pack: the `replicas` pool devices
         with the most free budget take the model.  Returns the chosen
         indices, or raises AdmissionDenied with the full decision.
-        Caller holds self._lock."""
+        `kv_detail` (generation admission) breaks the footprint's
+        slots×kv term out so the refusal NAMES it — KV cache is the
+        part that scales with concurrency, not the deploy.  Caller
+        holds self._lock."""
         free = [(self._budgets[i] - self._committed[i]
                  if self._budgets[i] > 0 else float("inf"), i)
                 for i in range(len(self._ctxs))]
@@ -306,13 +313,24 @@ class ModelRegistry:
             _bb.record("serve", "admission_rejected", model=name,
                        projected_bytes=int(footprint),
                        replicas=int(replicas),
+                       kv_detail=kv_detail,
                        decision=decision)
+            kv_term = ""
+            if kv_detail:
+                kv_term = (" — of which KV cache %d bytes (%d slots x "
+                           "%d bytes/slot; fewer slots or a smaller "
+                           "max_len shrink the KV term, the model "
+                           "itself is only %d bytes)"
+                           % (kv_detail.get("kv_bytes", 0),
+                              kv_detail.get("slots", 0),
+                              kv_detail.get("kv_bytes_per_slot", 0),
+                              kv_detail.get("param_bytes", 0)))
             raise AdmissionDenied(
                 "model %r projected footprint %d bytes does not fit "
-                "the remaining budget on %d device(s): %s"
+                "the remaining budget on %d device(s): %s%s"
                 % (name, footprint, replicas,
                    ", ".join("%s free=%s" % (d["device"], d["free"])
-                             for d in decision)))
+                             for d in decision), kv_term))
         return [i for _, i in chosen]
 
     def register(self, name, block, replicas=1, example_shape=None,
@@ -401,6 +419,94 @@ class ModelRegistry:
                 "basis": basis, "detail": detail,
                 "devices": [repr(self._ctxs[i]) for i in idxs]}
 
+    def register_generator(self, name, block, bos, eos, slots=None,
+                           max_len=None, prompt_buckets=None,
+                           **engine_kw):
+        """Admit `block` as GENERATION model `name` on one pool device
+        (a `serving.generation.GenerationEngine`).
+
+        Admission accounts what one-shot serving has no analogue for:
+        the KV term — ``slots × kv_bytes_per_slot`` from
+        `project_generation_footprint` (HBM scales with CONCURRENT
+        SEQUENCES, not just params).  A refusal names that term in
+        both the AdmissionDenied message and the flight-recorder
+        ledger.  ``warmup(name)`` reconciles the projection against
+        the measured ``decode_step`` cost-registry row (whose argument
+        bytes ARE params + the full slot cache)."""
+        from .generation import (GenerationEngine,
+                                 project_generation_footprint,
+                                 _parse_prompt_buckets)
+        name = str(name)
+        slots = int(slots if slots is not None
+                    else _cfg.get("MXNET_GEN_SLOTS"))
+        max_len = int(max_len if max_len is not None
+                      else _cfg.get("MXNET_GEN_MAX_LEN"))
+        bset = _parse_prompt_buckets(
+            prompt_buckets if prompt_buckets is not None
+            else _cfg.get("MXNET_GEN_BUCKETS"), max_len)
+        label = "serve.infer:%s" % name
+        footprint, detail = project_generation_footprint(
+            block, slots, max_len, bset)
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("registry is closed")
+            if name in self._models:
+                raise ValueError("model %r already registered "
+                                 "(unregister it first)" % name)
+            idxs = self._place(name, footprint, 1, kv_detail=detail)
+            for i in idxs:
+                self._committed[i] += footprint
+            self._models[name] = None       # hold the name (build
+        try:                                # outside the lock)
+            engine = GenerationEngine(
+                block, bos, eos, ctx=self._ctxs[idxs[0]], slots=slots,
+                max_len=max_len, prompt_buckets=bset,
+                cost_label=label, **engine_kw)
+        except Exception:
+            with self._lock:
+                for i in idxs:
+                    self._committed[i] = max(
+                        0, self._committed[i] - footprint)
+                self._models.pop(name, None)
+            raise
+        entry = _Entry(
+            name, engine,
+            _Breaker(name, _cfg.get("MXNET_SERVE_BREAKER_FAILS"),
+                     _cfg.get("MXNET_SERVE_BREAKER_COOLDOWN_S")),
+            footprint, "projected", idxs, detail,
+            cost_labels=[label + ":prefill", label + ":decode_step",
+                         label + ":join"])
+        with self._lock:
+            if self._closed:
+                closed = True
+            else:
+                closed = False
+                self._models[name] = entry
+        if closed:
+            engine.close()
+            raise EngineClosed("registry closed during registration "
+                               "of model %r" % name)
+        events.incr("serve.models_admitted")
+        _bb.record("serve", "admitted", model=name,
+                   footprint_bytes=int(footprint), basis="projected",
+                   kv_detail=detail,
+                   devices=[repr(self._ctxs[i]) for i in idxs])
+        return {"model": name, "footprint_bytes": int(footprint),
+                "basis": "projected", "detail": detail,
+                "devices": [repr(self._ctxs[i]) for i in idxs]}
+
+    def generate(self, name, prompt, max_new_tokens=None,
+                 deadline=None, lane=None, tenant=None):
+        """Route one generation request through model `name`'s
+        circuit breaker (the same `_route` triage as one-shot
+        submits).  Returns the `GenerationStream`; terminal
+        infrastructure failures on its future feed the breaker."""
+        entry = self._entry(name)
+        return self._route(entry, entry.engine.submit, prompt,
+                           max_new_tokens=max_new_tokens,
+                           deadline=deadline, lane=lane,
+                           tenant=tenant)
+
     def unregister(self, name, timeout=30.0):
         """Close the model's engine (drain + resolve every future) and
         release its committed budget."""
@@ -417,7 +523,8 @@ class ModelRegistry:
         # drop the model's cost rows with it: a later re-registration
         # under the same name must not read THIS incarnation's
         # footprint (register projects fresh; warmup re-measures)
-        _costs.drop_rows("serve.infer:%s" % entry.name, kind="serve")
+        for fam in entry.cost_labels:
+            _costs.drop_rows(fam, kind="serve")
         events.incr("serve.models_evicted")
         _bb.record("serve", "evicted", model=entry.name,
                    released_bytes=int(entry.footprint))
@@ -449,6 +556,10 @@ class ModelRegistry:
         return cb
 
     def _route(self, entry, submit, *args, **kw):
+        """ONE breaker triage for every submit shape: one-shot submits
+        return a Future, generation submits a GenerationStream whose
+        `.future` carries the verdict — the done-callback lands on
+        whichever exists."""
         if not entry.breaker.allow():
             events.incr("serve.breaker_rejected")
             events.incr("serve.breaker_rejected",
@@ -458,7 +569,7 @@ class ModelRegistry:
                 "recent dispatches failed terminally"
                 % (entry.name, entry.breaker.cooldown))
         try:
-            fut = submit(*args, **kw)
+            res = submit(*args, **kw)
         except _FLOW_ERRORS:
             raise                   # engine self-protection: neutral
         except (ValueError, TypeError):
@@ -469,8 +580,9 @@ class ModelRegistry:
         except Exception as e:      # noqa: BLE001 — submit-side infra
             entry.breaker.fail(e)   # failure counts against the model
             raise
+        fut = getattr(res, "future", res)
         fut.add_done_callback(self._observed(entry.breaker))
-        return fut
+        return res
 
     def submit(self, name, x, deadline=None, lane=None, tenant=None):
         """Route one example to model `name` through its circuit
@@ -509,10 +621,14 @@ class ModelRegistry:
         """Swap a model's projected footprint for the measured one
         (cost-registry memory-analysis rows) when available; adjusts
         the committed ledger by the delta and records the correction.
-        Returns the measured bytes (0 = nothing measured yet)."""
+        Generation entries read the max across their prefill/
+        decode_step/join families — decode_step's argument bytes ARE
+        params + the full slot cache, the honest concurrent working
+        set.  Returns the measured bytes (0 = nothing measured
+        yet)."""
         entry = self._entry(name)
-        measured = _costs.footprint_bytes("serve.infer:%s" % entry.name,
-                                          kind="serve")
+        measured = max(_costs.footprint_bytes(fam, kind="serve")
+                       for fam in entry.cost_labels)
         if measured <= 0 or measured == entry.footprint:
             return measured
         with self._lock:

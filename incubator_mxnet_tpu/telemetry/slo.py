@@ -64,7 +64,9 @@ from . import flightrec as _bb
 __all__ = ["Rule", "ThresholdRule", "BurnRateRule", "AnomalyRule",
            "register_rule", "unregister_rule", "clear_rules", "rules",
            "active_alerts", "evaluate", "block", "register_action",
-           "default_serving_rules", "install_default_serving_rules"]
+           "default_serving_rules", "install_default_serving_rules",
+           "default_generation_rules",
+           "install_default_generation_rules"]
 
 
 # -- metric readers ----------------------------------------------------
@@ -567,6 +569,73 @@ def default_serving_rules(targets=None, shed_budget=None, fast_s=None,
                 description="lane %r e2e p99 within its observed "
                             "%.3fs deadline" % (lane, float(t))))
     return out
+
+
+def default_generation_rules(targets=None, shed_budget=None,
+                             fast_s=None, slow_s=None, lanes=None,
+                             quotas=None) -> list:
+    """The generation-serving SLO set (ISSUE 14): same lane-ladder
+    discipline as `default_serving_rules`, pointed at the
+    `GenerationEngine`'s own series —
+
+    - per lane, a shed-rate burn rule over ``gen.shed`` /
+      (``gen.requests`` + ``gen.shed``) with the lane-quota error
+      budget;
+    - per lane with an observed deadline target (``targets``: {lane:
+      seconds}, from `GenerationEngine.slo_targets()`), a
+      **TTFT p99** threshold rule on the labeled ``gen.ttft_us`` ring
+      — time-to-first-token is the generation tail users feel; a
+      request that will finish in time but starts late is already a
+      violation.
+    """
+    if shed_budget is None:
+        shed_budget = float(_cfg.get("MXNET_SLO_SHED_BUDGET"))
+    if lanes is None and quotas is not None:
+        lanes = list(quotas)
+    if lanes is None or quotas is None:
+        env_lanes, env_quotas = _lanes_and_quotas()
+        lanes = list(lanes) if lanes is not None else env_lanes
+        quotas = dict(quotas) if quotas is not None else env_quotas
+    out = []
+    for lane in lanes:
+        budget = max(shed_budget, 1.0 - quotas.get(lane, 1.0))
+        out.append(BurnRateRule(
+            "gen-shed-%s" % lane,
+            bad="gen.shed", total=["gen.requests", "gen.shed"],
+            budget=budget, fast_s=fast_s, slow_s=slow_s,
+            labels={"lane": lane},
+            description="lane %r generation shed fraction burns its "
+                        "%.0f%% error budget over both windows"
+                        % (lane, budget * 100)))
+        t = (targets or {}).get(lane)
+        if t:
+            out.append(ThresholdRule(
+                "gen-ttft-p99-%s" % lane,
+                metric="gen.ttft_us", pct="p99",
+                labels={"lane": lane}, bound=float(t) * 1e6,
+                description="lane %r time-to-first-token p99 within "
+                            "its observed %.3fs deadline"
+                            % (lane, float(t))))
+    return out
+
+
+def install_default_generation_rules(engine=None, registry=None,
+                                     **kw) -> list:
+    """Build + register the default generation rules; ``engine`` (a
+    GenerationEngine) or ``registry`` supplies the observed per-lane
+    deadline targets and enforced quotas.  Returns rule names."""
+    targets = kw.pop("targets", None)
+    src = engine if engine is not None else registry
+    if src is not None:
+        if targets is None:
+            targets = src.slo_targets()
+        if "quotas" not in kw:
+            q = src.slo_lane_quotas()
+            if q:
+                kw["quotas"] = q
+    installed = [register_rule(r) for r in
+                 default_generation_rules(targets=targets, **kw)]
+    return [r.name for r in installed]
 
 
 def install_default_serving_rules(registry=None, engine=None,

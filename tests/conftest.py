@@ -113,6 +113,12 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slo: durable-telemetry history + SLO alerting "
         "tests (CPU-fast, run in tier-1 by default)")
+    # generation serving (ISSUE 14): KV-cached decode, continuous
+    # batching, greedy-parity oracle, KV-aware admission
+    config.addinivalue_line(
+        "markers", "gen: generation-serving (KV-cached decode / "
+        "continuous batching) tests (CPU-fast, run in tier-1 by "
+        "default)")
 
 
 @pytest.fixture(autouse=True)

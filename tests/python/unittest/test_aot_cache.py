@@ -181,3 +181,88 @@ def test_cache_unbounded_by_default(cache_dir):
         j(jnp.ones((n,)))
     assert len(_blobs(cache_dir)) == 3          # MXNET_AOT_CACHE_MAX=0
     assert trim_cache() == 0
+
+
+@pytest.fixture
+def load_breaker_state():
+    """Save/restore the process-wide disk-load breaker (ISSUE 14
+    satellite) so breaker tests can trip it without poisoning the
+    rest of the corpus."""
+    from incubator_mxnet_tpu import aot_cache as ac
+    saved = (ac._LOAD_FAILS[0], ac._LOADS_DISABLED[0],
+             ac._SELF_VERIFIED[0])
+    yield ac
+    (ac._LOAD_FAILS[0], ac._LOADS_DISABLED[0],
+     ac._SELF_VERIFIED[0]) = saved
+
+
+def test_load_breaker_trips_on_repeated_deserialize_errors(
+        cache_dir, load_breaker_state):
+    """A backend whose deserialize fails DETERMINISTICALLY (the
+    BENCH_serve deserialize_error:6 smoking gun) trips the load
+    breaker after 2 consecutive failures: remaining executables skip
+    the doomed load (aot.load_skipped) behind ONE classified
+    aot.load_disabled verdict, instead of a per-executable stale
+    storm."""
+    import warnings
+    from incubator_mxnet_tpu.monitor import events
+    ac = load_breaker_state
+    ac._LOAD_FAILS[0], ac._LOADS_DISABLED[0] = 0, None
+
+    x = jnp.ones((4,))
+    fns = [ac.aot_jit(lambda a, k=k: a * float(k), label="brk%d" % k)
+           for k in range(3)]
+    for f in fns:
+        f(x)                                    # populate blobs
+    stale0 = events.get("aot.stale")
+    skip0 = events.get("aot.load_skipped")
+    # the staticmethod OBJECT, not the unwrapped function — restoring
+    # a bare function would rebind it as an instance method
+    orig = ac._AotJitted.__dict__["_deserialize"]
+    ac._AotJitted._deserialize = staticmethod(
+        lambda blob, it, ot, dev: (_ for _ in ()).throw(
+            RuntimeError("UNIMPLEMENTED: deserialize_executable")))
+    try:
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            for k in range(3):      # fresh wrappers = a fresh process
+                f = ac.aot_jit(lambda a, k=k: a * float(k),
+                               label="brk%d" % k)
+                np.testing.assert_allclose(np.asarray(f(x)),
+                                           np.asarray(x) * k)
+    finally:
+        ac._AotJitted._deserialize = orig
+    assert events.get("aot.stale") - stale0 == 2        # breaker at 2
+    assert events.get("aot.load_skipped") - skip0 == 1  # 3rd skipped
+    assert ac._LOADS_DISABLED[0] is not None
+    assert any("disk-load path disabled" in str(m.message) for m in w)
+
+
+def test_post_store_self_verify_disables_broken_backend(
+        tmp_path, load_breaker_state):
+    """The self-verify half: a backend that cannot load its OWN
+    serialization is caught in the run that WRITES the cache — loads
+    disabled with reason self_verify, no warm-run stale storm."""
+    from incubator_mxnet_tpu import config as _cfg
+    from incubator_mxnet_tpu.monitor import events
+    ac = load_breaker_state
+    ac._LOAD_FAILS[0], ac._LOADS_DISABLED[0] = 0, None
+    ac._SELF_VERIFIED[0] = False
+    prev = _cfg.get("MXNET_AOT_CACHE_DIR")
+    _cfg.set("MXNET_AOT_CACHE_DIR", str(tmp_path))
+    orig = ac._AotJitted.__dict__["_deserialize"]
+    ac._AotJitted._deserialize = staticmethod(
+        lambda blob, it, ot, dev: (_ for _ in ()).throw(
+            RuntimeError("UNIMPLEMENTED: deserialize_executable")))
+    try:
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            f = ac.aot_jit(lambda a: a + 1.0, label="sv")
+            np.testing.assert_allclose(np.asarray(f(jnp.ones((2,)))),
+                                       np.full((2,), 2.0))
+        assert ac._LOADS_DISABLED[0] == "self_verify"
+        assert events.get("aot.selfcheck_failed") >= 1
+    finally:
+        ac._AotJitted._deserialize = orig
+        _cfg.set("MXNET_AOT_CACHE_DIR", prev or "")

@@ -1,0 +1,474 @@
+"""Generation serving tests (serving.generation — ISSUE 14 tentpole):
+the greedy-parity oracle against contrib.text.decode on both model
+families, variable-length RNN exactness, slot join/retire correctness
+under churn, the KV donation no-copy proof, zero-recompile across
+varying prompt lengths, mid-decode deadline shedding, KV-aware
+registry admission naming the KV term, drain/close exactly-once
+stream resolution, and the default TTFT SLO rules.  CPU-only, fast."""
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu import config as cfg
+from incubator_mxnet_tpu.monitor import events
+from incubator_mxnet_tpu.models import Seq2Seq
+from incubator_mxnet_tpu.models.transformer import transformer_nmt_small
+from incubator_mxnet_tpu.serving import (AdmissionDenied,
+                                         DeadlineExceeded, EngineClosed,
+                                         GenerationEngine,
+                                         ModelRegistry, Shed)
+from incubator_mxnet_tpu.contrib.text.decode import greedy_translate
+
+pytestmark = pytest.mark.gen
+
+V, BOS, EOS = 23, 1, 2
+
+
+def _seq2seq(seed=0):
+    mx.random.seed(seed)
+    net = Seq2Seq(V, V, embed_dim=16, hidden=24, num_layers=2)
+    net.initialize(force_reinit=True)
+    net(nd.array(onp.ones((1, 4), onp.int32)),
+        nd.array(onp.ones((1, 1), onp.int32)))      # concrete shapes
+    return net
+
+
+def _transformer(seed=0):
+    mx.random.seed(seed)
+    net = transformer_nmt_small(V, V, dropout=0.0)
+    net.initialize(force_reinit=True)
+    return net
+
+
+def _engine(net, slots=3, max_len=16, buckets=(4, 8), **kw):
+    return GenerationEngine(net, bos=BOS, eos=EOS, slots=slots,
+                            max_len=max_len, prompt_buckets=buckets,
+                            **kw)
+
+
+def _ref_tokens(net, prompt, max_new):
+    """greedy_translate oracle, trimmed at (and including) EOS."""
+    out = greedy_translate(net, nd.array(prompt[None], dtype="int32"),
+                           BOS, EOS, max_len=max_new)[0]
+    toks = list(out)
+    if EOS in toks:
+        toks = toks[:toks.index(EOS) + 1]
+    return [int(t) for t in toks]
+
+
+# -- variable-length RNN substrate -------------------------------------
+
+def test_rnn_varlen_matches_truncated_run():
+    """The prefill exactness contract: RNN_varlen over a right-padded
+    batch must equal running each row at its exact length — outputs,
+    final h AND c, both directions."""
+    from incubator_mxnet_tpu.gluon import rnn as grnn
+    onp.random.seed(3)
+    x = nd.array(onp.random.randn(6, 2, 4).astype(onp.float32))
+    vl = nd.array(onp.array([4, 6], onp.int32))
+    for bi in (False, True):
+        lstm = grnn.LSTM(8, num_layers=1 if bi else 2,
+                         bidirectional=bi, layout="TNC")
+        lstm.initialize()
+        s0 = lstm.begin_state(batch_size=2)
+        y_full, _ = lstm(x, s0)
+        y, h, c = nd.RNN_varlen(
+            x, lstm.parameters.data(), s0[0], s0[1], vl, state_size=8,
+            num_layers=1 if bi else 2, bidirectional=bi, mode="lstm")
+        y4, (h4, c4) = lstm(x[:4, 0:1], lstm.begin_state(batch_size=1))
+        assert onp.allclose(y[:4, 0].asnumpy(), y4[:, 0].asnumpy(),
+                            atol=1e-6)
+        assert onp.allclose(h[:, 0].asnumpy(), h4[:, 0].asnumpy(),
+                            atol=1e-6)
+        assert onp.allclose(c[:, 0].asnumpy(), c4[:, 0].asnumpy(),
+                            atol=1e-6)
+        # full-length row is untouched; padded tail outputs are zeroed
+        assert onp.allclose(y[:, 1].asnumpy(), y_full[:, 1].asnumpy(),
+                            atol=1e-6)
+        assert float(abs(y[4:, 0].asnumpy()).max()) == 0.0
+
+
+# -- greedy-parity oracle ----------------------------------------------
+
+@pytest.mark.parametrize("family", ["seq2seq", "transformer"])
+def test_greedy_parity_oracle(family):
+    """GenerationEngine greedy output is token-identical to the
+    host-looped contrib.text.decode.greedy_translate — for prompts AT
+    a bucket size and prompts padded up to one (the KV-cached path
+    may differ by masked-padding noise only; tokens must match)."""
+    net = _seq2seq() if family == "seq2seq" else _transformer()
+    eng = _engine(net)
+    try:
+        eng.warmup()
+        rs = onp.random.RandomState(7)
+        for n in (3, 8):                # off-bucket and on-bucket
+            prompt = rs.randint(3, V, (n,))
+            ref = _ref_tokens(net, prompt, 10)
+            got = [int(t) for t in
+                   eng.submit(prompt, max_new_tokens=10)
+                      .result(timeout=60)]
+            assert got == ref[:len(got)], (n, got, ref)
+            # a short result is legal only because EOS ended it
+            if len(got) < 10:
+                assert got[-1] == EOS
+    finally:
+        eng.close()
+
+
+def test_slot_churn_isolation():
+    """Join/retire masked updates under churn: more requests than
+    slots, staggered lengths — every sequence must decode exactly as
+    it would alone (slot reuse may not leak state across requests)."""
+    net = _seq2seq(seed=1)
+    eng = _engine(net, slots=2)
+    try:
+        eng.warmup()
+        rs = onp.random.RandomState(11)
+        # lengths repeat across requests on purpose: the greedy oracle
+        # reuses its per-(src,prefix)-length executables, so 6 refs
+        # cost ~2 requests' worth of compiles
+        prompts = [rs.randint(3, V, (int(n),))
+                   for n in (3, 8, 3, 8, 3, 8)]
+        budgets = [4, 9, 6, 11, 3, 7]
+        streams = [eng.submit(p, max_new_tokens=m)
+                   for p, m in zip(prompts, budgets)]
+        for p, m, s in zip(prompts, budgets, streams):
+            got = [int(t) for t in s.result(timeout=60)]
+            ref = _ref_tokens(net, p, m)
+            assert got == ref[:len(got)], (list(p), got, ref)
+        assert events.get("gen.retires") >= len(prompts)
+    finally:
+        eng.close()
+
+
+def test_continuous_join_mid_generation():
+    """A request submitted while generation is RUNNING joins at a
+    step boundary without evicting the running sequence — both finish
+    correctly, and the join happened while the first was live (the
+    continuous-batching contract)."""
+    net = _seq2seq(seed=2)
+    eng = _engine(net, slots=2, max_len=16)
+    try:
+        eng.warmup()
+        rs = onp.random.RandomState(5)
+        p1, p2 = rs.randint(3, V, (5,)), rs.randint(3, V, (4,))
+        s1 = eng.submit(p1, max_new_tokens=14)
+        # wait until the first sequence has visibly started emitting
+        first = next(iter(s1))
+        s2 = eng.submit(p2, max_new_tokens=4)
+        got2 = [int(t) for t in s2.result(timeout=60)]
+        got1 = [first] + [int(t) for t in s1]
+        assert got1 == _ref_tokens(net, p1, 14)[:len(got1)]
+        assert got2 == _ref_tokens(net, p2, 4)[:len(got2)]
+        # the overlap really happened: s2 joined before s1 retired
+        st = eng.stats()
+        assert st["counters"].get("gen.joins", 0) >= 2
+    finally:
+        eng.close()
+
+
+# -- zero-recompile + donation -----------------------------------------
+
+def test_zero_recompile_across_prompt_lengths():
+    """After warmup, no mix of prompt lengths / batch membership may
+    trace a new executable (serve.traces stays flat)."""
+    net = _seq2seq(seed=3)
+    eng = _engine(net, slots=2, buckets=(4, 8))
+    try:
+        w = eng.warmup()
+        assert w["traces"] >= 4         # 2 prefill + join + decode
+        t0 = events.get("serve.traces")
+        rs = onp.random.RandomState(13)
+        streams = [eng.submit(rs.randint(3, V, (int(n),)),
+                              max_new_tokens=5)
+                   for n in (1, 2, 3, 4, 5, 6, 7, 8, 3, 5)]
+        for s in streams:
+            s.result(timeout=60)
+        assert events.get("serve.traces") - t0 == 0
+    finally:
+        eng.close()
+
+
+def test_kv_donation_no_copy():
+    """The no-copy proof: after a decode step, the PREVIOUS cache
+    buffers are deleted (donated into the executable), not silently
+    copied — and the runtime audit counter stayed at zero."""
+    import jax
+    net = _seq2seq(seed=4)
+    eng = _engine(net, slots=2)
+    try:
+        eng.warmup()
+        before = events.get("gen.donation_copy") or 0
+        old_leaf = jax.tree_util.tree_leaves(eng._cache["m"])[0]
+        s = eng.submit(onp.random.RandomState(0).randint(3, V, (4,)),
+                       max_new_tokens=3)
+        s.result(timeout=60)
+        assert old_leaf.is_deleted(), \
+            "decode step copied the KV cache instead of donating it"
+        assert (events.get("gen.donation_copy") or 0) == before
+    finally:
+        eng.close()
+
+
+def test_prefill_bucket_warmup_counts():
+    """warmup() compiles exactly the closed executable set: one
+    prefill per prompt bucket + join + decode."""
+    net = _seq2seq(seed=5)
+    t0 = events.get("serve.traces")
+    eng = _engine(net, slots=2, buckets=(4, 8))
+    try:
+        eng.warmup()
+        assert events.get("serve.traces") - t0 == 4
+    finally:
+        eng.close()
+
+
+# -- deadlines / shedding ----------------------------------------------
+
+def test_mid_decode_deadline_frees_slot():
+    """A deadline expiring MID-generation resolves the stream with
+    DeadlineExceeded and frees the slot — the engine keeps serving
+    (the next request completes on the freed slot)."""
+    from incubator_mxnet_tpu import fault
+    net = _seq2seq(seed=6)
+    eng = _engine(net, slots=1, max_len=16)
+    try:
+        eng.warmup()
+        rs = onp.random.RandomState(17)
+        shed0 = events.get("gen.shed") or 0
+        # stall every decode step 20ms (serve.decode_slow site): 14
+        # tokens need >=280ms, the 80ms deadline expires mid-decode
+        # deterministically — but AFTER the first token lands
+        fault.install("serve.decode_slow", steps=list(range(5000)),
+                      seconds=0.02)
+        s = eng.submit(rs.randint(3, V, (8,)), max_new_tokens=14,
+                       deadline=0.080)
+        with pytest.raises(DeadlineExceeded):
+            s.result(timeout=60)
+        fault.clear()
+        assert len(s.tokens()) >= 1     # it WAS mid-decode
+        assert (events.get("gen.shed") or 0) > shed0
+        # the slot is free again: a fresh request completes
+        s2 = eng.submit(rs.randint(3, V, (4,)), max_new_tokens=3)
+        assert len(s2.result(timeout=60)) >= 1
+        assert eng.stats()["slots_live"] == 0
+    finally:
+        eng.close()
+
+
+def test_born_expired_and_infeasible_shed():
+    net = _seq2seq(seed=7)
+    eng = _engine(net, slots=1)
+    try:
+        eng.warmup()
+        with pytest.raises(DeadlineExceeded):
+            eng.submit(onp.array([3, 4, 5]), deadline=-1.0)
+        # lane-quota shed: with the decode loop parked (stop flag),
+        # the low lane's cap (0.25 x 8 = 2) sheds the 3rd submit
+        # deterministically — no race against admission
+        small = GenerationEngine(
+            net, bos=BOS, eos=EOS, slots=1, max_len=16,
+            prompt_buckets=(4,), queue_cap=8,
+            lanes=("hi", "lo"), lane_quotas=(1.0, 0.25))
+        try:
+            small._stop = True
+            with pytest.raises(Shed):
+                for _ in range(4):
+                    small.submit(onp.array([3, 4]), lane="lo",
+                                 max_new_tokens=2)
+        finally:
+            small.close()
+    finally:
+        eng.close()
+
+
+# -- lifecycle ----------------------------------------------------------
+
+def test_drain_close_resolve_every_stream_exactly_once():
+    """Queued + running + mid-flight streams are ALL resolved exactly
+    once across drain()/close(); no future is left pending and no
+    queue accounting leaks."""
+    net = _seq2seq(seed=8)
+    eng = _engine(net, slots=2, max_len=16)
+    try:
+        eng.warmup()
+        rs = onp.random.RandomState(23)
+        streams = [eng.submit(rs.randint(3, V, (4,)),
+                              max_new_tokens=12)
+                   for _ in range(8)]
+        # close with work still queued/running: every stream resolves
+        eng.close(timeout=60)
+        done = 0
+        for s in streams:
+            assert s.future.done()
+            try:
+                s.result(timeout=0)
+                done += 1
+            except (EngineClosed, DeadlineExceeded):
+                pass
+        assert done >= 1                # the ones that finished
+        assert eng._q.unfinished_tasks == 0
+        assert eng.stats()["slots_live"] == 0
+        with pytest.raises(EngineClosed):
+            eng.submit(onp.array([3, 4]))
+    finally:
+        eng.close()
+
+
+def test_stream_iterates_incrementally():
+    net = _seq2seq(seed=9)
+    eng = _engine(net, slots=1)
+    try:
+        eng.warmup()
+        s = eng.submit(onp.random.RandomState(1).randint(3, V, (5,)),
+                       max_new_tokens=6)
+        got = [int(t) for t in s]
+        assert got == [int(t) for t in s.result(timeout=1)]
+        assert len(got) >= 1
+        assert (events.get("gen.ttft_us.n") or 0) >= 1
+    finally:
+        eng.close()
+
+
+def test_drain_mode_admits_only_at_batch_boundary():
+    """continuous=False (the A/B baseline): while ANY slot is live no
+    new request joins; after the batch drains the queued one runs."""
+    net = _seq2seq(seed=10)
+    eng = _engine(net, slots=2, continuous=False)
+    try:
+        eng.warmup()
+        rs = onp.random.RandomState(29)
+        s1 = eng.submit(rs.randint(3, V, (5,)), max_new_tokens=12)
+        first = next(iter(s1))          # batch 1 is running
+        assert isinstance(first, int)
+        joins_before = events.get("gen.joins")
+        s2 = eng.submit(rs.randint(3, V, (4,)), max_new_tokens=2)
+        # while s1 is live, s2 must NOT have joined
+        time.sleep(0.05)
+        if not s1.done():
+            assert events.get("gen.joins") == joins_before
+        s1.result(timeout=60)
+        assert len(s2.result(timeout=60)) >= 1
+    finally:
+        eng.close()
+
+
+# -- registry / admission ----------------------------------------------
+
+def test_registry_kv_admission_names_kv_term():
+    """Generation admission accounts slots × kv_bytes; the refusal
+    names the KV term (message + flight-recorder event)."""
+    from incubator_mxnet_tpu.telemetry import flightrec as bb
+    net = _seq2seq(seed=11)
+    reg = ModelRegistry(devices=[mx.cpu()], hbm_budget=150 * 1024)
+    try:
+        with pytest.raises(AdmissionDenied) as ei:
+            reg.register_generator("g_big", net, BOS, EOS,
+                                   slots=4096, max_len=32,
+                                   prompt_buckets=(8,))
+        msg = str(ei.value)
+        assert "KV cache" in msg and "slots x" in msg
+        rec = reg.register_generator("g", net, BOS, EOS, slots=2,
+                                     max_len=16, prompt_buckets=(4, 8))
+        assert rec["detail"]["kv_bytes"] > 0
+        assert rec["detail"]["kv_bytes"] == \
+            2 * rec["detail"]["kv_bytes_per_slot"]
+        reg.warmup("g")
+        s = reg.generate("g", onp.array([3, 4, 5]), max_new_tokens=4)
+        assert len(s.result(timeout=60)) >= 1
+        ledger = reg.stats()["ledger"][0]
+        assert ledger["committed"] >= rec["footprint_bytes"]
+        reg.unregister("g")
+        assert reg.stats()["ledger"][0]["committed"] == 0
+    finally:
+        reg.close()
+
+
+def test_engine_projection_matches_live_cache():
+    """project_generation_footprint's per-slot KV bytes equal the
+    live engine's model-cache share (the projection admission trusts
+    is the thing actually allocated)."""
+    from incubator_mxnet_tpu.serving import project_generation_footprint
+    net = _seq2seq(seed=12)
+    total, detail = project_generation_footprint(
+        net, slots=2, max_len=16, buckets=(4, 8))
+    eng = _engine(net, slots=2, max_len=16, buckets=(4, 8))
+    try:
+        kv = eng.kv_cache_bytes()
+        # engine cache adds the tok/pos/out bookkeeping leaves on top
+        # of the model KV rows the projection counts
+        assert kv["per_slot"] >= detail["kv_bytes_per_slot"]
+        assert kv["per_slot"] - detail["kv_bytes_per_slot"] <= \
+            4 * (2 + 16)                # tok+pos+out int32 rows
+    finally:
+        eng.close()
+
+
+# -- SLO ----------------------------------------------------------------
+
+def test_default_generation_slo_rules():
+    from incubator_mxnet_tpu.telemetry import slo
+    net = _seq2seq(seed=13)
+    eng = _engine(net, slots=1, lanes=("high", "low"),
+                  lane_quotas=(1.0, 0.5))
+    try:
+        eng.warmup()
+        s = eng.submit(onp.array([3, 4, 5]), max_new_tokens=2,
+                       deadline=5.0, lane="high")
+        s.result(timeout=60)
+        names = eng.install_slo_rules()
+        try:
+            assert "gen-shed-high" in names
+            assert "gen-ttft-p99-high" in names   # observed deadline
+            assert "gen-ttft-p99-low" not in names  # never deadlined
+            rules = slo.rules()
+            r = rules["gen-ttft-p99-high"]
+            assert r.bound == pytest.approx(5.0 * 1e6)
+        finally:
+            for n in names:
+                slo.unregister_rule(n)
+    finally:
+        eng.close()
+
+
+# -- telemetry / occupancy ---------------------------------------------
+
+def test_slot_occupancy_gauge_and_spans():
+    from incubator_mxnet_tpu.telemetry import flightrec as bb
+    net = _seq2seq(seed=14)
+    eng = _engine(net, slots=2)
+    try:
+        eng.warmup()
+        s = eng.submit(onp.array([3, 4, 5, 6]), max_new_tokens=3)
+        s.result(timeout=60)
+        time.sleep(0.02)
+        # the occupancy gauge sampled live slots; join/retire landed
+        # in the flight-recorder ring
+        assert (events.get("gen.slots_live.n") or 0) >= 1
+        kinds = [(e.get("kind"), e.get("name"))
+                 for e in bb.ring_snapshot()]
+        assert ("gen", "join") in kinds
+        assert ("gen", "retire") in kinds
+    finally:
+        eng.close()
+
+
+@pytest.mark.slow
+def test_check_decode_gate_runs():
+    """The CI gate executes end to end (SKIP rc 0 on this host is a
+    legal verdict; nonzero = the contract broke)."""
+    import subprocess
+    import sys as _sys
+    import os as _os
+    root = _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.dirname(_os.path.abspath(__file__)))))
+    res = subprocess.run(
+        [_sys.executable,
+         _os.path.join(root, "tools", "check_decode.py"),
+         "--trials", "1", "--duration", "1.5"],
+        capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr

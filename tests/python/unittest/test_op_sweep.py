@@ -771,6 +771,7 @@ TESTED_ELSEWHERE = {
     "GroupNorm": "test_gluon.py",
     "Dropout": "test_operator.py",
     "RNN": "test_operator.py",
+    "RNN_varlen": "test_generation.py",
     "CTCLoss": "test_operator.py",
     "foreach": "test_operator.py",
     "while_loop": "test_operator.py",

@@ -1,0 +1,165 @@
+"""check_decode — CI gate for generation serving (ISSUE 14).
+
+The KV-cached GenerationEngine exists so that (a) steady-state decode
+is ZERO-recompile — after warmup(), no mix of prompt lengths or batch
+membership ever traces a new executable — and (b) continuous batching
+beats drain batching on time-to-first-token under overload (a drain
+batch holds freed slots hostage to its longest sequence; a continuous
+batch backfills them at the step boundary).  This script proves both:
+
+    JAX_PLATFORMS=cpu python tools/check_decode.py
+    python tools/check_decode.py --duration 3 --trials 3
+
+Methodology (the check_serve discipline): best-of-`--trials` (default
+3); one trial = fresh engines, a fresh capacity measurement, one
+2x-overload Poisson window driven at the continuous engine and then
+the SAME schedule at a drain engine (identical arrivals, identical
+heterogeneous generation lengths).  The gate passes when ANY trial
+passes (early exit); a real regression fails all three.  A trial
+whose achieved offer fell short of 1.3x capacity is inconclusive (the
+engines were never overloaded); all-inconclusive SKIPs (rc 0), as do
+single-core hosts.  The zero-recompile check is NOT timing-dependent:
+any steady-state trace in any trial fails the gate outright.
+Artifacts land in MXNET_GATE_REPORT_DIR (tools/gate_report.py).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for _p in (_ROOT, os.path.join(_ROOT, "tools")):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+
+def _trial(t, duration, capacity_s, hi_frac, seed):
+    import numpy as np
+    from bench import (build_generation_model, measure_generate_capacity,
+                       _generate_overload)
+    from incubator_mxnet_tpu.monitor import events
+    from incubator_mxnet_tpu.serving import GenerationEngine
+
+    net = build_generation_model(seed=seed + t)
+    rs = np.random.RandomState(seed + t)
+    prompts = [rs.randint(3, 31, (int(n),))
+               for n in rs.choice((3, 4, 5, 6, 7, 8), 64)]
+    max_new, slots = 12, 4
+    detail = {"trial": t}
+    ttft = {}
+    capacity = None
+    recompiled = False
+    for mode in ("cb", "drain"):
+        # lane names unique per (trial, mode): the labeled TTFT rings
+        # are process-global and cumulative — reuse would leak trial
+        # t-1's samples into trial t's p99
+        lanes = ("cap%d%s" % (t, mode), "hi%d%s" % (t, mode),
+                 "lo%d%s" % (t, mode))
+        eng = GenerationEngine(
+            net, bos=1, eos=2, slots=slots, max_len=24,
+            prompt_buckets=(4, 8), queue_cap=64, lanes=lanes,
+            lane_quotas=(1.0, 1.0, 0.5), continuous=(mode == "cb"))
+        eng.warmup()
+        traces0 = events.get("serve.traces")
+        if capacity is None:
+            capacity = measure_generate_capacity(
+                eng, prompts, capacity_s, max_new)
+            svc = 1.0 / max(capacity / slots, 1e-6)
+            hi_dl = max(0.5, 3.5 * svc)
+            detail["capacity_rps"] = round(capacity, 1)
+        rs_phase = np.random.RandomState(seed + t + 99)
+        offered, served, shed, wall = _generate_overload(
+            eng, prompts, 2.0 * capacity, duration, hi_frac,
+            lanes[1], lanes[2], hi_dl, 2.0 * hi_dl, max_new, rs_phase)
+        traces_delta = events.get("serve.traces") - traces0
+        eng.close()
+        if traces_delta:
+            recompiled = True
+        pct = {r["labels"]["lane"]: r
+               for r in events.labeled_percentiles("gen.ttft_us",
+                                                   (99,))}
+        ttft[mode] = pct.get(lanes[1], {}).get("p99", 0.0) / 1e3
+        detail["%s_achieved_rps" % mode] = round(
+            offered / max(wall, 1e-9), 1)
+        detail["%s_ttft_p99_ms" % mode] = round(ttft[mode], 2)
+        detail["%s_traces_delta" % mode] = traces_delta
+    overloaded = (detail["cb_achieved_rps"] >= 1.3 * capacity
+                  and detail["drain_achieved_rps"] >= 1.3 * capacity)
+    win = ttft["cb"] < ttft["drain"]
+    detail["overloaded"] = overloaded
+    detail["cb_win"] = bool(win)
+    print("  trial %d: capacity=%.0f rps, cb TTFT p99 %.1fms vs "
+          "drain %.1fms, traces cb=%d drain=%d%s"
+          % (t, capacity, ttft["cb"], ttft["drain"],
+             detail["cb_traces_delta"], detail["drain_traces_delta"],
+             "" if overloaded else "  [not overloaded]"))
+    return overloaded, win, recompiled, detail
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--duration", type=float, default=3.0)
+    ap.add_argument("--capacity-s", type=float, default=1.0)
+    ap.add_argument("--hi-frac", type=float, default=0.2)
+    ap.add_argument("--seed", type=int, default=11)
+    args = ap.parse_args(argv)
+    from gate_report import write_report
+    params = {"trials": args.trials, "duration_s": args.duration,
+              "capacity_s": args.capacity_s, "hi_frac": args.hi_frac}
+
+    if (os.cpu_count() or 1) < 2:
+        print("SKIP: single-core host (submitter, decode loop and "
+              "executable share one core — no TTFT bound is "
+              "meaningful)")
+        write_report("check_decode", "skip", [], rc=0, params=params,
+                     extra={"skip_reason": "single-core host"})
+        return 0
+
+    results = []
+    for t in range(max(1, args.trials)):
+        results.append(_trial(t, args.duration, args.capacity_s,
+                              args.hi_frac, args.seed))
+        overloaded, win, recompiled, _ = results[-1]
+        if recompiled:
+            break                       # hard fail — not timing noise
+        if overloaded and win:
+            break                       # best-of-N early exit
+    trial_rows = [dict(d, verdict="fail" if r
+                       else ("inconclusive" if not o
+                             else ("pass" if w else "fail")))
+                  for (o, w, r, d) in results]
+
+    if any(r for _, _, r, _ in results):
+        write_report("check_decode", "fail", trial_rows, rc=1,
+                     params=params,
+                     extra={"fail_reason": "steady-state recompile"})
+        print("FAIL: a steady-state decode traced a NEW executable "
+              "(the zero-recompile contract is broken — this is not "
+              "timing noise)", file=sys.stderr)
+        return 1
+    measurable = [w for o, w, _, _ in results if o]
+    if not measurable:
+        print("SKIP: no trial achieved 2x overload (starved "
+              "submitter) — shared/throttled VM")
+        write_report("check_decode", "skip", trial_rows, rc=0,
+                     params=params,
+                     extra={"skip_reason": "overload not achieved"})
+        return 0
+    failed = not any(measurable)
+    write_report("check_decode", "fail" if failed else "pass",
+                 trial_rows, rc=1 if failed else 0, params=params)
+    if failed:
+        print("FAIL: drain batching matched or beat continuous "
+              "batching on TTFT p99 in all %d measurable trial(s)"
+              % len(measurable), file=sys.stderr)
+        return 1
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())
