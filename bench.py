@@ -2061,6 +2061,363 @@ def run_quality(epochs=8, batch=256, train_n=5120, eval_n=1024,
             "quality_epochs": epochs}
 
 
+#: documented accuracy bound for the int8 serving path (absolute top-1
+#: delta vs the f32 model on the quality-config dataset).  check_quant
+#: imports it so the CI gate and the bench judge the same contract.
+QUANT_ACC_DELTA_BOUND = 0.02
+
+
+def backend_dtype_gemm_ratio(dtype="int8", n=1024, m=64, iters=8):
+    """f32-wall / `dtype`-wall of a jitted GEMM on THIS backend —
+    ≥ 1.0 means the backend has a native (profitable) low-precision
+    matmul path (MXU int8/bf16), < 1.0 means it emulates (XLA-CPU
+    upcasts int8 element-wise, ~10-50x slower).  The quant bench and
+    tools/check_quant.py both use this probe to decide whether the
+    int8/bf16 THROUGHPUT contracts are judgeable on this host — the
+    accuracy/packing/zero-recompile contracts are judged regardless."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    rs = np.random.RandomState(0)
+    af = jnp.asarray(rs.randn(m, n).astype(np.float32))
+    bf = jnp.asarray(rs.randn(n, n).astype(np.float32))
+    if dtype == "int8":
+        a = jnp.asarray(rs.randint(-127, 127, (m, n), dtype=np.int8))
+        b = jnp.asarray(rs.randint(-127, 127, (n, n), dtype=np.int8))
+        f_lp = jax.jit(lambda x, w: lax.dot_general(
+            x, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32))
+    else:
+        a = af.astype(jnp.bfloat16)
+        b = bf.astype(jnp.bfloat16)
+        f_lp = jax.jit(lambda x, w: x @ w)
+    f_f32 = jax.jit(lambda x, w: x @ w)
+
+    def wall(f, x, w):
+        import jax as _j
+        _j.block_until_ready(f(x, w))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f(x, w)
+        _j.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    return wall(f_f32, af, bf) / max(wall(f_lp, a, b), 1e-9)
+
+
+def _quant_mlp(seed=1234, in_units=3072, hidden=256, classes=10):
+    """The quant config's model: a Dense/GEMM classifier over the
+    flattened quality-config images.  Dense (not conv) deliberately:
+    the int8 serving path is the MXU int8-GEMM story, and on backends
+    that EMULATE int8 (this CPU) an int8 conv net would burn the whole
+    bench budget proving only that emulation is slow — the probe
+    records that separately."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+    mx.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Flatten(),
+            gluon.nn.Dense(hidden, activation="relu",
+                           in_units=in_units),
+            gluon.nn.Dense(classes, in_units=hidden))
+    net.initialize(force_reinit=True)
+    return net
+
+
+def _measure_engine_serve(net, imgs, n, seed, ctx, max_batch=16,
+                          capacity_s=1.5):
+    """Warm an engine on `net` and report (a) closed-loop saturation
+    throughput via the SHARED measure_serve_capacity (bounded
+    outstanding work — a burst-submitted stream would instead measure
+    the dispatcher's max_wait coalesce window on fast executables),
+    (b) client-observed latency tails over the run_serve mixed-size
+    request stream (per-request submit→done walls via done-callbacks,
+    so two engines measured back-to-back never share a percentile
+    ring), and (c) the post-warmup serve.traces delta — the
+    zero-recompile contract."""
+    import threading
+    from incubator_mxnet_tpu.monitor import events
+    rs = np.random.RandomState(seed)
+    eng = net.inference_engine(ctx=ctx, max_batch=max_batch,
+                               queue_cap=max(64, n))
+    try:
+        warm = eng.warmup(example_shape=imgs.shape[1:],
+                          wire_dtype="float32")
+        traces0 = events.get("serve.traces")
+        capacity = measure_serve_capacity(eng, imgs, capacity_s,
+                                          batch=8)
+        lats, lock = [], threading.Lock()
+
+        def track(t_sub):
+            def cb(_f):
+                dt = time.perf_counter() - t_sub
+                with lock:
+                    lats.append(dt)
+            return cb
+
+        futs = []
+        t0 = time.perf_counter()
+        i = 0
+        while i < n:
+            k = int(rs.choice((1, 1, 2, 3, 5, 8)))
+            k = min(k, n - i)
+            f = eng.submit(imgs[i]) if k == 1 else \
+                eng.submit_batch(imgs[i:i + k])
+            f.add_done_callback(track(time.perf_counter()))
+            futs.append(f)
+            i += k
+        for f in futs:
+            r = f.result(timeout=300)
+            # a server RETURNS results: one-element D2H per request,
+            # identical on both variants (symmetric comparison)
+            float(r.reshape((-1,))[:1].asnumpy()[0])
+        stream_rate = n / (time.perf_counter() - t0)
+        traces_delta = events.get("serve.traces") - traces0
+        # result() can return BEFORE the future's done-callbacks run
+        # (set_result notifies waiters first): wait for every latency
+        # sample to land before reading the list, or the sort below
+        # races the last appends and p99 drops the slowest requests —
+        # exactly the samples a tail metric exists for
+        t_cb = time.monotonic() + 10.0
+        while time.monotonic() < t_cb:
+            with lock:
+                if len(lats) >= len(futs):
+                    break
+            time.sleep(0.002)
+    finally:
+        eng.close()
+    with lock:
+        lats = sorted(lats)
+
+    def pct(p):
+        return lats[min(len(lats) - 1,
+                        max(0, int(round(p * len(lats))) - 1))]
+
+    return {"images_per_sec": round(capacity, 2),
+            "stream_images_per_sec": round(stream_rate, 2),
+            "p50_ms": round(pct(0.50) * 1e3, 3),
+            "p99_ms": round(pct(0.99) * 1e3, 3),
+            "traces_after_warmup_delta": int(traces_delta),
+            "warmup_wall_s": warm["wall_s"]}
+
+
+def run_quant(epochs=3, batch=256, train_n=2560, eval_n=512,
+              serve_n=256, amp_steps=12, extra=None):
+    """Quant config (ISSUE 15): int8 serving + bf16 AMP training as
+    first-class paths, measured end to end.
+
+    Four parts, merged into BENCH_serve.json:
+    1. ACCURACY — train the quant MLP on the quality-config dataset,
+       post-training-quantize a parameter-identical copy (naive
+       calibration over train batches), report f32 vs int8 top-1 and
+       the delta against QUANT_ACC_DELTA_BOUND.
+    2. SERVING — the same mixed-size request stream run_serve uses,
+       driven at an f32 engine and at the int8 engine: throughput,
+       client-observed p50/p99, and the zero-recompile contract
+       (serve.traces delta 0 after warmup) on BOTH.
+    3. CAPACITY — one budgeted registry device, models admitted until
+       AdmissionDenied for f32 vs int8: the packing multiplier the
+       ~4x smaller int8 footprints buy (this is ledger math — judged
+       on every host).
+    4. AMP — ResilientTrainer guarded steps (the NaN-guard IS the
+       overflow backstop) f32 vs amp='bfloat16': median step wall,
+       loss trajectories bit-finite, guard trips on the clean run.
+
+    Host honesty: backend_dtype_gemm_ratio probes whether THIS backend
+    has native int8/bf16 matmul.  Where it does not (XLA-CPU emulates
+    both), the throughput/step-time speedups are recorded but marked
+    unjudgeable (quant_host_note) — the accuracy, packing and
+    zero-recompile contracts gate quant_ok regardless."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, autograd as ag
+    from incubator_mxnet_tpu.monitor import events
+    from incubator_mxnet_tpu.contrib import amp as amp_mod
+    from incubator_mxnet_tpu.serving import (
+        ModelRegistry, AdmissionDenied, project_footprint,
+        quantize_for_serving)
+
+    ctx = mx.gpu()
+    out = {"quant_model": "mlp_3072_256_10_on_quality_data",
+           "quant_acc_delta_bound": QUANT_ACC_DELTA_BOUND}
+
+    # backend probes first: they decide which contracts are judgeable
+    int8_ratio = backend_dtype_gemm_ratio("int8")
+    bf16_ratio = backend_dtype_gemm_ratio("bfloat16")
+    out["quant_backend_int8_gemm_ratio"] = round(int8_ratio, 3)
+    out["quant_backend_bf16_gemm_ratio"] = round(bf16_ratio, 3)
+
+    # ---- 1. accuracy on the quality-config dataset
+    x_np, y_np = _quality_dataset(train_n + eval_n)
+    xt, yt = x_np[:train_n], y_np[:train_n]
+    xe, ye = x_np[train_n:], y_np[train_n:]
+    net = _quant_mlp()
+    net.hybridize(static_alloc=True, static_shape=True)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    for _ep in range(epochs):
+        for i in range(0, train_n, batch):
+            xb = nd.array(xt[i:i + batch], ctx=ctx)
+            yb = nd.array(yt[i:i + batch], ctx=ctx)
+            with ag.record():
+                l = loss_fn(net(xb), yb)
+                l.backward()
+            trainer.step(batch)
+
+    def eval_acc(model):
+        correct = 0
+        for i in range(0, eval_n, batch):
+            o = model(nd.array(xe[i:i + batch], ctx=ctx))
+            correct += int((o.asnumpy().argmax(axis=1)
+                            == ye[i:i + batch]).sum())
+        return correct / float(eval_n)
+
+    acc_f32 = eval_acc(net)
+    # parameter-identical copy → PTQ pipeline (calibrate → rewrite)
+    import tempfile
+    qnet = _quant_mlp()
+    with tempfile.NamedTemporaryFile(suffix=".params") as tf:
+        net.save_parameters(tf.name)
+        qnet.load_parameters(tf.name, ctx=ctx)
+    calib = [nd.array(xt[i:i + batch], ctx=ctx)
+             for i in range(0, 4 * batch, batch)]
+    _, qreport = quantize_for_serving(qnet, calib)
+    acc_int8 = eval_acc(qnet)
+    out.update({
+        "quant_acc_f32": round(acc_f32, 4),
+        "quant_acc_int8": round(acc_int8, 4),
+        "quant_acc_delta": round(acc_f32 - acc_int8, 4),
+        "quant_calib_mode": qreport["calib_mode"],
+        "quant_quantized_layers": qreport["quantized_layers"],
+        "quant_weight_bytes_f32":
+            qreport["weight_bytes_total_before"],
+        "quant_weight_bytes_int8":
+            qreport["weight_bytes_total_after"],
+    })
+
+    # ---- 2. serving throughput/p99 + zero-recompile, f32 vs int8
+    imgs = xe[:serve_n].astype(np.float32)
+    f32_serve = _measure_engine_serve(net, imgs, serve_n, 0, ctx)
+    int8_serve = _measure_engine_serve(qnet, imgs, serve_n, 0, ctx)
+    for k, v in f32_serve.items():
+        out["quant_f32_serve_" + k] = v
+    for k, v in int8_serve.items():
+        out["quant_int8_serve_" + k] = v
+    out["quant_int8_speedup"] = round(
+        int8_serve["images_per_sec"]
+        / max(f32_serve["images_per_sec"], 1e-9), 3)
+    out["quant_traces_after_warmup_delta"] = \
+        int8_serve["traces_after_warmup_delta"]
+
+    # ---- 3. capacity: models admitted per budgeted device
+    fp_f32, _d = project_footprint(net, (1, 2, 4, 8, 16), (3, 32, 32),
+                                   "float32")
+    fp_int8, _d8 = project_footprint(qnet, (1, 2, 4, 8, 16),
+                                     (3, 32, 32), "float32")
+    budget = int(2.2 * fp_f32)
+
+    def admitted(block):
+        reg = ModelRegistry(devices=[ctx], hbm_budget=budget)
+        n_adm = 0
+        try:
+            while n_adm < 32:
+                reg.register("m%d" % n_adm, block,
+                             example_shape=(3, 32, 32),
+                             wire_dtype="float32", max_batch=16)
+                n_adm += 1
+        except AdmissionDenied:
+            pass
+        finally:
+            reg.close()
+        return n_adm
+
+    n_f32 = admitted(net)
+    n_int8 = admitted(qnet)
+    out.update({
+        "quant_footprint_f32_bytes": int(fp_f32),
+        "quant_footprint_int8_bytes": int(fp_int8),
+        "quant_hbm_budget_bytes": budget,
+        "quant_models_admitted_f32": n_f32,
+        "quant_models_admitted_int8": n_int8,
+        "quant_packing_multiplier": round(n_int8 / max(n_f32, 1), 2),
+    })
+
+    # ---- 4. AMP bf16 guarded steps vs f32
+    from incubator_mxnet_tpu.parallel.trainer import ShardedTrainer
+    from incubator_mxnet_tpu.parallel.resilience import ResilientTrainer
+
+    def amp_run(amp_dtype):
+        # amp=False (not None) on BOTH layers of the baseline: None
+        # means "fall back to MXNET_AMP_DTYPE", and an exported env
+        # default would silently turn the f32 arm into a bf16-vs-bf16
+        # comparison; the ResilientTrainer owns the policy for the
+        # AMP arm
+        t = ShardedTrainer(
+            _quant_mlp(seed=4321, in_units=512, hidden=512),
+            optimizer="sgd", lr=0.05, amp=False)
+        res = ResilientTrainer(t, ckpt_dir=None,
+                               amp=amp_dtype or False,
+                               handle_sigterm=False)
+        rs = np.random.RandomState(3)
+        xa = rs.randn(batch, 512).astype(np.float32)
+        ya = rs.randint(0, 10, batch).astype(np.int32)
+        walls, losses, trips = [], [], 0
+        for _ in range(amp_steps):
+            t0 = time.perf_counter()
+            loss, ok = res.step(xa, ya)
+            walls.append(time.perf_counter() - t0)
+            losses.append(loss)
+            trips += 0 if ok else 1
+        amp_mod.turn_off()
+        walls = sorted(walls[2:])          # drop compile steps
+        return walls[len(walls) // 2], losses, trips
+
+    w_f32, l_f32, trips_f32 = amp_run(False)
+    w_amp, l_amp, trips_amp = amp_run("bfloat16")
+    amp_finite = bool(np.all(np.isfinite(l_amp))
+                      and np.all(np.isfinite(l_f32)))
+    out.update({
+        "quant_amp_step_ms": round(w_amp * 1e3, 3),
+        "quant_amp_f32_step_ms": round(w_f32 * 1e3, 3),
+        "quant_amp_speedup": round(w_f32 / max(w_amp, 1e-9), 3),
+        "quant_amp_losses_finite": amp_finite,
+        "quant_amp_nan_guard_trips": int(trips_amp),
+        "quant_amp_final_loss": round(float(l_amp[-1]), 4),
+        "quant_amp_f32_final_loss": round(float(l_f32[-1]), 4),
+    })
+
+    # ---- verdict: host-independent contracts always gate; the
+    # throughput contracts join only where the backend has the fast
+    # path (the probe), mirroring check_feed's "ceiling too low =
+    # neither pass nor fail" convention
+    ok = (out["quant_traces_after_warmup_delta"] == 0
+          and f32_serve["traces_after_warmup_delta"] == 0
+          and out["quant_acc_delta"] <= QUANT_ACC_DELTA_BOUND
+          and out["quant_packing_multiplier"] >= 2.0
+          and amp_finite and trips_amp == 0)
+    judged_speed = int8_ratio >= 1.0
+    if judged_speed:
+        ok = ok and out["quant_int8_speedup"] >= 2.0
+    else:
+        out["quant_host_note"] = (
+            "backend emulates int8/bf16 GEMM (int8 ratio %.2f, bf16 "
+            "%.2f): throughput/step-time speedups are recorded but "
+            "not judged on this host; accuracy, packing and "
+            "zero-recompile contracts gate quant_ok"
+            % (int8_ratio, bf16_ratio))
+    # the bf16 step-time contract joins only on a CLEARLY native bf16
+    # backend (probe >= 1.1, not 1.0: XLA-CPU bf16 matmul lands near
+    # f32 speed, and a 1.02-by-noise probe must not arm a >1.0 gate
+    # that the 10-step median then fails by the same noise)
+    if bf16_ratio >= 1.1:
+        ok = ok and out["quant_amp_speedup"] > 1.0
+    out["quant_int8_speedup_judged"] = bool(judged_speed)
+    out["quant_ok"] = bool(ok)
+    if extra is not None:
+        extra.update(out)
+    return out
+
+
 def run_io(batch=128):
     """Input-pipeline-only throughput on the multi-process decode
     service (io/decode_service.py): sharded RecordIO readers → worker-
@@ -2225,6 +2582,7 @@ _CONFIGS = {
         batch_key="sharded_trainer_batch"),
     "int8": lambda b=None: _cfg_simple(
         "resnet50_int8_infer_images_per_sec", run_int8_infer, (64, 32)),
+    "quant": lambda b=None: _cfg_quant(),
     "quality": lambda b=None: run_quality(),
     "serve": lambda b=None: _cfg_serve(),
     "generate": lambda b=None: _cfg_generate(),
@@ -2333,6 +2691,15 @@ def _cfg_generate():
     return parsed
 
 
+def _cfg_quant():
+    parsed = run_quant()
+    try:
+        _merge_bench_serve(parsed)      # quant_* keys ride in the
+    except Exception:                   # serve trajectory file
+        pass
+    return parsed
+
+
 def _cfg_elastic():
     parsed = run_elastic()
     try:
@@ -2387,19 +2754,32 @@ def main():
     required = ("resnet", "bert", "ssd512", "rcnn", "gnmt",
                 "transformer_nmt", "wide_deep")
     optional = ("io", "serve", "generate", "sharded", "elastic",
-                "multichip", "quality", "int8")
+                "multichip", "quality", "quant", "int8")
 
     # optional configs need this much budget left to be worth starting
     # (below it they'd time out AT the budget edge instead of skipping
     # cleanly — int8's quantization calibration alone needs ~4 min cold)
     optional_min = {"io": 30, "serve": 90, "generate": 60,
                     "sharded": 90, "elastic": 60, "multichip": 90,
-                    "quality": 120, "int8": 250}
+                    "quality": 120, "quant": 150, "int8": 250}
 
     for name in required + optional:
         remaining = budget - (time.perf_counter() - t_start)
         if name not in required and remaining < optional_min[name]:
-            extra[name + "_skipped"] = "bench budget (%ds) spent" % budget
+            # typed skip record (ISSUE 15 satellite): a machine-readable
+            # reason in the standard schema, with the standalone escape
+            # hatch named — any config runs budget-free via
+            # `python bench.py <cfg>`.  String-valued on purpose:
+            # bench_diff flattens numeric leaves and its 'skipped'
+            # fragment judges them lower-better, so a numeric
+            # remaining_s here would read budget-timing noise between
+            # rounds as a regression
+            extra[name + "_skipped"] = {
+                "reason": "budget",
+                "detail": "needed %ds, %.0fs remaining of %ds budget"
+                          % (optional_min[name], remaining, budget),
+                "standalone": "python bench.py %s" % name,
+            }
             continue
         # required configs get a fair floor even if earlier ones ran
         # long; optionals never exceed the remaining budget; the
@@ -2563,9 +2943,46 @@ if __name__ == "__main__":
         # marked child of run_multichip (same virtual-platform recipe)
         _multichip_scenario(int(sys.argv[2]))
         sys.exit(0)
-    if len(sys.argv) >= 3 and sys.argv[1] == "--config":
-        name = sys.argv[2]
-        batch = sys.argv[3] if len(sys.argv) >= 4 else None
+    if len(sys.argv) >= 2 and sys.argv[1] == "quant":
+        # standalone quant bench (ISSUE 15): ONE JSON line; quant_*
+        # keys merged into BENCH_serve.json.  rc 1 only when a
+        # host-independent contract broke (steady-state recompile,
+        # accuracy delta past the documented bound, packing < 2x, a
+        # NaN-guard trip on the clean AMP run) or — on hosts whose
+        # backend has native int8 — the 2x throughput contract
+        try:
+            parsed = run_quant()
+            rc = 0 if parsed.get("quant_ok") is not False else 1
+            try:
+                # same cost-table totals every other standalone config
+                # line carries (schema parity with `--config quant`)
+                from incubator_mxnet_tpu.telemetry import costs as _costs
+                t = _costs.totals()
+                if t.get("executables"):
+                    parsed["quant_costs"] = t
+            except Exception:
+                pass
+        except Exception as e:
+            parsed, rc = {"quant_error": str(e)[:160]}, 1
+            try:
+                from incubator_mxnet_tpu import telemetry
+                parsed["quant_blackbox"] = telemetry.dump_blackbox(
+                    reason="bench.quant", exc=e)
+            except Exception:
+                pass
+        try:
+            _merge_bench_serve(parsed, rc=rc)
+        except Exception:
+            pass
+        print(json.dumps(parsed))
+        sys.exit(rc)
+
+    def _run_one_config(name, batch, rc_on_fail):
+        """ONE config → one JSON line.  Shared by the driver's
+        `--config` subprocess protocol (rc 0 even on failure — the
+        driver reads <cfg>_error and walks its batch ladder) and the
+        bare `bench.py <cfg>` standalone entry (rc 1 on failure —
+        ISSUE 15 satellite: any config runs budget-free)."""
         try:
             out = _CONFIGS[name](batch)
             try:
@@ -2578,7 +2995,7 @@ if __name__ == "__main__":
             except Exception:
                 pass
             print(json.dumps(out))
-            sys.exit(0)
+            return 0
         except Exception as e:
             # a crashing config leaves its black box (ring + counters +
             # cost table) and reports <cfg>_failed instead of killing
@@ -2593,5 +3010,17 @@ if __name__ == "__main__":
             except Exception:
                 pass
             print(json.dumps(fail))
-            sys.exit(0)
+            return rc_on_fail
+
+    if len(sys.argv) >= 3 and sys.argv[1] == "--config":
+        sys.exit(_run_one_config(
+            sys.argv[2], sys.argv[3] if len(sys.argv) >= 4 else None,
+            rc_on_fail=0))
+    if len(sys.argv) >= 2 and sys.argv[1] in _CONFIGS:
+        # bare `bench.py <cfg>` (ISSUE 15 satellite): any config —
+        # including ones the last full round skipped for budget — runs
+        # standalone with no budget gate; rc reflects THIS config
+        sys.exit(_run_one_config(
+            sys.argv[1], sys.argv[2] if len(sys.argv) >= 3 else None,
+            rc_on_fail=1))
     sys.exit(main())

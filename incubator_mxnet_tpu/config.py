@@ -373,6 +373,24 @@ register("MXNET_GEN_BUCKETS", str, "",
          "= powers of two from 8 up to MXNET_GEN_MAX_LEN.  The set "
          "is CLOSED: after warmup() no prompt length ever traces a "
          "new executable (serve.traces stays flat)")
+register("MXNET_QUANT_CALIB_MODE", str, "naive",
+         "serving.quantize_for_serving default calibration mode: "
+         "'naive' (min/max over the calibration batches), 'entropy' "
+         "(KL-divergence optimal thresholds — clips activation "
+         "outliers, usually the better accuracy at the same bits), "
+         "or 'none' (dynamic per-batch ranges, slowest)")
+register("MXNET_QUANT_CALIB_BATCHES", int, 10,
+         "serving.quantize_for_serving default number of calibration "
+         "batches consumed from calib_data. 0 = the whole iterable")
+register("MXNET_AMP_DTYPE", str, "",
+         "Default mixed-precision compute dtype for ShardedTrainer/"
+         "ResilientTrainer built with amp=None: 'bfloat16' (TPU-"
+         "native: f32 exponent range, no loss scaling) or 'float16' "
+         "(parity path — pair with a LossScaler; ResilientTrainer "
+         "arms one automatically, backed by the NaN-guard).  Empty = "
+         "full f32.  Master weights stay f32 either way; the cast "
+         "policy lives in the op registry (contrib.amp.init) so "
+         "imperative, symbolic AND jitted step traces all see it")
 register("MXNET_SERVE_HBM_BUDGET", int, 0,
          "ModelRegistry: per-device HBM budget in bytes for serving "
          "admission control. 0 = auto (the device's PJRT bytes_limit "
@@ -458,8 +476,9 @@ register("MXNET_BLACKBOX_RING", int, 4096,
          "last-N timeline a black-box dump embeds)")
 register("MXNET_BLACKBOX_DIR", str, "",
          "Directory for black-box dumps (auto-named "
-         "blackbox-<ts>-p<pid>-<seq>-<reason>.json). Empty = current "
-         "working directory")
+         "blackbox-<ts>-p<pid>-<seq>-<reason>.json). Empty = the "
+         "system temp directory (crash hooks armed outside bench/tests "
+         "must not litter the launch directory)")
 register("MXNET_ZERO_LEVEL", int, 0,
          "Default ZeRO stage for ShardedTrainer(zero=None): 0 = fully "
          "replicated, 1 = optimizer state sharded along the data axis "

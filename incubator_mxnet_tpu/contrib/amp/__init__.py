@@ -26,7 +26,7 @@ from .loss_scaler import LossScaler
 
 __all__ = ["init", "init_trainer", "scale_loss", "unscale",
            "convert_hybrid_block", "convert_model", "convert_symbol",
-           "LossScaler", "lists"]
+           "LossScaler", "lists", "current_target", "normalize_dtype"]
 
 _CURRENT = {"target": None, "orig": {}}   # opname -> original fn
 
@@ -88,6 +88,34 @@ def init(target_dtype="bfloat16", target_precision_ops=None,
         od.fn = _wrap_cast(od.fn, f32, low_floats)
     _CURRENT["target"] = str(target)   # normalized name ("float16"), not
     # str(raw arg) — init_trainer's float16 check and re-init compare it
+
+
+def current_target():
+    """The active AMP target dtype name ('bfloat16'/'float16'), or
+    None when AMP is off."""
+    return _CURRENT["target"]
+
+
+_DTYPE_ALIASES = {"bf16": "bfloat16", "fp16": "float16",
+                  "half": "float16"}
+
+
+def normalize_dtype(amp):
+    """Canonical AMP target for trainer ``amp=`` / MXNET_AMP_DTYPE
+    values: 'bfloat16' | 'float16' | None (off).  Accepts the common
+    aliases (bf16/fp16/half) and the off spellings (''/0/off/none/
+    float32); anything else raises."""
+    if amp in (None, False, 0):
+        return None
+    s = str(amp).strip().lower()
+    if s in ("", "0", "off", "none", "float32", "fp32"):
+        return None
+    s = _DTYPE_ALIASES.get(s, s)
+    if s not in ("bfloat16", "float16"):
+        raise ValueError(
+            "unsupported AMP dtype %r (use 'bfloat16' or 'float16')"
+            % (amp,))
+    return s
 
 
 def _try_get(reg, name):

@@ -22,6 +22,7 @@ class LossScaler:
         self._unskipped = 0
 
     def update(self, overflow: bool) -> None:
+        old = self.loss_scale
         if overflow:
             self.loss_scale = max(1.0, self.loss_scale / self._scale_factor)
             self._unskipped = 0
@@ -30,3 +31,22 @@ class LossScaler:
             if self._unskipped >= self._scale_window:
                 self.loss_scale *= self._scale_factor
                 self._unskipped = 0
+        if self.loss_scale != old:
+            self._note_transition(old, overflow)
+
+    def _note_transition(self, old, overflow):
+        """Scale TRANSITIONS are the loss-scale events the telemetry
+        layer wants (ISSUE 15): a backoff means the overflow backstop
+        (the PR 1 NaN-guard on guarded steps, grad-zeroing on the
+        gluon path) just fired, growth means the window of clean steps
+        elapsed.  Lazy imports keep this module usable from
+        telemetry-free contexts; emission is best-effort."""
+        try:
+            from ...monitor import events
+            from ...telemetry import flightrec as _bb
+        except Exception:               # noqa: BLE001
+            return
+        events.incr("amp.loss_scale_backoff" if self.loss_scale < old
+                    else "amp.loss_scale_growth")
+        _bb.record("amp", "loss_scale", scale=self.loss_scale,
+                   prev=old, overflow=bool(overflow))

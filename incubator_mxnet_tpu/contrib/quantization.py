@@ -29,7 +29,8 @@ from ..base import MXNetError
 
 __all__ = ["quantize_net", "quantize_model", "QuantizedDense",
            "QuantizedConv2D", "_get_optimal_threshold",
-           "LayerOutputMinMaxCollector", "LayerHistogramCollector"]
+           "LayerOutputMinMaxCollector", "LayerHistogramCollector",
+           "quantized_layers", "is_quantized"]
 
 
 # ---------------------------------------------------------------------------
@@ -191,6 +192,25 @@ def _quantize_weight(w):
     return (NDArray(q, ctx=w.context), -amax, amax)
 
 
+def _int8_param(name, nd_arr):
+    """Wrap an int8 NDArray as a non-trainable gluon Parameter.
+
+    The quantized weights must be PARAMETERS, not plain attributes: the
+    serving stack's functional bridge (`parallel.functional`) only sees
+    `collect_params()`, so parameter-held int8 weights flow into traced
+    executables as ARGUMENTS — replicated per serving device once,
+    counted by XLA's memory_analysis as argument bytes, and priced by
+    the registry's admission projection at 1 byte/element.  A plain
+    attribute would instead be baked into EVERY bucket executable as a
+    constant (N buckets × a full weight copy)."""
+    from collections import OrderedDict
+    from ..gluon.parameter import Parameter
+    p = Parameter(name, grad_req="null", shape=nd_arr.shape,
+                  dtype="int8", differentiable=False)
+    p._data = OrderedDict([(nd_arr.context, nd_arr)])
+    return p
+
+
 class _QuantizedLayer:
     """Shared machinery: calibrated input range + requantize-out."""
 
@@ -232,14 +252,15 @@ class QuantizedDense(_Block, _QuantizedLayer):
         self._units = dense._units
         self._flatten = dense._flatten
         self.act = dense.act
-        self._qw, self._wmin, self._wmax = _quantize_weight(
+        qw, self._wmin, self._wmax = _quantize_weight(
             dense.weight.data())
+        self.qweight = _int8_param(dense.weight.name + "_quantize", qw)
         bias = getattr(dense, "bias", None)   # absent on use_bias=False
         if bias is not None:
-            self._qb, self._bmin, self._bmax = _quantize_weight(
-                bias.data())
+            qb, self._bmin, self._bmax = _quantize_weight(bias.data())
+            self.qbias = _int8_param(bias.name + "_quantize", qb)
         else:
-            self._qb = None
+            self.qbias = None
 
     def forward(self, x):
         from ..ndarray.ndarray import invoke
@@ -248,18 +269,19 @@ class QuantizedDense(_Block, _QuantizedLayer):
         ctx = x.context
         wmin = array([self._wmin], ctx=ctx)
         wmax = array([self._wmax], ctx=ctx)
-        if self._qb is not None:
+        if self.qbias is not None:
             bmin = array([self._bmin], ctx=ctx)
             bmax = array([self._bmax], ctx=ctx)
             acc, mn, mx = invoke(
-                "_contrib_quantized_fully_connected", qx, self._qw,
-                self._qb, mnd, mxd, wmin, wmax, bmin, bmax,
+                "_contrib_quantized_fully_connected", qx,
+                self.qweight.data(ctx), self.qbias.data(ctx), mnd, mxd,
+                wmin, wmax, bmin, bmax,
                 num_hidden=self._units, flatten=self._flatten)
         else:
             acc, mn, mx = invoke(
-                "_contrib_quantized_fully_connected", qx, self._qw,
-                None, mnd, mxd, wmin, wmax, None, None,
-                num_hidden=self._units, no_bias=True,
+                "_contrib_quantized_fully_connected", qx,
+                self.qweight.data(ctx), None, mnd, mxd, wmin, wmax,
+                None, None, num_hidden=self._units, no_bias=True,
                 flatten=self._flatten)
         out = self._finish(acc, mn, mx)
         if self.act is not None:
@@ -276,14 +298,15 @@ class QuantizedConv2D(_Block, _QuantizedLayer):
         self._setup_ranges(in_range, out_range, quantized_dtype)
         self._kwargs = dict(conv._kwargs)
         self.act = conv.act
-        self._qw, self._wmin, self._wmax = _quantize_weight(
+        qw, self._wmin, self._wmax = _quantize_weight(
             conv.weight.data())
+        self.qweight = _int8_param(conv.weight.name + "_quantize", qw)
         bias = getattr(conv, "bias", None)
         if bias is not None:
-            self._qb, self._bmin, self._bmax = _quantize_weight(
-                bias.data())
+            qb, self._bmin, self._bmax = _quantize_weight(bias.data())
+            self.qbias = _int8_param(bias.name + "_quantize", qb)
         else:
-            self._qb = None
+            self.qbias = None
 
     def forward(self, x):
         from ..ndarray.ndarray import invoke
@@ -295,20 +318,39 @@ class QuantizedConv2D(_Block, _QuantizedLayer):
         kw = {k: self._kwargs[k] for k in
               ("kernel", "stride", "pad", "dilate", "num_filter",
                "num_group") if k in self._kwargs}
-        if self._qb is not None:
+        if self.qbias is not None:
             bmin = array([self._bmin], ctx=ctx)
             bmax = array([self._bmax], ctx=ctx)
             acc, mn, mx = invoke(
-                "_contrib_quantized_conv", qx, self._qw, self._qb,
-                mnd, mxd, wmin, wmax, bmin, bmax, **kw)
+                "_contrib_quantized_conv", qx, self.qweight.data(ctx),
+                self.qbias.data(ctx), mnd, mxd, wmin, wmax, bmin, bmax,
+                **kw)
         else:
             acc, mn, mx = invoke(
-                "_contrib_quantized_conv", qx, self._qw, None,
-                mnd, mxd, wmin, wmax, None, None, no_bias=True, **kw)
+                "_contrib_quantized_conv", qx, self.qweight.data(ctx),
+                None, mnd, mxd, wmin, wmax, None, None, no_bias=True,
+                **kw)
         out = self._finish(acc, mn, mx)
         if self.act is not None:
             out = invoke("Activation", out, act_type=self.act)
         return out
+
+
+def quantized_layers(block, prefix=""):
+    """Yield ``(path, wrapper)`` for every quantized layer under
+    `block` (post-`quantize_net` introspection: the serving pipeline's
+    calibration report and the admission detail both count these)."""
+    for name, child in block._children.items():
+        path = prefix + name
+        if isinstance(child, (QuantizedDense, QuantizedConv2D)):
+            yield path, child
+        else:
+            yield from quantized_layers(child, path + ".")
+
+
+def is_quantized(block) -> bool:
+    """True when `block` holds at least one quantized layer."""
+    return next(quantized_layers(block), None) is not None
 
 
 # ---------------------------------------------------------------------------

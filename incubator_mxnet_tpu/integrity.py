@@ -383,11 +383,15 @@ def quarantine_path() -> str:
     """This process's quarantine ledger (JSON lines, one per record):
     ``<MXNET_BLACKBOX_DIR>/io-quarantine-p<pid>.jsonl`` — next to the
     black-box dumps, because it answers the same forensic question."""
-    d = _cfg.get("MXNET_BLACKBOX_DIR") or os.getcwd()
+    import tempfile
+    # same default as the black-box dumps (flightrec._resolve_path):
+    # scratch, never the launch directory — a quarantine hit outside
+    # bench/tests must not litter the checkout
+    d = _cfg.get("MXNET_BLACKBOX_DIR") or tempfile.gettempdir()
     try:
         os.makedirs(d, exist_ok=True)
     except OSError:
-        d = os.getcwd()
+        d = tempfile.gettempdir()
     return os.path.join(d, "io-quarantine-p%d.jsonl" % os.getpid())
 
 

@@ -37,6 +37,12 @@ except Exception:                                       # pragma: no cover
     pl = pltpu = None
     _PALLAS_OK = False
 
+# jax renamed pltpu.TPUCompilerParams -> CompilerParams across 0.4->0.5;
+# resolve whichever this jaxlib ships so the kernels build on both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams", None) if pltpu is not None \
+    else None
+
 __all__ = ["flash_attention", "naive_attention"]
 
 _NEG_INF = -1e30
@@ -198,7 +204,7 @@ def _flash_fwd(q, k, v, scale, causal):
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(q, k, v)
@@ -344,7 +350,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, scale, causal):
             pltpu.VMEM((bq, d), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interp,
     )(q, k, v, do, out, lse)
@@ -373,7 +379,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, scale, causal):
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interp,
     )(k, v, q, do, out, lse)

@@ -135,7 +135,21 @@ class ResilientTrainer:
                     ``fold_in(key(seed), step)`` — which makes resume
                     bit-deterministic with no key state to carry
     loss_scaler:    optional amp.LossScaler driving loss scaling with
-                    backoff on bad steps (default: scale 1.0)
+                    backoff on bad steps (default: scale 1.0, or the
+                    amp= default below)
+    amp:            mixed-precision compute dtype, or None =
+                    MXNET_AMP_DTYPE (empty = off).  'bfloat16' turns
+                    on the op-registry cast policy (contrib.amp.init;
+                    f32 master weights, no scaling needed — bf16
+                    shares f32's exponent range).  'float16' is the
+                    parity path: the default loss_scaler becomes a
+                    dynamic LossScaler(2^16) whose overflow verdict IS
+                    this trainer's NaN-guard — the guarded step checks
+                    the SCALED grads for finiteness inside the
+                    executable, a bad step skips the update and backs
+                    the scale off, scale_window clean steps grow it.
+                    Scale transitions land on monitor.events
+                    (amp.loss_scale_*) and in the flight recorder
     handle_sigterm: install a SIGTERM handler that converts preemption
                     into checkpoint-and-clean-exit (main thread only)
     audit_interval: cross-replica SDC audit cadence in steps (default:
@@ -171,9 +185,26 @@ class ResilientTrainer:
                  seed: int = 0, ema_decay: float = 0.9,
                  loss_scaler: Optional[LossScaler] = None,
                  handle_sigterm: bool = True,
-                 audit_interval: Optional[int] = None):
+                 audit_interval: Optional[int] = None,
+                 amp: Optional[str] = None):
         from .. import config
+        from ..contrib import amp as _amp_mod
         self.trainer = trainer
+        # AMP (ISSUE 15): arm the cast policy before the guarded step
+        # is traced; f16 gets the dynamic scaler whose overflow
+        # backstop is this trainer's in-executable NaN-guard, bf16
+        # needs none (f32 exponent range) so scale stays 1.0
+        self.amp = _amp_mod.normalize_dtype(
+            amp if amp is not None else config.get("MXNET_AMP_DTYPE"))
+        if self.amp:
+            _amp_mod.init(self.amp)
+            if loss_scaler is None:
+                loss_scaler = LossScaler(
+                    init_scale=2.0 ** 16 if self.amp == "float16"
+                    else 1.0)
+            events.incr("amp.trainer_init")
+            _bb.record("amp", "init", target=self.amp,
+                       trainer="resilient")
         self.ckpt_dir = os.path.abspath(ckpt_dir) if ckpt_dir else None
         self.ckpt_interval = int(ckpt_interval if ckpt_interval is not None
                                  else config.get("MXNET_CKPT_INTERVAL"))
@@ -377,7 +408,13 @@ class ResilientTrainer:
         # last-N step timeline a black-box dump replays
         _bb.record("step", "resilient", step=stepno,
                    loss=(loss if loss == loss else None), ok=ok,
-                   us=int((t2 - t0) * 1e6))
+                   us=int((t2 - t0) * 1e6),
+                   **({"amp": self.amp} if self.amp else {}))
+        if self.amp:
+            # labeled AMP step-wall ring (ISSUE 15): percentiles of
+            # the bf16/f16 guarded step next to the unlabeled series
+            events.observe_time("train.step_us", t2 - t0,
+                                labels={"amp": self.amp})
         if tele is not None:
             tele.record_step(loss=loss, ok=ok, wall_s=t2 - t0,
                              data_wait_s=t1 - t0, compute_s=t2 - t1,

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import config as _config
 from .functional import functionalize, extract_params, load_params
 from .mesh import make_mesh, mesh_devices
 from .zero import BucketPlan, overlap_schedule, record_plan, \
@@ -127,12 +128,34 @@ class ShardedTrainer:
     preprocess: pure jnp fn applied to the batch INSIDE the jitted
         step (e.g. `io.device_feed.make_normalizer` — uint8 wire
         batches are normalized/cast on device, fused with the step)
+    amp: mixed-precision compute dtype, or None = MXNET_AMP_DTYPE
+        (empty = off).  'bfloat16' turns the op-registry cast policy
+        on (`contrib.amp.init`): matmul/conv ops compute in bf16,
+        numerically-sensitive ops stay f32, and because the policy
+        sits below `invoke`, the SAME casts land inside this
+        trainer's jitted step executables — ZeRO-2/3's shard_map
+        bodies included.  Master weights and optimizer state stay
+        f32 (grads arrive f32 at the update).  'float16' is the
+        parity path: bare ShardedTrainer runs it unscaled (bf16-range
+        models only); wrap in ResilientTrainer(amp='float16') for the
+        dynamic LossScaler backed by the NaN-guard.  The policy is
+        process-wide — `contrib.amp.turn_off()` reverts it.
     """
 
     def __init__(self, block, loss_fn=softmax_ce_loss, optimizer="sgd",
                  lr=0.01, momentum=0.9, wd=0.0, mesh: Optional[Mesh] = None,
                  batch_axis="data", param_spec_fn=None, donate=True,
-                 zero=None, preprocess=None):
+                 zero=None, preprocess=None, amp=None):
+        from ..contrib import amp as _amp_mod
+        self.amp = _amp_mod.normalize_dtype(
+            amp if amp is not None else _config.get("MXNET_AMP_DTYPE"))
+        if self.amp:
+            # BEFORE the first trace: the wrapped registry fns are what
+            # the lazily-built step executable captures
+            _amp_mod.init(self.amp)
+            events.incr("amp.trainer_init")
+            _bb.record("amp", "init", target=self.amp,
+                       trainer="sharded")
         self.block = block
         self.mesh = mesh or make_mesh()
         self.batch_axis = batch_axis
@@ -569,9 +592,15 @@ class ShardedTrainer:
                 self._broadcast_solo_params()
         t2 = time.perf_counter()
         # always-on flight-recorder step record (loss stays on device —
-        # forcing it here would forfeit dispatch/compute overlap)
+        # forcing it here would forfeit dispatch/compute overlap); AMP
+        # runs tag their records AND feed a labeled step-wall ring, so
+        # /metrics and dumps answer "bf16 step wall vs f32" directly
         _bb.record("step", "sharded", step=self._n_step - 1,
-                   us=int((t2 - t0) * 1e6))
+                   us=int((t2 - t0) * 1e6),
+                   **({"amp": self.amp} if self.amp else {}))
+        if self.amp:
+            events.observe_time("train.step_us", t2 - t0,
+                                labels={"amp": self.amp})
         if tele is not None:
             tele.record_step(wall_s=t2 - t0, data_wait_s=t1 - t0,
                              dispatch_s=t2 - t1,

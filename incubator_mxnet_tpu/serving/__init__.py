@@ -19,6 +19,13 @@ breakers)::
     reg.warmup("ranker")
     fut = reg.submit("ranker", x, lane="high", deadline=0.05)
 
+Int8 tenants ride the same contract at ~1/4 the admission footprint
+(ISSUE 15; see docs/quantization.md)::
+
+    net, report = serving.quantize_for_serving(net, calib_batches)
+    reg.register_quantized("ranker8", net2, calib_batches,
+                           example_shape=(256,))
+
 See docs/serving.md for lifecycle, admission math, the lane/shed
 decision table and the counter reference.
 """
@@ -28,10 +35,12 @@ from .registry import (ModelRegistry, AdmissionDenied, CircuitOpen,
                        UnknownModel, project_footprint)
 from .generation import (GenerationEngine, GenerationStream,
                          project_generation_footprint)
+from .quantize import quantize_for_serving, param_bytes_by_dtype
 
 __all__ = ["InferenceEngine", "QueueFull", "DeadlineExceeded",
            "EngineClosed", "Shed", "serve_counters",
            "ModelRegistry", "AdmissionDenied", "CircuitOpen",
            "UnknownModel", "project_footprint",
            "GenerationEngine", "GenerationStream",
-           "project_generation_footprint"]
+           "project_generation_footprint",
+           "quantize_for_serving", "param_bytes_by_dtype"]

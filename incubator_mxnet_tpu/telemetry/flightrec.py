@@ -329,7 +329,12 @@ def _resolve_path(path, reason):
             return path             # explicit file
         d = path
     else:
-        d = _cfg.get("MXNET_BLACKBOX_DIR") or os.getcwd()
+        # default to scratch, never the checkout: crash hooks armed
+        # OUTSIDE bench/conftest (which set MXNET_BLACKBOX_DIR) used
+        # to drop excepthook dumps into whatever directory the process
+        # happened to be launched from — typically the repo root
+        import tempfile
+        d = _cfg.get("MXNET_BLACKBOX_DIR") or tempfile.gettempdir()
         os.makedirs(d, exist_ok=True)
     name = "blackbox-%s-p%d-%03d-%s.json" % (
         time.strftime("%Y%m%dT%H%M%S"), os.getpid(), next(_SEQ),
@@ -342,8 +347,8 @@ def dump_blackbox(path=None, reason="manual", exc=None, last=None):
     percentiles, executable cost table, HBM watermarks, the last-N
     event timeline, and a chrome-trace view of it — one atomic JSON
     file (tmp + os.replace).  `path` may be a file, a directory, or
-    None (MXNET_BLACKBOX_DIR, else cwd; auto-named).  Returns the
-    written path."""
+    None (MXNET_BLACKBOX_DIR, else the system temp dir; auto-named).
+    Returns the written path."""
     # order matters: snapshot the ledger FIRST, then sample (the
     # sample's own events land in the timeline of the NEXT dump, and
     # cost resolution must not skew the counters this dump reports)

@@ -119,6 +119,12 @@ def pytest_configure(config):
         "markers", "gen: generation-serving (KV-cached decode / "
         "continuous batching) tests (CPU-fast, run in tier-1 by "
         "default)")
+    # int8 serving + AMP training (ISSUE 15): PTQ calibration/parity,
+    # int8 admission footprints, AMP trajectories and the
+    # LossScaler→NaN-guard handoff
+    config.addinivalue_line(
+        "markers", "quant: int8 quantized-serving + AMP training "
+        "tests (CPU-fast, run in tier-1 by default)")
 
 
 @pytest.fixture(autouse=True)

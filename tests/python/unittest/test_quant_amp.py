@@ -1,0 +1,414 @@
+"""Int8 serving + AMP training as first-class paths (ISSUE 15).
+
+Covers the satellite test checklist: KL-vs-naive threshold selection,
+int8-vs-f32 output tolerance on the quantized wrappers, the serving
+zero-recompile contract on a quantized model, the ~1/4 admission
+footprint and the packing multiplier in the registry ledger, AMP bf16
+step-vs-f32 loss-trajectory tolerance, and the LossScaler
+overflow→NaN-guard handoff.
+"""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon
+from incubator_mxnet_tpu.contrib import amp
+from incubator_mxnet_tpu.contrib import quantization as qz
+from incubator_mxnet_tpu.monitor import events
+from incubator_mxnet_tpu.telemetry import flightrec as bb
+
+pytestmark = pytest.mark.quant
+
+
+@pytest.fixture(autouse=True)
+def _amp_off():
+    # the cast policy is process-wide — never leak it across tests
+    yield
+    amp.turn_off()
+
+
+def _mlp(seed=1234, in_units=16, hidden=32, classes=8):
+    mx.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(hidden, activation="relu",
+                           in_units=in_units),
+            gluon.nn.Dense(classes, in_units=hidden))
+    net.initialize(force_reinit=True)
+    return net
+
+
+# ---------------------------------------------------------------------------
+# calibration
+# ---------------------------------------------------------------------------
+
+def test_kl_vs_naive_threshold_selection():
+    """On outlier-heavy activations the two calibration modes must
+    DIFFER the way they are designed to: naive min/max swallows the
+    outlier into the range (quantization step blows up), the entropy
+    collector's KL threshold clips it."""
+    rs = onp.random.RandomState(0)
+    bulk = rs.randn(50000).astype(onp.float32)
+    data = onp.concatenate([bulk, onp.array([80.0], onp.float32)])
+
+    naive = qz.LayerOutputMinMaxCollector()
+    naive.collect("a", data)
+    lo, hi = naive.range_of("a")
+    assert hi == pytest.approx(80.0)        # outlier IS the range
+
+    ent = qz.LayerHistogramCollector()
+    ent.collect("a", data)
+    klo, khi = ent.range_of("a")
+    assert khi < 20.0                       # outlier clipped
+    assert khi > 2.0                        # ...but the bulk survives
+    assert klo == -khi                      # symmetric
+
+
+def test_quantize_for_serving_report_and_counters():
+    from incubator_mxnet_tpu.serving import (quantize_for_serving,
+                                             param_bytes_by_dtype)
+    net = _mlp()
+    before = sum(param_bytes_by_dtype(net).values())
+    rs = onp.random.RandomState(1)
+    calib = [nd.array(rs.randn(8, 16).astype(onp.float32))
+             for _ in range(3)]
+    c0 = events.get("quant.models")
+    _, rep = quantize_for_serving(net, calib, calib_mode="naive",
+                                  num_calib_batches=2)
+    assert rep["quantized"] and rep["quantized_layers"] == 2
+    assert rep["calib_mode"] == "naive"
+    assert rep["weight_bytes_total_before"] == before
+    # pure-Dense net: every weight went f32 -> int8, exactly 1/4
+    assert rep["weight_bytes_total_after"] * 4 == before
+    assert "int8" in rep["weight_bytes_after"]
+    assert events.get("quant.models") == c0 + 1
+    kinds = [(e.get("kind"), e.get("name")) for e in bb.ring_snapshot()]
+    assert ("quant", "calibrated") in kinds
+
+
+# ---------------------------------------------------------------------------
+# int8 parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("calib_mode", ["naive", "entropy"])
+def test_quantized_dense_int8_tolerance(calib_mode):
+    rs = onp.random.RandomState(2)
+    net = _mlp(seed=77)
+    xs = [nd.array(rs.randn(8, 16).astype(onp.float32))
+          for _ in range(4)]
+    want = net(xs[0]).asnumpy()
+    qz.quantize_net(net, calib_data=xs, calib_mode=calib_mode)
+    got = net(xs[0]).asnumpy()
+    rel = onp.abs(got - want).max() / (onp.abs(want).max() + 1e-8)
+    assert rel < (0.2 if calib_mode == "entropy" else 0.1), rel
+
+
+def test_quantized_conv_int8_tolerance():
+    rs = onp.random.RandomState(3)
+    mx.random.seed(55)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, in_channels=3,
+                            activation="relu"),
+            gluon.nn.Conv2D(4, 3, padding=1, in_channels=8))
+    net.initialize(force_reinit=True)
+    x = nd.array(rs.randn(2, 3, 8, 8).astype(onp.float32))
+    want = net(x).asnumpy()
+    qz.quantize_net(net, calib_data=[x], calib_mode="naive")
+    got = net(x).asnumpy()
+    rel = onp.abs(got - want).max() / (onp.abs(want).max() + 1e-8)
+    assert rel < 0.1, rel
+
+
+def test_int8_weights_are_parameters():
+    """The rewritten model's int8 weights must be PARAMETERS (flow as
+    executable arguments — engine replication, admission pricing), and
+    the f32 originals must be gone from collect_params."""
+    from incubator_mxnet_tpu.parallel.functional import extract_params
+    net = _mlp()
+    f32_names = set(extract_params(net))
+    rs = onp.random.RandomState(4)
+    qz.quantize_net(net, calib_data=[nd.array(
+        rs.randn(4, 16).astype(onp.float32))], calib_mode="naive")
+    params = extract_params(net)
+    assert params, "quantized net exposes no parameters"
+    assert all(str(v.dtype) == "int8" for v in params.values()), \
+        {k: str(v.dtype) for k, v in params.items()}
+    assert not (set(params) & f32_names)
+    assert qz.is_quantized(net)
+    assert len(list(qz.quantized_layers(net))) == 2
+
+
+# ---------------------------------------------------------------------------
+# serving: zero-recompile + admission
+# ---------------------------------------------------------------------------
+
+def test_int8_serving_zero_recompile_and_parity():
+    rs = onp.random.RandomState(5)
+    net = _mlp(seed=99)
+    xs = rs.randn(16, 16).astype(onp.float32)
+    want = net(nd.array(xs)).asnumpy()
+    qz.quantize_net(net, calib_data=[nd.array(xs)], calib_mode="naive")
+    eng = net.inference_engine(ctx=mx.cpu(), max_batch=4)
+    eng.warmup(example_shape=(16,), wire_dtype="float32")
+    t0 = events.get("serve.traces")
+    futs = [eng.submit(xs[i]) for i in range(6)]
+    futs.append(eng.submit_batch(xs[6:9]))          # mixed sizes
+    outs = [f.result(timeout=60) for f in futs]
+    eng.close()
+    assert events.get("serve.traces") == t0, \
+        "steady-state recompile on the quantized path"
+    got = onp.stack([o.asnumpy() for o in outs[:6]])
+    rel = onp.abs(got - want[:6]).max() / (onp.abs(want).max() + 1e-8)
+    assert rel < 0.1, rel
+
+
+def test_registry_int8_footprint_quarter():
+    """int8 admission footprint ≈ 1/4 f32 in the registry ledger: the
+    projection prices parameters by their dtype, so the SAME
+    architecture projects a 4x smaller param term once quantized."""
+    from incubator_mxnet_tpu.serving import project_footprint
+    f32 = _mlp(seed=11, in_units=32, hidden=256, classes=10)
+    _, d32 = project_footprint(f32, (1, 2, 4), (32,), "float32")
+    q = _mlp(seed=11, in_units=32, hidden=256, classes=10)
+    rs = onp.random.RandomState(6)
+    qz.quantize_net(q, calib_data=[nd.array(
+        rs.randn(4, 32).astype(onp.float32))], calib_mode="naive")
+    _, d8 = project_footprint(q, (1, 2, 4), (32,), "float32")
+    ratio = d32["param_bytes"] / d8["param_bytes"]
+    assert 3.5 < ratio <= 4.5, ratio
+
+
+def test_registry_packing_multiplier_and_refusal():
+    """The fleet-capacity claim in ledger form: on one budgeted device
+    the registry admits ≥2x the quantized tenants vs f32, the refusal
+    is typed + forensically recorded, and warmup() reconciliation
+    still runs on the quantized entry."""
+    from incubator_mxnet_tpu.serving import (ModelRegistry,
+                                             AdmissionDenied,
+                                             project_footprint)
+    rs = onp.random.RandomState(7)
+    calib = [nd.array(rs.randn(4, 32).astype(onp.float32))]
+
+    def build(seed):
+        return _mlp(seed=seed, in_units=32, hidden=256, classes=10)
+
+    fp32, _ = project_footprint(build(0), (1, 2, 4), (32,), "float32")
+    budget = int(2.2 * fp32)
+
+    reg = ModelRegistry(devices=[mx.cpu()], hbm_budget=budget)
+    n_f32 = 0
+    with pytest.raises(AdmissionDenied) as ei:
+        while n_f32 < 8:
+            reg.register("f%d" % n_f32, build(n_f32),
+                         example_shape=(32,), wire_dtype="float32",
+                         max_batch=4)
+            n_f32 += 1
+    assert "does not fit" in str(ei.value) and "free=" in str(ei.value)
+    reg.close()
+
+    reg = ModelRegistry(devices=[mx.cpu()], hbm_budget=budget)
+    n_i8 = 0
+    rec = None
+    try:
+        while n_i8 < 16:
+            rec = reg.register_quantized(
+                "q%d" % n_i8, build(100 + n_i8), calib,
+                example_shape=(32,), wire_dtype="float32", max_batch=4)
+            n_i8 += 1
+    except AdmissionDenied:
+        pass
+    assert n_f32 == 2 and n_i8 >= 2 * n_f32, (n_f32, n_i8)
+    assert rec["quantized"] and rec["detail"]["quantized_layers"] == 2
+    # ledger holds the int8 footprints
+    stats = reg.stats()
+    assert stats["models"]["q0"]["footprint_bytes"] < fp32 / 2
+    # warmup()→reconcile() runs on a quantized entry without error
+    reg.warmup("q0", example_shape=(32,), wire_dtype="float32")
+    assert stats["models"]["q0"]["basis"] in ("projected", "measured")
+    # the f32 refusal left a flight-recorder event naming the model
+    names = [(e.get("kind"), e.get("name"), e.get("model"))
+             for e in bb.ring_snapshot()]
+    assert ("serve", "admission_rejected", "f2") in names
+    reg.close()
+
+
+# ---------------------------------------------------------------------------
+# AMP
+# ---------------------------------------------------------------------------
+
+def _mesh1():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:1]), ("data",))
+
+
+def _amp_data(rs, n=32, d=32, classes=10):
+    return (rs.randn(n, d).astype(onp.float32),
+            rs.randint(0, classes, n).astype(onp.int32))
+
+
+def test_amp_bf16_sharded_step_loss_trajectory():
+    """bf16 AMP step (f32 master weights) tracks the f32 trajectory
+    within bf16 tolerance — and the bf16 compute really is in the
+    executable (the labeled AMP step-wall ring fills)."""
+    from incubator_mxnet_tpu.parallel.trainer import ShardedTrainer
+    rs = onp.random.RandomState(8)
+    x, y = _amp_data(rs)
+    zeros = onp.zeros(2, onp.uint32)
+    t32 = ShardedTrainer(_mlp(seed=21, in_units=32, hidden=64,
+                              classes=10), optimizer="sgd", lr=0.1,
+                         mesh=_mesh1())
+    l32 = [float(t32.step(x, y, rng_bits=zeros)) for _ in range(6)]
+    tamp = ShardedTrainer(_mlp(seed=21, in_units=32, hidden=64,
+                               classes=10), optimizer="sgd", lr=0.1,
+                          mesh=_mesh1(), amp="bf16")
+    assert tamp.amp == "bfloat16"
+    lamp = [float(tamp.step(x, y, rng_bits=zeros)) for _ in range(6)]
+    assert all(onp.isfinite(lamp))
+    # master weights stay f32
+    assert all(str(v.dtype) == "float32"
+               for v in tamp.params.values())
+    for a, b in zip(lamp, l32):
+        assert abs(a - b) / abs(b) < 0.05, (lamp, l32)
+    rows = events.labeled_snapshot().get("train.step_us.n", [])
+    assert any(r["labels"].get("amp") == "bfloat16" for r in rows)
+
+
+def test_amp_bf16_zero2_compatible():
+    """The cast policy lands inside the ZeRO-2 shard_map step too —
+    'ZeRO-compatible' is a traced-executable property, not a wiring
+    one."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    from incubator_mxnet_tpu.parallel.trainer import ShardedTrainer
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    mesh = Mesh(np.array(devs[:2]), ("data",))
+    rs = onp.random.RandomState(9)
+    x, y = _amp_data(rs, n=32)
+    zeros = onp.zeros(2, onp.uint32)
+    t = ShardedTrainer(_mlp(seed=31, in_units=32, hidden=64,
+                            classes=10), optimizer="sgd", lr=0.1,
+                       mesh=mesh, zero=2, amp="bfloat16")
+    losses = [float(t.step(x, y, rng_bits=zeros)) for _ in range(3)]
+    assert all(onp.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_amp_f16_loss_scaler_nan_guard_handoff():
+    """float16 parity path: an overflowing loss scale trips the
+    in-executable NaN-guard (step SKIPPED — params untouched), the
+    scaler backs off, and once the scale is representable training
+    proceeds.  The handoff is visible on every surface: skip counters,
+    amp.loss_scale_backoff, and amp/loss_scale ring events."""
+    from incubator_mxnet_tpu.parallel.trainer import ShardedTrainer
+    from incubator_mxnet_tpu.parallel.resilience import ResilientTrainer
+    from incubator_mxnet_tpu.contrib.amp.loss_scaler import LossScaler
+    rs = onp.random.RandomState(10)
+    x, y = _amp_data(rs)
+    tr = ShardedTrainer(_mlp(seed=41, in_units=32, hidden=64,
+                             classes=10), optimizer="sgd", lr=0.1,
+                        mesh=_mesh1())
+    res = ResilientTrainer(
+        tr, ckpt_dir=None, amp="float16", handle_sigterm=False,
+        loss_scaler=LossScaler(init_scale=2.0 ** 120,
+                               scale_factor=2.0 ** 40,
+                               scale_window=100))
+    assert res.amp == "float16"
+    b0 = events.get("amp.loss_scale_backoff")
+    s0 = events.get("resilience.step_skipped")
+    oks = []
+    for _ in range(6):
+        loss, ok = res.step(x, y)
+        oks.append(ok)
+    # 2^120 * O(1) grads overflow f32 → guard skips, scale halves by
+    # 2^40 per bad step: 3 skips land it at 1.0, then steps commit
+    assert oks[:3] == [False, False, False] and oks[3] is True, oks
+    assert res.scaler.loss_scale == 1.0
+    assert onp.isfinite(loss)
+    assert events.get("amp.loss_scale_backoff") - b0 >= 3
+    assert events.get("resilience.step_skipped") - s0 >= 3
+    kinds = [(e.get("kind"), e.get("name")) for e in bb.ring_snapshot()]
+    assert ("amp", "loss_scale") in kinds
+
+
+def test_amp_f16_default_scaler_armed():
+    """ResilientTrainer(amp='float16') with no explicit scaler arms the
+    dynamic default (2^16); bf16 arms a unit scale."""
+    from incubator_mxnet_tpu.parallel.trainer import ShardedTrainer
+    from incubator_mxnet_tpu.parallel.resilience import ResilientTrainer
+    tr = ShardedTrainer(_mlp(seed=51), optimizer="sgd", mesh=_mesh1())
+    res = ResilientTrainer(tr, ckpt_dir=None, amp="fp16",
+                           handle_sigterm=False)
+    assert res.scaler.loss_scale == 2.0 ** 16
+    amp.turn_off()
+    tr2 = ShardedTrainer(_mlp(seed=52), optimizer="sgd", mesh=_mesh1())
+    res2 = ResilientTrainer(tr2, ckpt_dir=None, amp="bfloat16",
+                            handle_sigterm=False)
+    assert res2.scaler.loss_scale == 1.0
+
+
+def test_loss_scaler_transition_events():
+    from incubator_mxnet_tpu.contrib.amp.loss_scaler import LossScaler
+    b0 = events.get("amp.loss_scale_backoff")
+    g0 = events.get("amp.loss_scale_growth")
+    sc = LossScaler(init_scale=1024.0, scale_factor=2.0, scale_window=2)
+    sc.update(overflow=True)
+    assert events.get("amp.loss_scale_backoff") == b0 + 1
+    sc.update(False)
+    sc.update(False)
+    assert events.get("amp.loss_scale_growth") == g0 + 1
+    # scale pinned at the 1.0 floor: no transition, no event
+    sc2 = LossScaler(init_scale=1.0)
+    b1 = events.get("amp.loss_scale_backoff")
+    sc2.update(overflow=True)
+    assert events.get("amp.loss_scale_backoff") == b1
+
+
+def test_quantize_for_serving_idempotent():
+    """quantize_for_serving(...) then register_quantized(...) on the
+    same block is the natural call sequence — the second pass reports
+    the existing quantized state instead of dying on 'no quantizable
+    layers found'."""
+    from incubator_mxnet_tpu.serving import quantize_for_serving
+    net = _mlp(seed=61)
+    rs = onp.random.RandomState(12)
+    calib = [nd.array(rs.randn(4, 16).astype(onp.float32))]
+    _, r1 = quantize_for_serving(net, calib)
+    _, r2 = quantize_for_serving(net, calib)
+    assert r2["already_quantized"] and \
+        r2["quantized_layers"] == r1["quantized_layers"]
+    assert r2["weight_bytes_total_after"] == \
+        r1["weight_bytes_total_after"]
+
+
+@pytest.mark.slow
+def test_check_quant_gate():
+    """The CI gate runs green end-to-end (SKIP counts: single-core
+    hosts and emulating backends are designed rc-0 outcomes; a
+    broken accuracy bound or a steady-state recompile would rc 1)."""
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "check_quant.py"),
+         "--trials", "2", "--capacity-s", "1.0"],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_amp_dtype_normalization():
+    assert amp.normalize_dtype(None) is None
+    assert amp.normalize_dtype("") is None
+    assert amp.normalize_dtype("off") is None
+    assert amp.normalize_dtype("float32") is None
+    assert amp.normalize_dtype("bf16") == "bfloat16"
+    assert amp.normalize_dtype("BFloat16") == "bfloat16"
+    assert amp.normalize_dtype("fp16") == "float16"
+    with pytest.raises(ValueError):
+        amp.normalize_dtype("int8")
