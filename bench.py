@@ -1959,6 +1959,337 @@ def _cfg_integrity():
     return parsed
 
 
+_CTL_CHILD_MARK = "_BENCH_CTL_CHILD"
+
+
+def run_controlplane(n_devices=4, duration_s=14.0, capacity_s=2.0,
+                     seed=0):
+    """Control-plane chaos scenario (ISSUE 16 acceptance): ONE run in
+    which the load doubles mid-run AND a bad model version ships —
+    and the fleet recovers BOTH without an operator.  A
+    FleetSupervisor watches the live SLO surface; the bad canary
+    (model.bad_version: stalls + sign-flips) must be rolled back
+    automatically with the breaching rule named in a proactive
+    blackbox dump, and the load spike (serve.load_spike doubles the
+    open-loop Poisson rate) must drive a ledger-admitted scale-up
+    that brings the hi lane back inside its deadline.
+    Self-bootstrapping child on an n-device virtual CPU host
+    (run_integrity's recipe)."""
+    if os.environ.get(_CTL_CHILD_MARK) != "1":
+        import re
+        import subprocess
+        env = dict(os.environ)
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       "", env.get("XLA_FLAGS", ""))
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d"
+            % n_devices).strip()
+        env["JAX_PLATFORMS"] = "cpu"
+        env[_CTL_CHILD_MARK] = "1"
+        env.setdefault("MXNET_BLACKBOX_DIR", "/tmp")
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--controlplane-child", str(n_devices),
+               str(duration_s), str(capacity_s), str(seed)]
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=420, env=env,
+                             cwd=os.path.dirname(os.path.abspath(__file__)))
+        for line in reversed((res.stdout or "").strip().splitlines()
+                             or [""]):
+            if line.startswith("{"):
+                return json.loads(line)
+        tail = (res.stderr or res.stdout or "").strip().splitlines()
+        raise RuntimeError("controlplane child failed (rc=%d): %s"
+                           % (res.returncode,
+                              tail[-1] if tail else "no output"))
+    return _controlplane_scenario(n_devices, duration_s, capacity_s,
+                                  seed)
+
+
+def build_controlplane_model(seed=0, in_dim=32):
+    """Small Dense net + priming forward — shared by
+    `bench.py controlplane` and tools/check_controlplane.py so the CI
+    gate and the bench exercise the same workload."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"), nn.Dense(8))
+    net.initialize(ctx=mx.cpu())
+    rs = np.random.RandomState(seed)
+    net(nd.array(rs.randn(2, in_dim).astype(np.float32)))
+    return net
+
+
+def controlplane_trial(n_devices=4, duration_s=14.0, capacity_s=2.0,
+                       seed=0, stall_s=0.04):
+    """The supervised-fleet chaos timeline — shared by the bench
+    scenario and tools/check_controlplane.py (same contract
+    discipline as measure_serve_capacity):
+
+      t=0      v1 serving (1 replica); every batch stalls `stall_s`
+               (fault: serve.slow) so the service time is
+               SLEEP-DOMINATED — capacity is ~batch/stall per
+               replica and scale-out genuinely multiplies it even on
+               a 1-core virtual-device host.  Open-loop Poisson at
+               0.7x measured capacity across hi/lo lanes
+      t=1.0s   a BAD v2 ships through the supervisor
+               (fault: model.bad_version) -> its version-labeled
+               rules must fire -> automatic rollback + blackbox dump
+      t=4.5s   the load DOUBLES (fault: serve.load_spike) -> the lo
+               lane's shed burn fires -> supervisor scales the
+               replica set up through the ledger
+      end      hi-lane outcomes submitted after the scale-up settles
+               must be back inside the deadline
+
+    Verdict `controlplane_ok`: True / False / None (None = the open
+    loop never actually overloaded the engine — a starved submitter
+    can't prove the scale leg either way)."""
+    import threading
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import config as _icfg
+    from incubator_mxnet_tpu import fault
+    from incubator_mxnet_tpu.monitor import events
+    from incubator_mxnet_tpu.serving import (
+        FleetSupervisor, ModelRegistry, Shed, QueueFull,
+        DeadlineExceeded, EngineClosed, CircuitOpen)
+    from incubator_mxnet_tpu.telemetry import slo as _slo
+
+    flow_errors = (Shed, QueueFull, DeadlineExceeded, EngineClosed,
+                   CircuitOpen)
+    rs = np.random.RandomState(seed)
+    in_dim = 32
+    data = rs.rand(256, in_dim).astype(np.float32)
+    pool = [mx.cpu(i) for i in range(n_devices)]
+
+    reg = ModelRegistry(devices=pool)
+    reg.register("m", build_controlplane_model(seed, in_dim),
+                 replicas=1, version="v1", example_shape=(in_dim,),
+                 max_batch=8, queue_cap=64,
+                 lanes=("cap", "hi", "lo"),
+                 lane_quotas=(1.0, 1.0, 0.75))
+    reg.warmup("m")
+    eng = reg.engine("m")
+    # pin the service time: every batch (v1, canary, and any replica
+    # the supervisor adds) takes >= stall_s, so measured capacity is
+    # ~max_batch/stall per replica and a second replica really does
+    # double it
+    fault.install("serve.slow", at_calls=[1], times=10 ** 9,
+                  seconds=stall_s)
+    capacity = measure_serve_capacity(eng, data, capacity_s)
+    hi_dl = overload_deadline_s(8, capacity)
+    lo_dl = 2.0 * hi_dl
+    reg.install_slo_rules(targets={"hi": hi_dl, "lo": lo_dl},
+                          fast_s=1.0, slow_s=2.5)
+    # the bad version's taint: stall well past the hi deadline so the
+    # canary's OWN labeled rules (shed burn / p99) must catch it
+    _icfg.set("MXNET_CTL_DEGRADE_S", 2.0 * hi_dl)
+
+    sup = FleetSupervisor(
+        reg, "m", lanes=("hi", "lo"), min_replicas=1,
+        max_replicas=n_devices, tick_s=0.25, up_rounds=2,
+        down_rounds=200, cooldown_s=2.0, observe_rounds=2,
+        canary_fraction=0.3, fast_s=1.0, slow_s=2.5)
+    sup.start()
+
+    results, rlock = [], threading.Lock()
+    deploy_err = [None]
+
+    def _deploy():
+        fault.install("model.bad_version")
+        try:
+            sup.deploy(build_controlplane_model(seed + 1, in_dim),
+                       "v2")
+        except Exception as e:      # noqa: BLE001 — reported in the
+            deploy_err[0] = str(e)[:200]    # verdict, not fatal
+
+    def _track(lane, t_sub, fut):
+        def cb(f):
+            t = time.perf_counter()
+            try:
+                f.result()
+                ok = True
+            except flow_errors:
+                ok = False
+            with rlock:
+                results.append((lane, t_sub, t, ok))
+        fut.add_done_callback(cb)
+
+    rate0 = 0.7 * capacity
+    rate = rate0
+    hi_frac = 0.35
+    t0 = time.perf_counter()
+    next_t, n_offered = t0, 0
+    deployed = spike_armed = spiked = False
+    t_spike = t_scale = None
+    n_spike_offered = 0
+    while True:
+        now = time.perf_counter()
+        if now >= t0 + duration_s:
+            break
+        if not deployed and now - t0 >= 1.0:
+            deployed = True
+            threading.Thread(target=_deploy, daemon=True).start()
+        if not spike_armed and now - t0 >= 4.5:
+            spike_armed = True
+            fault.install("serve.load_spike")
+        if spike_armed and not spiked \
+                and fault.should_fire("serve.load_spike"):
+            spiked, t_spike, rate = True, now, 2.0 * rate0
+        if t_scale is None \
+                and events.get("controlplane.scale_ups") >= 1:
+            t_scale = now
+        if now < next_t:
+            time.sleep(min(next_t - now, 0.002))
+            continue
+        next_t += rs.exponential(1.0 / rate)
+        lane = "hi" if rs.rand() < hi_frac else "lo"
+        dl = hi_dl if lane == "hi" else lo_dl
+        n_offered += 1
+        if spiked:
+            n_spike_offered += 1
+        try:
+            _track(lane, now, reg.submit(
+                "m", data[n_offered % 256], deadline=dl, lane=lane,
+                tenant="t%d" % (n_offered % 4)))
+        except flow_errors:
+            with rlock:
+                results.append((lane, now, now, False))
+    wall = time.perf_counter() - t0
+    # drain: every pending future resolves through its callback
+    reg.drain_all(timeout=60.0)
+    time.sleep(0.2)
+    if t_scale is None and events.get("controlplane.scale_ups") >= 1:
+        t_scale = time.perf_counter()       # landed during drain
+    sup.stop()
+    status = sup.status()
+    last_rb = sup.last_rollback
+
+    with rlock:
+        rows = list(results)
+    achieved_spike = (n_spike_offered / max(1e-6, wall -
+                      (t_spike - t0))) if t_spike is not None else 0.0
+    overloaded = bool(t_spike is not None
+                      and achieved_spike >= 1.15 * capacity)
+    # post-scale hi outcomes, after a settle window; a SHED request
+    # counts as +inf latency — "p99 recovered" must not be satisfied
+    # by shedding the lane
+    post = sorted((t_done - t_sub) if ok else float("inf")
+                  for lane, t_sub, t_done, ok in rows
+                  if lane == "hi" and t_scale is not None
+                  and t_sub >= t_scale + 0.5)
+    hi_p99_post = post[min(len(post) - 1,
+                           int(0.99 * len(post)))] if post else None
+
+    rollbacks = events.get("controlplane.rollbacks")
+    scale_ups = events.get("controlplane.scale_ups")
+    bb = (last_rb or {}).get("blackbox")
+    out = {
+        "controlplane_devices": n_devices,
+        "controlplane_capacity_ips": round(capacity, 1),
+        "controlplane_hi_deadline_ms": round(hi_dl * 1e3, 1),
+        "controlplane_duration_s": round(wall, 2),
+        "controlplane_offered": n_offered,
+        "controlplane_spike_achieved_ips": round(achieved_spike, 1),
+        "controlplane_overloaded": overloaded,
+        "controlplane_deploys": events.get("controlplane.deploys"),
+        "controlplane_deploy_error": deploy_err[0],
+        "controlplane_rollbacks": rollbacks,
+        "controlplane_rollback_rule": (last_rb or {}).get("rule"),
+        "controlplane_rollback_version":
+            (last_rb or {}).get("version"),
+        "controlplane_rollback_blackbox":
+            os.path.basename(bb) if bb else None,
+        "controlplane_scale_ups": scale_ups,
+        "controlplane_scale_denied":
+            events.get("controlplane.scale_denied"),
+        "controlplane_replicas_final": status["replicas"],
+        "controlplane_hi_post_scale_n": len(post),
+        "controlplane_hi_p99_post_scale_ms":
+            (round(hi_p99_post * 1e3, 1)
+             if hi_p99_post not in (None, float("inf"))
+             else (None if hi_p99_post is None else "inf")),
+    }
+    canary_ok = bool(
+        rollbacks >= 1 and out["controlplane_rollback_rule"]
+        and out["controlplane_rollback_version"] == "v2"
+        and bb and os.path.exists(bb))
+    scale_judgeable = overloaded and len(post) >= 20
+    scale_ok = bool(
+        scale_judgeable and scale_ups >= 1
+        and hi_p99_post is not None and hi_p99_post <= hi_dl)
+    if canary_ok and scale_ok:
+        out["controlplane_ok"] = True
+    elif canary_ok and not scale_judgeable:
+        out["controlplane_ok"] = None       # starved open loop: the
+                                            # scale leg is unjudged
+    else:
+        out["controlplane_ok"] = False
+    # teardown in dependency order; config/fault/rules must not leak
+    # into the next trial (the gate runs best-of-3 in one process)
+    sup.close()
+    fault.clear()
+    _slo.clear_rules()
+    reg.close()
+    _icfg.unset("MXNET_CTL_DEGRADE_S")
+    return out
+
+
+def _controlplane_scenario(n_devices, duration_s, capacity_s, seed):
+    """Child-side body of run_controlplane."""
+    import jax
+    jax.config.update("jax_enable_compilation_cache", False)
+    out = controlplane_trial(n_devices, duration_s, capacity_s, seed)
+    print(json.dumps(out))
+    return out
+
+
+def _write_bench_controlplane(parsed, rc=0):
+    """BENCH_controlplane.json: the chaos scenario's proof artifact —
+    ok only when the fleet recovered BOTH injected incidents on its
+    own (bad version rolled back with the breaching rule named +
+    blackbox dumped, load spike absorbed by a ledger-admitted
+    scale-up with the hi lane back inside its deadline)."""
+    ok = parsed.get("controlplane_ok")
+    if ok is True:
+        tail = ("controlplane ok: v2 rolled back by rule %s "
+                "(blackbox=%s), load spike absorbed by scale-up to "
+                "%s replicas (hi p99 post-scale %sms <= %sms), zero "
+                "operator steps\n"
+                % (parsed.get("controlplane_rollback_rule"),
+                   parsed.get("controlplane_rollback_blackbox"),
+                   parsed.get("controlplane_replicas_final"),
+                   parsed.get("controlplane_hi_p99_post_scale_ms"),
+                   parsed.get("controlplane_hi_deadline_ms")))
+    elif ok is None:
+        tail = ("controlplane INCONCLUSIVE: canary leg green but the "
+                "open loop never overloaded the engine (achieved %s "
+                "ips vs capacity %s) — scale leg unjudged\n"
+                % (parsed.get("controlplane_spike_achieved_ips"),
+                   parsed.get("controlplane_capacity_ips")))
+    else:
+        tail = ("controlplane FAILED: rc=%d — parsed has the per-leg "
+                "evidence (rollback rule/blackbox, scale-ups, "
+                "post-scale p99)\n" % rc)
+    blob = {"n_devices": parsed.get("controlplane_devices", 0),
+            "rc": rc, "ok": ok is True, "skipped": ok is None,
+            "tail": tail, "parsed": parsed}
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "BENCH_controlplane.json"),
+              "w") as fh:
+        json.dump(blob, fh, indent=2)
+
+
+def _cfg_controlplane():
+    parsed = run_controlplane()
+    try:
+        _write_bench_controlplane(
+            parsed, rc=0 if parsed.get("controlplane_ok")
+            is not False else 1)            # proof artifact rides
+    except Exception:
+        pass
+    return parsed
+
+
 def run_int8_infer(batch=64, warmup=3, iters=20):
     """Optional extra: post-training-quantized (int8, naive calib)
     ResNet-50 inference, images/sec — the deploy-side MXU int8 story
@@ -2588,6 +2919,7 @@ _CONFIGS = {
     "generate": lambda b=None: _cfg_generate(),
     "elastic": lambda b=None: _cfg_elastic(),
     "integrity": lambda b=None: _cfg_integrity(),
+    "controlplane": lambda b=None: _cfg_controlplane(),
     "multichip": lambda b=None: _cfg_multichip(),
 }
 
@@ -2873,6 +3205,28 @@ if __name__ == "__main__":
     if len(sys.argv) >= 2 and sys.argv[1] == "--integrity-child":
         _n, _s, _spe = (int(a) for a in sys.argv[2:5])
         _integrity_scenario(_n, _s, _spe)
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "controlplane":
+        # standalone control-plane chaos scenario (ISSUE 16): ONE
+        # JSON line + BENCH_controlplane.json; rc 1 only when the
+        # scenario RAN (overloaded) and the fleet failed to recover
+        # an injected incident on its own
+        try:
+            parsed = run_controlplane()
+            rc = 0 if parsed.get("controlplane_ok") is not False \
+                else 1
+        except Exception as e:
+            parsed, rc = {"controlplane_error": str(e)[:160]}, 1
+        try:
+            _write_bench_controlplane(parsed, rc=rc)
+        except Exception:
+            pass
+        print(json.dumps(parsed))
+        sys.exit(rc)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--controlplane-child":
+        _n = int(sys.argv[2])
+        _d, _c = float(sys.argv[3]), float(sys.argv[4])
+        _controlplane_scenario(_n, _d, _c, int(sys.argv[5]))
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "serve_overload":
         # standalone overload scenario (ISSUE 8): ONE JSON line; rc 1

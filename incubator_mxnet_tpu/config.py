@@ -575,6 +575,60 @@ register("MXNET_SLO_SHED_BUDGET", float, 0.02,
          "burn-rate rule; lower lanes are designed to shed under "
          "overload and budget max(this, 1 - lane quota) following "
          "the MXNET_SERVE_LANE_QUOTAS ladder")
+register("MXNET_CTL_TICK_S", float, 1.0,
+         "FleetSupervisor loop cadence in seconds (serving/"
+         "controlplane.py): how often the background supervisor "
+         "thread evaluates the SLO surface and acts (scale, ramp, "
+         "rollback).  Manual `tick()` callers ignore this")
+register("MXNET_CTL_UP_ROUNDS", int, 2,
+         "Scale-up hysteresis: consecutive supervisor ticks with a "
+         "firing shed-burn rule on a watched lane before the replica "
+         "set grows by one.  Higher = slower to react, harder to flap")
+register("MXNET_CTL_DOWN_ROUNDS", int, 6,
+         "Scale-down hysteresis: consecutive QUIET ticks (no watched "
+         "alert firing) before the replica set shrinks by one toward "
+         "min_replicas.  HBM ledger pressure (any pool device past "
+         "MXNET_CTL_HBM_PRESSURE committed) halves the requirement — "
+         "idle capacity on a nearly-full ledger is the first thing "
+         "to give back")
+register("MXNET_CTL_COOLDOWN_S", float, 10.0,
+         "Minimum seconds between supervisor scale transitions (and "
+         "between emergency rebuilds): with the round hysteresis "
+         "above this bounds the loop at <= 1 transition per direction "
+         "per window, the no-flapping contract")
+register("MXNET_CTL_HBM_PRESSURE", float, 0.9,
+         "Committed/budget fraction past which a pool device counts "
+         "as HBM-pressured for the supervisor's scale-down decision "
+         "(unbudgeted devices never register pressure)")
+register("MXNET_CTL_CANARY_FRACTION", float, 0.1,
+         "Initial traffic fraction mirrored to a freshly-admitted "
+         "canary version (ModelRegistry.register_version / "
+         "FleetSupervisor.deploy); the supervisor ramps it from here")
+register("MXNET_CTL_CANARY_STEP", float, 0.2,
+         "Canary ramp increment: fraction added each time every SLO "
+         "rule for the model stays quiet for a full observation "
+         "window (MXNET_CTL_OBSERVE_ROUNDS ticks)")
+register("MXNET_CTL_CANARY_MAX", float, 0.5,
+         "Canary traffic ceiling: the ramp stops here, and one more "
+         "fully-quiet observation window at the ceiling PROMOTES the "
+         "version (refresh_params weight-swap onto the primary)")
+register("MXNET_CTL_OBSERVE_ROUNDS", int, 3,
+         "Canary observation window in supervisor ticks: the ramp "
+         "advances (or promotes, at the ceiling) only after this many "
+         "consecutive ticks with every rule for the model quiet; any "
+         "firing model rule restarts the window")
+register("MXNET_CTL_DEGRADE_S", float, 0.05,
+         "Deterministic per-batch stall applied to an engine tainted "
+         "by the model.bad_version fault site (outputs are also "
+         "sign-flipped) — the knob the chaos scenarios size so the "
+         "canary's labeled p99 provably breaches its rule")
+register("MXNET_SERVE_BUILD_TIMEOUT_S", float, 120.0,
+         "Bounded engine-build timeout for ModelRegistry.register / "
+         "register_version / resize: a build (param replication + "
+         "functionalization) that wedges past this raises the typed "
+         "RegistrationTimeout, rolls the ledger hold back and leaves "
+         "a flight-recorder event instead of holding the deploy path "
+         "hostage.  0 disables the bound")
 register("MXNET_GATE_REPORT_DIR", str, "",
          "Directory the CI gates (check_overhead/check_feed/"
          "check_serve/check_scaling) write per-run JSON artifacts to "

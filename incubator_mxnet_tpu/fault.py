@@ -27,6 +27,12 @@ Sites are plain strings; the built-in ones:
                         `seconds` the dispatch also stalls first, which
                         is how queue-full / deadline-expiry tests hold
                         the dispatcher busy deterministically
+    serve.slow          InferenceEngine dispatch: the batch STALLS
+                        `seconds` but still succeeds — benign latency
+                        chaos; armed for every batch it pins the
+                        service time, so capacity scales with
+                        replicas even on a 1-core virtual-device host
+                        (the controlplane bench's service model)
     mesh.replica_down   ElasticTrainer heartbeat layer: the victim
                         replica (highest active id) STOPS posting
                         kvstore heartbeats from this step on — the
@@ -55,6 +61,24 @@ Sites are plain strings; the built-in ones:
                         leaf — detection, blame and the rollback/
                         eviction response all run the production
                         comparison path
+    serve.build         ModelRegistry engine construction (register /
+                        register_version / resize): the build stalls
+                        `seconds` before constructing — how the
+                        bounded-build-timeout (RegistrationTimeout)
+                        path is exercised without a real hung compile
+    serve.load_spike    open-loop load generators (bench.py
+                        controlplane scenario, check_controlplane
+                        gate): from the firing on, the offered Poisson
+                        arrival rate DOUBLES — the deterministic
+                        trigger for the FleetSupervisor's scale-up
+                        path
+    model.bad_version   ModelRegistry.register_version: the version
+                        admitted while armed is TAINTED — its engine
+                        stalls every batch by MXNET_CTL_DEGRADE_S and
+                        sign-flips its outputs (deterministic
+                        degradation), so the canary's labeled SLO
+                        rules provably fire and the supervisor's
+                        automatic rollback path runs end to end
 
 Faults install programmatically::
 

@@ -32,7 +32,9 @@ decision table and the counter reference.
 from .engine import (InferenceEngine, QueueFull, DeadlineExceeded,
                      EngineClosed, Shed, serve_counters)
 from .registry import (ModelRegistry, AdmissionDenied, CircuitOpen,
-                       UnknownModel, project_footprint)
+                       UnknownModel, RegistrationTimeout,
+                       project_footprint)
+from .controlplane import FleetSupervisor
 from .generation import (GenerationEngine, GenerationStream,
                          project_generation_footprint)
 from .quantize import quantize_for_serving, param_bytes_by_dtype
@@ -40,7 +42,8 @@ from .quantize import quantize_for_serving, param_bytes_by_dtype
 __all__ = ["InferenceEngine", "QueueFull", "DeadlineExceeded",
            "EngineClosed", "Shed", "serve_counters",
            "ModelRegistry", "AdmissionDenied", "CircuitOpen",
-           "UnknownModel", "project_footprint",
+           "UnknownModel", "RegistrationTimeout",
+           "project_footprint", "FleetSupervisor",
            "GenerationEngine", "GenerationStream",
            "project_generation_footprint",
            "quantize_for_serving", "param_bytes_by_dtype"]

@@ -1,0 +1,585 @@
+"""SLO-driven fleet control plane (ISSUE 16 tentpole).
+
+Everything below this module can already observe and actuate: PR 12's
+burn-rate rules judge the live SLO surface, PR 8's registry bin-packs
+admissions against the HBM ledger, PR 7's engine routes around sick
+replicas, and ``refresh_params`` swaps weights in place.  What no
+in-tree component does is CLOSE THE LOOP — a human still reads the
+alert and runs the resize or the rollback.  At fleet scale that human
+is the outage.  `FleetSupervisor` is the missing controller: each tick
+it evaluates the rules, reads the active-alert surface, the registry
+ledger and the replica health, and acts —
+
+**Autoscaling.**  `MXNET_CTL_UP_ROUNDS` consecutive ticks with a
+firing shed-burn rule on a watched lane grow the model's replica set
+by one (``ModelRegistry.resize`` — make-before-break admission through
+the same ledger every deploy answers to).  `MXNET_CTL_DOWN_ROUNDS`
+consecutive QUIET ticks shrink it back toward ``min_replicas``; HBM
+pressure on the ledger (any pool device past ``MXNET_CTL_HBM_PRESSURE``
+committed) halves the quiet requirement — idle capacity on a full
+ledger is the first thing to give back.  Round hysteresis plus
+`MXNET_CTL_COOLDOWN_S` between transitions bound the loop at <= 1
+transition per direction per window: it never flaps.
+
+**Rolling deploys.**  `deploy(block, version)` admits the version
+alongside the primary (``ModelRegistry.register_version`` — own
+ledger hold, own breaker, own version-labeled telemetry) and mirrors
+`MXNET_CTL_CANARY_FRACTION` of traffic to it.  The fraction ramps by
+`MXNET_CTL_CANARY_STEP` only after every rule for the model stays
+quiet for a full observation window (`MXNET_CTL_OBSERVE_ROUNDS`
+ticks); at `MXNET_CTL_CANARY_MAX` one more quiet window PROMOTES —
+the primary swaps to the version's weights in place
+(`refresh_params_from`) and the canary entry retires.
+
+**Automatic rollback.**  Any firing rule ATTRIBUTABLE to the canary —
+one of the version-labeled rules this supervisor installed at deploy,
+or any rule whose labels carry the canary's version — triggers the
+instant revert: traffic mirroring stops, the canary deregisters (its
+ledger hold releases exactly once), and a proactive blackbox dump
+lands with reason ``controlplane:rollback:<model>@<version>`` and a
+ring event naming the breaching rule.  No operator in the loop.
+
+The supervisor's own actions are typed ``controlplane.*`` counters,
+ring events and durable history rows, and it installs watchdog rules
+over itself (`telemetry.slo.default_controlplane_rules`): rollback
+storms and scale oscillation page a human — the controller heals
+incidents, humans heal the controller.
+
+Typical lifecycle::
+
+    reg = serving.ModelRegistry(devices=pool)
+    reg.register("ranker", net, replicas=1, example_shape=(256,))
+    reg.warmup("ranker")
+    reg.install_slo_rules()
+    sup = FleetSupervisor(reg, "ranker", max_replicas=len(pool))
+    sup.start()                       # background tick loop
+    ...
+    sup.deploy(net_v2, "v2")          # canary → ramp → promote
+    ...                               # (or rollback, automatically)
+    sup.close()
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+
+from .. import config as _cfg
+from ..monitor import events
+from ..telemetry import flightrec as _bb
+from ..telemetry import slo as _slo
+from .registry import AdmissionDenied, UnknownModel
+
+__all__ = ["FleetSupervisor", "status_block"]
+
+#: live supervisors, for the /metrics.json + blackbox "controlplane"
+#: block (weak: a supervisor must die with its owner, not be pinned
+#: by introspection)
+_SUPERVISORS = weakref.WeakSet()
+
+
+def _hist_record(action, model, value=1.0, **fields):
+    try:
+        from ..telemetry import history as _hist
+        _hist.record("controlplane", action, float(value),
+                     labels={"model": str(model)}, **fields)
+    except Exception:               # noqa: BLE001 — durability is
+        pass                        # best-effort, never control flow
+
+
+def status_block():
+    """The ``controlplane`` block for /metrics.json and blackbox
+    dumps: every live supervisor's status.  Empty list = no
+    supervisors (callers omit the block)."""
+    out = []
+    for sup in list(_SUPERVISORS):
+        try:
+            if not sup._closed:
+                out.append(sup.status())
+        except Exception:           # noqa: BLE001 — introspection
+            pass                    # must never break a scrape/dump
+    return out
+
+
+class FleetSupervisor:
+    """The rule→action controller for ONE registry model.
+
+    registry / model: the `ModelRegistry` and the model name whose
+        replica set and deploys this supervisor owns.
+    lanes: lanes whose ``serve-shed-<lane>`` burn rules count as
+        scale-up evidence (default: the model engine's lanes).
+    watch_rules: extra rule names treated as scale evidence AND model
+        noise (tests and bespoke deployments point the supervisor at
+        their own rules).
+    min_replicas / max_replicas: the scale envelope (max defaults to
+        the registry pool size).
+    evaluate: when True (default) each tick runs `slo.evaluate`
+        itself — the loop is self-contained; pass False when a
+        periodic exporter already evaluates at its own cadence.
+    install_rules: register the supervisor watchdog rules
+        (rollback-storm, scale-oscillation) at construction;
+        `close()` unregisters them.
+
+    Remaining knobs default from the ``MXNET_CTL_*`` config family
+    (see docs/controlplane.md for the table); constructor arguments
+    override.  `tick(now)` is manual and deterministic (tests drive
+    simulated time through it); `start()` runs it on a daemon thread
+    at ``tick_s`` cadence.
+    """
+
+    def __init__(self, registry, model, lanes=None, watch_rules=(),
+                 min_replicas=1, max_replicas=None, tick_s=None,
+                 up_rounds=None, down_rounds=None, cooldown_s=None,
+                 canary_fraction=None, canary_step=None,
+                 canary_max=None, observe_rounds=None,
+                 hbm_pressure=None, fast_s=None, slow_s=None,
+                 evaluate=True, install_rules=True):
+        self._reg = registry
+        self._model = str(model)
+        if lanes is None:
+            lanes = tuple(registry.engine(self._model)._lanes)
+        self._lanes = tuple(str(l) for l in lanes)
+        self._scale_rules = ({"serve-shed-%s" % l for l in self._lanes}
+                             | {str(r) for r in watch_rules})
+        self._noise_rules = (set(self._scale_rules)
+                             | {"serve-p99-%s" % l
+                                for l in self._lanes})
+        self._min = max(1, int(min_replicas))
+        self._max = int(max_replicas if max_replicas is not None
+                        else len(registry._ctxs))
+        self._tick_s = float(tick_s if tick_s is not None
+                             else _cfg.get("MXNET_CTL_TICK_S"))
+        self._up_rounds = int(up_rounds if up_rounds is not None
+                              else _cfg.get("MXNET_CTL_UP_ROUNDS"))
+        self._down_rounds = int(
+            down_rounds if down_rounds is not None
+            else _cfg.get("MXNET_CTL_DOWN_ROUNDS"))
+        self._cooldown = float(
+            cooldown_s if cooldown_s is not None
+            else _cfg.get("MXNET_CTL_COOLDOWN_S"))
+        self._fraction0 = float(
+            canary_fraction if canary_fraction is not None
+            else _cfg.get("MXNET_CTL_CANARY_FRACTION"))
+        self._step = float(canary_step if canary_step is not None
+                           else _cfg.get("MXNET_CTL_CANARY_STEP"))
+        self._canary_max = float(
+            canary_max if canary_max is not None
+            else _cfg.get("MXNET_CTL_CANARY_MAX"))
+        self._observe = int(
+            observe_rounds if observe_rounds is not None
+            else _cfg.get("MXNET_CTL_OBSERVE_ROUNDS"))
+        self._pressure = float(
+            hbm_pressure if hbm_pressure is not None
+            else _cfg.get("MXNET_CTL_HBM_PRESSURE"))
+        self._fast_s, self._slow_s = fast_s, slow_s
+        self._evaluate = bool(evaluate)
+
+        self._lock = threading.RLock()
+        self._hot = 0               # consecutive ticks with scale
+        self._quiet = 0             # evidence / without any
+        self._cool_until = 0.0      # vs the tick's own `now`
+        self._canary = None         # {"version","rules","quiet",
+                                    #  "fraction"}
+        self.last_rollback = None   # most recent rollback record
+        self.last_scale = None      # most recent scale record
+        self._thread = None
+        self._stop = threading.Event()
+        self._closed = False
+        self._own_rules = (_slo.install_default_controlplane_rules(
+            fast_s=fast_s, slow_s=slow_s) if install_rules else [])
+        _SUPERVISORS.add(self)
+
+    # -- deploys -------------------------------------------------------
+    def deploy(self, block, version, fraction=None, **register_kw):
+        """Ship `version` as a canary: admit it alongside the primary,
+        install its version-labeled SLO rules, start mirroring
+        traffic.  From here the TICK LOOP owns it — ramp while quiet,
+        promote at the ceiling, roll back on any attributable alert.
+        Raises (AdmissionDenied / RegistrationTimeout / ValueError)
+        without supervisor state when the admit fails."""
+        version = str(version)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("supervisor is closed")
+            if self._canary is not None:
+                raise ValueError(
+                    "model %r already has version %r in flight"
+                    % (self._model, self._canary["version"]))
+        rec = self._reg.register_version(
+            self._model, block, version,
+            fraction=fraction if fraction is not None
+            else self._fraction0, **register_kw)
+        rules = self._install_canary_rules(version)
+        with self._lock:
+            self._canary = {"version": version, "rules": rules,
+                            "quiet": 0,
+                            "fraction": float(rec["fraction"])}
+        events.incr("controlplane.deploys")
+        events.incr("controlplane.deploys",
+                    labels={"model": self._model, "version": version})
+        _bb.record("controlplane", "deploy", model=self._model,
+                   version=version, fraction=rec["fraction"],
+                   rules=list(rules))
+        _hist_record("deploy", self._model, version=version,
+                     fraction=rec["fraction"])
+        return rec
+
+    def _install_canary_rules(self, version):
+        """The version-labeled judgement: a shed-burn rule over the
+        canary's own requests, and — when the model's lanes have
+        observed deadline targets — a p99 threshold on the canary's
+        labeled ring at the TIGHTEST lane target (the canary must be
+        good enough for the most demanding traffic it mirrors)."""
+        budget = max(float(_cfg.get("MXNET_SLO_SHED_BUDGET")), 0.05)
+        names = []
+        r = _slo.register_rule(_slo.BurnRateRule(
+            "ctl-canary-shed-%s-%s" % (self._model, version),
+            bad="serve.shed",
+            total=["serve.requests", "serve.shed"],
+            labels={"version": version}, budget=budget,
+            fast_s=self._fast_s, slow_s=self._slow_s,
+            description="canary %s@%s shed fraction burns its %.0f%% "
+                        "budget" % (self._model, version,
+                                    budget * 100)))
+        names.append(r.name)
+        try:
+            targets = self._reg.slo_targets()
+        except Exception:           # noqa: BLE001
+            targets = {}
+        if targets:
+            t = min(targets.values())
+            r = _slo.register_rule(_slo.ThresholdRule(
+                "ctl-canary-p99-%s-%s" % (self._model, version),
+                metric="serve.e2e_us", pct="p99",
+                labels={"version": version}, bound=float(t) * 1e6,
+                description="canary %s@%s e2e p99 within the model's "
+                            "tightest observed deadline (%.3fs)"
+                            % (self._model, version, float(t))))
+            names.append(r.name)
+        return names
+
+    def _uninstall_rules(self, names):
+        for n in names:
+            try:
+                _slo.unregister_rule(n)
+            except Exception:       # noqa: BLE001
+                pass
+
+    def rollback(self, rule=None, info=None):
+        """Instant canary revert: stop the mirror, deregister the
+        version (ledger hold released exactly once — registry-side
+        idempotency), drop its rules, and leave the forensic trail:
+        counters, ring event and a PROACTIVE blackbox dump whose
+        reason names the model@version and whose ring names the
+        breaching rule.  Idempotent; returns the rollback record or
+        None when no version was in flight."""
+        with self._lock:
+            can, self._canary = self._canary, None
+        if can is None:
+            return None
+        self._uninstall_rules(can["rules"])
+        self._reg.rollback_version(self._model, reason=rule)
+        events.incr("controlplane.rollbacks")
+        events.incr("controlplane.rollbacks",
+                    labels={"model": self._model,
+                            "version": can["version"]})
+        detail = {k: v for k, v in (info or {}).items()
+                  if isinstance(v, (int, float, str, bool))}
+        _bb.record("controlplane", "rollback", model=self._model,
+                   version=can["version"],
+                   rule=str(rule) if rule else None,
+                   fraction=can["fraction"], **detail)
+        _hist_record("rollback", self._model, version=can["version"],
+                     rule=str(rule) if rule else None)
+        # the proactive dump: the breaching rule + version are in the
+        # ring event above, the reason names the incident — blackbox's
+        # suspected-cause heuristics read both
+        _bb.crash_dump("controlplane:rollback:%s@%s"
+                       % (self._model, can["version"]))
+        rec = {"model": self._model, "version": can["version"],
+               "rule": str(rule) if rule else None,
+               "fraction": can["fraction"],
+               "blackbox": _bb.last_dump_path()}
+        self.last_rollback = rec
+        return rec
+
+    def promote(self):
+        """Promote the in-flight version (weight-swap onto the
+        primary; canary entry retires).  Normally the tick loop calls
+        this after a fully-quiet window at the fraction ceiling."""
+        with self._lock:
+            can = self._canary
+        if can is None:
+            raise ValueError("model %r has no version in flight"
+                             % self._model)
+        rec = self._reg.promote_version(self._model)
+        with self._lock:
+            self._canary = None
+        self._uninstall_rules(can["rules"])
+        events.incr("controlplane.promotes")
+        events.incr("controlplane.promotes",
+                    labels={"model": self._model,
+                            "version": can["version"]})
+        _bb.record("controlplane", "promote", model=self._model,
+                   version=can["version"])
+        _hist_record("promote", self._model, version=can["version"])
+        return rec
+
+    # -- the tick ------------------------------------------------------
+    def tick(self, now=None):
+        """One control round: evaluate rules, then act — canary
+        first (a bad version inflates the very shed burn the scaler
+        reads), then scaling, then replica health.  Deterministic
+        under a caller-supplied `now` (tests drive simulated time);
+        never raises — action failures are counted
+        (controlplane.errors) and the loop keeps custody."""
+        now = float(now if now is not None else time.time())
+        with self._lock:
+            if self._closed:
+                return None
+            events.incr("controlplane.ticks")
+            if self._evaluate:
+                try:
+                    _slo.evaluate(now)
+                except Exception:       # noqa: BLE001
+                    pass
+            alerts = _slo.active_alerts()
+            try:
+                self._tick_canary(now, alerts)
+            except Exception:           # noqa: BLE001
+                events.incr("controlplane.errors")
+                _bb.record("controlplane", "error", model=self._model,
+                           phase="canary")
+            try:
+                self._tick_scale(now, alerts)
+            except Exception:           # noqa: BLE001
+                events.incr("controlplane.errors")
+                _bb.record("controlplane", "error", model=self._model,
+                           phase="scale")
+            try:
+                self._tick_health(now)
+            except Exception:           # noqa: BLE001
+                events.incr("controlplane.errors")
+                _bb.record("controlplane", "error", model=self._model,
+                           phase="health")
+            return self.status()
+
+    def _canary_breach(self, alerts, can):
+        """The firing rule attributable to the canary, or None: one
+        of the rules installed for it, or any rule whose labels carry
+        its version."""
+        for name in can["rules"]:
+            if name in alerts:
+                return name
+        want = str(can["version"])
+        for name, info in alerts.items():
+            labels = info.get("labels") or {}
+            if isinstance(labels, dict) \
+                    and str(labels.get("version")) == want:
+                return name
+        return None
+
+    def _model_noisy(self, alerts, can):
+        """True when ANY rule for the model is firing — the ramp
+        gate: 'every SLO rule for the model stays quiet for a full
+        observation window'."""
+        watched = self._noise_rules | set(can["rules"])
+        return any(name in watched for name in alerts)
+
+    def _tick_canary(self, now, alerts):
+        can = self._canary
+        if can is None:
+            return
+        breach = self._canary_breach(alerts, can)
+        if breach is not None:
+            self.rollback(rule=breach, info=alerts.get(breach))
+            return
+        if self._model_noisy(alerts, can):
+            can["quiet"] = 0        # window restarts — ramping while
+            return                  # ANY model rule fires is how bad
+                                    # versions reach 100%
+        can["quiet"] += 1
+        if can["quiet"] < self._observe:
+            return
+        can["quiet"] = 0
+        if can["fraction"] >= self._canary_max - 1e-9:
+            self.promote()
+            return
+        f = min(self._canary_max, can["fraction"] + self._step)
+        can["fraction"] = f
+        self._reg.set_canary_fraction(self._model, f)
+        events.incr("controlplane.ramps")
+        events.incr("controlplane.ramps",
+                    labels={"model": self._model,
+                            "version": can["version"]})
+        _bb.record("controlplane", "ramp", model=self._model,
+                   version=can["version"], fraction=f)
+        _hist_record("ramp", self._model, value=f,
+                     version=can["version"])
+
+    def _replicas(self):
+        try:
+            return len(self._reg._entry(self._model).devices)
+        except UnknownModel:
+            return 0
+
+    def _hbm_pressured(self):
+        for row in self._reg.stats()["ledger"]:
+            if row["budget"] > 0 and \
+                    row["committed"] >= self._pressure * row["budget"]:
+                return True
+        return False
+
+    def _tick_scale(self, now, alerts):
+        evidence = sorted(n for n in alerts if n in self._scale_rules)
+        n = self._replicas()
+        if not n:
+            return                  # model gone: nothing to scale
+        if evidence:
+            self._hot += 1
+            self._quiet = 0
+            if self._hot >= self._up_rounds and n < self._max \
+                    and now >= self._cool_until:
+                self._scale_to(n + 1, "up", evidence[0], now)
+                self._hot = 0
+            return
+        self._hot = 0
+        self._quiet += 1
+        need = self._down_rounds
+        if self._hbm_pressured():
+            need = max(1, need // 2)    # idle capacity on a full
+                                        # ledger goes back first
+        if self._quiet >= need and n > self._min \
+                and now >= self._cool_until:
+            self._scale_to(n - 1, "down", "quiet", now)
+            self._quiet = 0
+
+    def _scale_to(self, replicas, direction, reason, now,
+                  force=False):
+        try:
+            rec = self._reg.resize(self._model, replicas, force=force)
+        except AdmissionDenied as e:
+            events.incr("controlplane.scale_denied")
+            events.incr("controlplane.scale_denied",
+                        labels={"model": self._model})
+            _bb.record("controlplane", "scale_denied",
+                       model=self._model, replicas=int(replicas),
+                       reason=str(e)[:300])
+            # cooldown anyway: re-asking a full ledger every tick is
+            # the flapping this supervisor exists to prevent
+            self._cool_until = now + self._cooldown
+            return None
+        self._cool_until = now + self._cooldown
+        events.incr("controlplane.scale_%ss" % direction)
+        events.incr("controlplane.scale_%ss" % direction,
+                    labels={"model": self._model})
+        _bb.record("controlplane", "scale_%s" % direction,
+                   model=self._model, replicas=int(replicas),
+                   rule=str(reason), forced=bool(force))
+        _hist_record("scale_%s" % direction, self._model,
+                     value=float(replicas), rule=str(reason))
+        self.last_scale = {"direction": direction,
+                           "replicas": int(replicas),
+                           "rule": str(reason), "at": now}
+        return rec
+
+    def _tick_health(self, now):
+        try:
+            health = self._reg.engine(self._model).stats().get(
+                "replica_health") or []
+        except (UnknownModel, Exception):   # noqa: BLE001
+            return
+        if not health or any(h == "healthy" for h in health):
+            return
+        if not all(h == "unhealthy" for h in health):
+            return                  # probing replicas may recover
+        events.incr("controlplane.unhealthy_fleet")
+        events.incr("controlplane.unhealthy_fleet",
+                    labels={"model": self._model})
+        _bb.record("controlplane", "unhealthy_fleet",
+                   model=self._model, replicas=len(health))
+        if now < self._cool_until:
+            return                  # one rebuild per cooldown window
+        _bb.crash_dump("controlplane:unhealthy:%s" % self._model)
+        _hist_record("rebuild", self._model, value=float(len(health)))
+        # last-resort fallback: rebuild the SAME replica count on
+        # fresh engines (resize force) — routing has nowhere healthy
+        # left to route around
+        self._scale_to(len(health), "up", "all_replicas_unhealthy",
+                       now, force=True)
+
+    # -- lifecycle / introspection -------------------------------------
+    def start(self, interval=None):
+        """Run `tick()` on a daemon thread every `interval` seconds
+        (default MXNET_CTL_TICK_S).  Idempotent while running."""
+        interval = float(interval if interval is not None
+                         else self._tick_s)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("supervisor is closed")
+            if self._thread is not None and self._thread.is_alive():
+                return self._thread
+            self._stop.clear()
+
+            def loop():
+                while not self._stop.wait(interval):
+                    try:
+                        self.tick()
+                    except Exception:   # noqa: BLE001 — the loop
+                        events.incr("controlplane.errors")  # must
+                        pass            # survive anything
+
+            self._thread = threading.Thread(
+                target=loop, daemon=True,
+                name="FleetSupervisor-%s" % self._model)
+            self._thread.start()
+        return self._thread
+
+    def stop(self, timeout=5.0):
+        """Stop the background loop (the supervisor stays usable for
+        manual ticks)."""
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        self._thread = None
+
+    def close(self, timeout=5.0):
+        """Stop the loop and unregister every rule this supervisor
+        installed (its own watchdogs + any live canary's).  The
+        in-flight canary, if any, is left REGISTERED — closing the
+        controller must not take a traffic decision; roll back or
+        promote explicitly first.  Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            can = self._canary
+        self.stop(timeout)
+        if can is not None:
+            self._uninstall_rules(can["rules"])
+        self._uninstall_rules(self._own_rules)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def status(self):
+        """Live controller state for /metrics.json, dumps and
+        tests."""
+        with self._lock:
+            can = dict(self._canary) if self._canary else None
+        return {"model": self._model,
+                "replicas": self._replicas(),
+                "envelope": [self._min, self._max],
+                "lanes": list(self._lanes),
+                "hot_rounds": self._hot,
+                "quiet_rounds": self._quiet,
+                "canary": can,
+                "last_scale": self.last_scale,
+                "last_rollback": self.last_rollback,
+                "running": bool(self._thread is not None
+                                and self._thread.is_alive()),
+                "closed": self._closed}

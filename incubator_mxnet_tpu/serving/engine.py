@@ -352,7 +352,7 @@ class InferenceEngine:
                  max_batch=None, max_wait_us=None, queue_cap=None,
                  example_shape=None, wire_dtype=None,
                  handle_sigterm=False, lanes=None, lane_quotas=None,
-                 tenant_quota=None, cost_label=None):
+                 tenant_quota=None, cost_label=None, version=None):
         from ..parallel.functional import functionalize
         if devices is None:
             devices = [ctx or current_context()]
@@ -389,6 +389,13 @@ class InferenceEngine:
             else _cfg.get("MXNET_SERVE_TENANT_QUOTA"))
         self._tenant_q = {}         # tenant -> currently-queued count
         self._cost_label = str(cost_label or "serve.infer")
+        # version tag (ISSUE 16): labels the serve.requests/e2e_us/
+        # shed splits so canary traffic is attributable; None = no
+        # labeled children (single-version engines add no labelsets)
+        self._version = str(version) if version is not None else None
+        # model.bad_version taint: >0 stalls every batch by this many
+        # seconds and sign-flips outputs (deterministic degradation)
+        self._degrade_s = 0.0
         self._example_shape = (tuple(example_shape)
                                if example_shape is not None else None)
         self._wire_dtype = (str(_np.dtype(wire_dtype))
@@ -396,6 +403,10 @@ class InferenceEngine:
 
         self._pure = functionalize(block, training=False)
         self._infer = self._make_infer()
+        self._param_src = None      # block whose params serve (set by
+                                    # refresh_params_from on promote)
+        self._param_remap = None    # promoted-name -> serving-name
+                                    # (auto-prefix drift)
         self._dev_params = None     # list of {name: jax.Array} per ctx
         try:
             self.refresh_params()
@@ -484,14 +495,77 @@ class InferenceEngine:
 
     def refresh_params(self):
         """(Re-)replicate the block's current parameters onto every
-        serving device (call after the block was retrained/updated)."""
+        serving device (call after the block was retrained/updated).
+        After a `refresh_params_from` promote, the promoted block is
+        the parameter source — a later refresh must keep serving the
+        promoted weights, not silently revert to the original's."""
         import jax
         from ..parallel.functional import extract_params
-        base = extract_params(self._block)
+        base = extract_params(self._param_src if self._param_src
+                              is not None else self._block)
+        if self._param_remap:
+            base = {self._param_remap.get(n, n): v
+                    for n, v in base.items()}
         self._dev_params = [
             {n: jax.device_put(v, c.jax_device)
              for n, v in base.items()}
             for c in self._ctxs]
+
+    def refresh_params_from(self, block, version=None):
+        """Promote-by-weight-swap (ISSUE 16): serve `block`'s
+        parameters through THIS engine's already-warmed executables.
+        The parameter trees must match — same names, same shapes; or
+        (gluon auto-prefixing gives separately-built copies of the
+        SAME architecture fresh ``dense<N>_*`` names) same
+        registration order of shapes, in which case params map
+        positionally onto the serving names.  The executables were
+        traced against the original signature, so an architecturally
+        different version needs a fresh engine, not a swap.
+        Optionally re-tags the engine's version label."""
+        from ..parallel.functional import extract_params
+        new = extract_params(block)
+        cur = extract_params(self._param_src if self._param_src
+                             is not None else self._block)
+        remap = None
+        if set(new) != set(cur):
+            # collect_params order is registration order: identical
+            # architectures enumerate identically even when the name
+            # prefixes drifted
+            if len(new) != len(cur):
+                raise ValueError(
+                    "parameter tree mismatch: promote needs an "
+                    "identical tree (%d params vs %d serving) — "
+                    "architecturally different versions need a fresh "
+                    "engine" % (len(new), len(cur)))
+            remap = dict(zip(new, cur))
+            cur_by_new = {n: cur[remap[n]] for n in new}
+        else:
+            cur_by_new = cur
+        for n in new:
+            if tuple(new[n].shape) != tuple(cur_by_new[n].shape):
+                raise ValueError(
+                    "parameter %r shape %r != serving shape %r — the "
+                    "warmed executables serve ONE signature"
+                    % (n, tuple(new[n].shape),
+                       tuple(cur_by_new[n].shape)))
+        self._param_src = block
+        self._param_remap = remap
+        self.refresh_params()
+        if version is not None:
+            self._version = str(version)
+        events.incr("serve.param_swaps")
+
+    def set_version(self, version):
+        """Re-tag the version label on this engine's serve.* splits
+        (promotes re-point the primary's label at the new version)."""
+        self._version = str(version) if version is not None else None
+
+    def degrade(self, stall_s):
+        """Taint this engine (model.bad_version fault site): every
+        batch stalls `stall_s` seconds and outputs are sign-flipped —
+        deterministic degradation the canary SLO rules must catch.
+        Test/chaos hook; 0 restores healthy behavior."""
+        self._degrade_s = max(0.0, float(stall_s))
 
     # -- signal / preemption (PR 1 pattern) ----------------------------
     def _install_sigterm(self):
@@ -613,6 +687,11 @@ class InferenceEngine:
                                           "reason": reason})
         if tenant is not None:
             events.incr("serve.shed", labels={"tenant": tenant})
+        if self._version is not None:
+            # per-version split (ISSUE 16): canary attribution — the
+            # version-labeled shed burn is what the supervisor's
+            # rollback rules read
+            events.incr("serve.shed", labels={"version": self._version})
 
     def _shed(self, lane, tenant, reason, msg):
         self._shed_mark(lane, tenant, reason)
@@ -1111,6 +1190,11 @@ class InferenceEngine:
     def _run(self, dev_i, batch_np):
         import jax
         fault.maybe_raise("serve.infer", step=self._n_batches)
+        # benign per-batch stall (latency chaos / the controlplane
+        # bench's sleep-dominated service): unlike serve.infer this
+        # slows the batch instead of failing it, so capacity scales
+        # with REPLICAS even on a single-core virtual-device host
+        fault.maybe_slow("serve.slow", step=self._n_batches)
         if self._warm and self._dev_params is not None:
             # warmed steady state: every (device, bucket) executable
             # exists and the signature is locked, so replica workers
@@ -1120,14 +1204,24 @@ class InferenceEngine:
                                self._ctxs[dev_i].jax_device)
             out = self._infer(self._dev_params[dev_i], x)
             jax.block_until_ready(out)
-            return out
+            return self._degraded(out)
         with self._exec_lock:           # traces/materialization
             if self._dev_params is None:
                 self._materialize_params(batch_np)
             x = jax.device_put(batch_np, self._ctxs[dev_i].jax_device)
             out = self._infer(self._dev_params[dev_i], x)
             jax.block_until_ready(out)
-        return out
+        return self._degraded(out)
+
+    def _degraded(self, out):
+        """model.bad_version taint (see `degrade`): stall + sign-flip
+        — deterministic badness on latency AND correctness, so both a
+        p99 rule and an output-parity check catch it."""
+        if not self._degrade_s:
+            return out
+        import jax
+        time.sleep(self._degrade_s)
+        return jax.tree_util.tree_map(lambda a: -a, out)
 
     def _fan_out(self, reqs, out, dev_i):
         import jax
@@ -1162,6 +1256,14 @@ class InferenceEngine:
                                labels={"tenant": r.tenant})
                 events.incr("serve.requests",
                             labels={"tenant": r.tenant})
+            if self._version is not None:
+                # version split (ISSUE 16): one labelset per live
+                # version (bounded by the MAX_LABELSETS fold) — the
+                # percentile ring the canary p99 rule judges
+                events.observe("serve.e2e_us", us,
+                               labels={"version": self._version})
+                events.incr("serve.requests",
+                            labels={"version": self._version})
 
     # -- warmup --------------------------------------------------------
     def warmup(self, example_shape=None, wire_dtype=None):
@@ -1319,4 +1421,6 @@ class InferenceEngine:
                           "depths": self._q.lane_depths(),
                           "caps": dict(self._lane_caps)},
                 "tenants_queued": tenants,
+                "version": self._version,
+                "degraded": bool(self._degrade_s),
                 "warm": self._warm}

@@ -63,6 +63,7 @@ import time
 import numpy as _np
 
 from .. import config as _cfg
+from .. import fault
 from ..base import MXNetError
 from ..context import Context, current_context
 from ..monitor import events
@@ -72,7 +73,7 @@ from .engine import (InferenceEngine, QueueFull, DeadlineExceeded,
                      EngineClosed, Shed)
 
 __all__ = ["ModelRegistry", "AdmissionDenied", "CircuitOpen",
-           "UnknownModel", "project_footprint"]
+           "UnknownModel", "RegistrationTimeout", "project_footprint"]
 
 
 class AdmissionDenied(MXNetError):
@@ -80,6 +81,16 @@ class AdmissionDenied(MXNetError):
     per-device budget on enough devices — refused at REGISTRATION time
     (a ledger check), not discovered as an allocator OOM at traffic
     time."""
+
+
+class RegistrationTimeout(MXNetError):
+    """The engine build (param replication + functionalization) did
+    not complete within the bounded build timeout
+    (MXNET_SERVE_BUILD_TIMEOUT_S / ``build_timeout=``): the ledger
+    hold was rolled back and the name released, so the deploy path is
+    free to retry — a wedged compile must not hold it hostage.  If
+    the abandoned build eventually completes, its engine is closed in
+    the background (never leaked)."""
 
 
 class CircuitOpen(MXNetError):
@@ -221,10 +232,12 @@ class _Breaker:
 
 class _Entry:
     __slots__ = ("name", "engine", "breaker", "footprint", "basis",
-                 "devices", "detail", "cost_labels")
+                 "devices", "detail", "cost_labels", "version",
+                 "canary", "spawn")
 
     def __init__(self, name, engine, breaker, footprint, basis,
-                 devices, detail, cost_labels=None):
+                 devices, detail, cost_labels=None, version=None,
+                 spawn=None):
         self.name = name
         self.engine = engine
         self.breaker = breaker
@@ -236,6 +249,14 @@ class _Entry:
         # is read from (one for one-shot engines; prefill/decode_step/
         # join for generation engines)
         self.cost_labels = cost_labels or ["serve.infer:%s" % name]
+        self.version = version      # serving version tag (ISSUE 16)
+        # in-flight canary route: {"name", "version", "fraction",
+        # "acc"} — the deterministic traffic-mirroring state
+        self.canary = None
+        # registration kwargs, so resize / register_version can
+        # rebuild an engine with the same signature without the
+        # caller re-supplying it
+        self.spawn = spawn or {}
 
 
 class ModelRegistry:
@@ -333,9 +354,69 @@ class ModelRegistry:
                              for d in decision), kv_term))
         return [i for _, i in chosen]
 
+    def _build_engine(self, name, ctor, build_timeout):
+        """Run the engine constructor in a worker bounded by
+        `build_timeout` seconds (MXNET_SERVE_BUILD_TIMEOUT_S when
+        None; <= 0 = unbounded).  A build that wedges (hung compile,
+        stalled param replication) raises the typed
+        `RegistrationTimeout` instead of holding the deploy path
+        hostage; the abandoned worker closes its engine if it ever
+        finishes, so nothing leaks.  The `serve.build` fault site
+        stalls inside the worker — the deterministic wedge the
+        regression test arms."""
+        if build_timeout is None:
+            build_timeout = float(
+                _cfg.get("MXNET_SERVE_BUILD_TIMEOUT_S"))
+        if build_timeout <= 0:
+            fault.maybe_slow("serve.build")
+            return ctor()
+        box = {"engine": None, "exc": None, "abandoned": False}
+        done = threading.Event()
+        claim = threading.Lock()
+
+        def build():
+            try:
+                fault.maybe_slow("serve.build")
+                eng = ctor()
+            except BaseException as e:      # noqa: BLE001 — reraised
+                box["exc"] = e              # on the caller's thread
+            else:
+                with claim:                 # exactly one side owns the
+                    orphan = box["abandoned"]   # engine: the caller
+                    if not orphan:          # (returned) or the builder
+                        box["engine"] = eng     # (closes the orphan)
+                if orphan:
+                    try:                    # too late: caller already
+                        eng.close(1.0)      # rolled the ledger back
+                    except Exception:       # noqa: BLE001
+                        pass
+            done.set()
+
+        t = threading.Thread(target=build, daemon=True,
+                             name="ServeBuild-%s" % name)
+        t.start()
+        if not done.wait(build_timeout):
+            with claim:
+                timed_out = box["engine"] is None
+                box["abandoned"] = timed_out
+            if timed_out:
+                events.incr("serve.registration_timeout")
+                events.incr("serve.registration_timeout",
+                            labels={"model": name})
+                _bb.record("serve", "registration_timeout",
+                           model=name, timeout_s=float(build_timeout))
+                raise RegistrationTimeout(
+                    "engine build for model %r did not complete "
+                    "within %.1fs (MXNET_SERVE_BUILD_TIMEOUT_S / "
+                    "build_timeout=); ledger hold rolled back — "
+                    "retry or raise the bound" % (name, build_timeout))
+        if box["exc"] is not None:
+            raise box["exc"]
+        return box["engine"]
+
     def register(self, name, block, replicas=1, example_shape=None,
                  wire_dtype=None, buckets=None, max_batch=None,
-                 **engine_kw):
+                 build_timeout=None, **engine_kw):
         """Admit `block` as model `name` on `replicas` pool devices.
 
         The per-device footprint comes from the cost registry when
@@ -384,15 +465,19 @@ class ModelRegistry:
             # (construction replicates params onto devices — slow)
             self._models[name] = None
         try:
-            engine = InferenceEngine(
-                block, devices=[self._ctxs[i] for i in idxs],
-                buckets=bset, max_batch=max_batch,
-                example_shape=example_shape, wire_dtype=wire_dtype,
-                cost_label=label, **engine_kw)
+            engine = self._build_engine(
+                name,
+                lambda: InferenceEngine(
+                    block, devices=[self._ctxs[i] for i in idxs],
+                    buckets=bset, max_batch=max_batch,
+                    example_shape=example_shape,
+                    wire_dtype=wire_dtype,
+                    cost_label=label, **engine_kw),
+                build_timeout)
         except Exception:
             with self._lock:    # roll the admission back — a failed
-                for i in idxs:  # build must not leak committed budget
-                    self._committed[i] = max(
+                for i in idxs:  # (or timed-out) build must not leak
+                    self._committed[i] = max(    # committed budget
                         0, self._committed[i] - footprint)
                 self._models.pop(name, None)
             raise
@@ -400,7 +485,12 @@ class ModelRegistry:
             name, engine,
             _Breaker(name, _cfg.get("MXNET_SERVE_BREAKER_FAILS"),
                      _cfg.get("MXNET_SERVE_BREAKER_COOLDOWN_S")),
-            footprint, basis, idxs, detail)
+            footprint, basis, idxs, detail,
+            version=engine_kw.get("version"),
+            spawn=dict(engine_kw, replicas=int(replicas),
+                       example_shape=example_shape,
+                       wire_dtype=wire_dtype, buckets=list(bset),
+                       max_batch=max_batch))
         with self._lock:
             if self._closed:
                 closed = True       # a close() raced the engine build:
@@ -565,6 +655,12 @@ class ModelRegistry:
             for i in entry.devices:
                 self._committed[i] = max(
                     0, self._committed[i] - entry.footprint)
+            # instant traffic revert: any primary mirroring traffic to
+            # this name stops NOW, not at its next rollback bookkeeping
+            for e in self._models.values():
+                if e is not None and e.canary \
+                        and e.canary.get("name") == str(name):
+                    e.canary = None
         entry.engine.close(timeout)
         # drop the model's cost rows with it: a later re-registration
         # under the same name must not read THIS incarnation's
@@ -574,6 +670,274 @@ class ModelRegistry:
         events.incr("serve.models_evicted")
         _bb.record("serve", "evicted", model=entry.name,
                    released_bytes=int(entry.footprint))
+
+    # -- elastic resize (ISSUE 16) -------------------------------------
+    def resize(self, name, replicas, force=False, timeout=30.0,
+               build_timeout=None):
+        """Grow/shrink model `name` to `replicas` pool devices —
+        make-before-break: the NEW replica set is admitted (bin-packed
+        + committed) while the old one still serves, the new engine is
+        built and warmed, traffic swaps atomically, and only then is
+        the old engine closed and its commitment released.  The
+        temporary double-count is the safe direction — admission may
+        transiently refuse OTHER deploys, never oversubscribe HBM.
+        `force=True` rebuilds even at the same replica count (the
+        supervisor's all-replicas-unhealthy fallback).  Raises
+        AdmissionDenied when the new set does not fit; the old engine
+        keeps serving untouched."""
+        entry = self._entry(name)
+        if not isinstance(entry.engine, InferenceEngine):
+            raise ValueError(
+                "resize() supports one-shot InferenceEngine models "
+                "only (generation engines are single-device)")
+        replicas = int(replicas)
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1, got %d"
+                             % replicas)
+        if replicas == len(entry.devices) and not force:
+            return {"model": entry.name, "replicas": replicas,
+                    "resized": False}
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("registry is closed")
+            idxs = self._place(entry.name, entry.footprint, replicas)
+            for i in idxs:
+                self._committed[i] += entry.footprint
+        old_engine = entry.engine
+        spawn = {k: v for k, v in entry.spawn.items()
+                 if k not in ("replicas", "version")}
+        example_shape = spawn.pop("example_shape", None)
+        wire_dtype = spawn.pop("wire_dtype", None)
+        bset = spawn.pop("buckets", None)
+        max_batch = spawn.pop("max_batch", None)
+        label = "serve.infer:%s" % entry.name
+
+        def ctor():
+            eng = InferenceEngine(
+                old_engine._block,
+                devices=[self._ctxs[i] for i in idxs],
+                buckets=bset, max_batch=max_batch,
+                example_shape=example_shape, wire_dtype=wire_dtype,
+                cost_label=label, version=entry.version, **spawn)
+            if old_engine._param_src is not None:
+                # the primary was promoted since registration: new
+                # replicas must serve the promoted weights, not the
+                # original block's
+                eng.refresh_params_from(old_engine._param_src)
+            return eng
+
+        engine = None
+        try:
+            engine = self._build_engine(entry.name, ctor,
+                                        build_timeout)
+            if example_shape is not None:
+                engine.warmup()     # new replicas compile BEFORE the
+                                    # swap — traffic never pays it
+        except Exception:
+            with self._lock:        # release the NEW commitment; the
+                for i in idxs:      # old set never stopped serving
+                    self._committed[i] = max(
+                        0, self._committed[i] - entry.footprint)
+            if engine is not None:
+                try:
+                    engine.close(1.0)
+                except Exception:   # noqa: BLE001
+                    pass
+            raise
+        with self._lock:
+            old_devices, entry.devices = entry.devices, idxs
+            entry.engine = engine
+            for i in old_devices:
+                self._committed[i] = max(
+                    0, self._committed[i] - entry.footprint)
+        old_engine.close(timeout)
+        events.incr("serve.resized")
+        events.incr("serve.resized", labels={"model": entry.name})
+        _bb.record("serve", "resized", model=entry.name,
+                   replicas=replicas, from_replicas=len(old_devices),
+                   forced=bool(force),
+                   devices=[repr(self._ctxs[i]) for i in idxs])
+        return {"model": entry.name, "replicas": replicas,
+                "resized": True,
+                "devices": [repr(self._ctxs[i]) for i in idxs]}
+
+    # -- versioned deploys (ISSUE 16) ----------------------------------
+    def register_version(self, name, block, version, fraction=None,
+                         warmup=True, **register_kw):
+        """Admit `block` as version `version` of model `name`
+        ALONGSIDE the serving one, under the same admission ledger
+        (entry name ``<name>@<version>``, own engine/breaker/ledger
+        hold), and start mirroring a deterministic `fraction` of the
+        primary's traffic to it (default
+        MXNET_CTL_CANARY_FRACTION).  Engine signature defaults come
+        from the primary's registration, so the canary serves the
+        same wire contract without re-specifying it.  The
+        `model.bad_version` fault site taints the version admitted
+        while armed (engine.degrade) — after warmup, so the taint
+        degrades traffic, not compilation.  Promote with
+        `promote_version`, abort with `rollback_version`."""
+        base = self._entry(name)
+        if not isinstance(base.engine, InferenceEngine):
+            raise ValueError("register_version() supports one-shot "
+                             "InferenceEngine models only")
+        version = str(version)
+        cname = "%s@%s" % (name, version)
+        with self._lock:
+            if base.canary is not None:
+                raise ValueError(
+                    "model %r already has version %r in flight "
+                    "(promote or roll it back first)"
+                    % (name, base.canary["version"]))
+        tainted = fault.should_fire("model.bad_version")
+        spawn = {k: v for k, v in base.spawn.items()
+                 if k not in ("replicas", "version")}
+        spawn.update(register_kw)
+        replicas = int(spawn.pop("replicas", 1))
+        rec = self.register(cname, block, replicas=replicas,
+                            version=version, **spawn)
+        try:
+            centry = self._entry(cname)
+            if warmup and centry.engine._example_shape is not None:
+                self.warmup(cname)
+            if tainted:
+                stall = float(_cfg.get("MXNET_CTL_DEGRADE_S"))
+                centry.engine.degrade(stall)
+                _bb.record("serve", "bad_version", model=str(name),
+                           version=version, stall_s=stall)
+            fraction = float(
+                fraction if fraction is not None
+                else _cfg.get("MXNET_CTL_CANARY_FRACTION"))
+            if not (0.0 <= fraction <= 1.0):
+                raise ValueError("canary fraction must be in [0, 1], "
+                                 "got %r" % (fraction,))
+            with self._lock:
+                cur = self._models.get(str(name))
+                if cur is None or cur is not base:
+                    raise UnknownModel(
+                        "model %r was unregistered while version %r "
+                        "built" % (name, version))
+                base.canary = {"name": cname, "version": version,
+                               "fraction": fraction, "acc": 0.0}
+        except Exception:
+            # the canary's ledger hold releases on EVERY exit path —
+            # a failed warmup/validation must not strand it
+            try:
+                self.unregister(cname, timeout=5.0)
+            except UnknownModel:
+                pass
+            raise
+        events.incr("serve.versions_admitted")
+        events.incr("serve.versions_admitted",
+                    labels={"model": str(name), "version": version})
+        _bb.record("serve", "version_admitted", model=str(name),
+                   version=version, fraction=fraction,
+                   tainted=bool(tainted))
+        rec.update(version=version, fraction=fraction,
+                   tainted=bool(tainted))
+        return rec
+
+    def canary(self, name):
+        """The in-flight canary route for model `name` ({name,
+        version, fraction, acc}) or None."""
+        with self._lock:
+            entry = self._models.get(str(name))
+            if entry is None:
+                raise UnknownModel("model %r is not registered"
+                                   % (name,))
+            return dict(entry.canary) if entry.canary else None
+
+    def set_canary_fraction(self, name, fraction):
+        """Re-point the mirrored traffic fraction of model `name`'s
+        in-flight version (the supervisor's ramp actuator)."""
+        fraction = float(fraction)
+        if not (0.0 <= fraction <= 1.0):
+            raise ValueError("canary fraction must be in [0, 1], "
+                             "got %r" % (fraction,))
+        with self._lock:
+            entry = self._models.get(str(name))
+            if entry is None:
+                raise UnknownModel("model %r is not registered"
+                                   % (name,))
+            if entry.canary is None:
+                raise ValueError("model %r has no version in flight"
+                                 % (name,))
+            entry.canary["fraction"] = fraction
+            version = entry.canary["version"]
+        _bb.record("serve", "canary_fraction", model=str(name),
+                   version=version, fraction=fraction)
+        return fraction
+
+    def promote_version(self, name, timeout=30.0):
+        """Promote model `name`'s in-flight version: the primary
+        engine swaps to the version's weights in place
+        (`refresh_params_from` — the already-warmed executables keep
+        serving, zero downtime), re-tags its version label, and the
+        canary entry is unregistered (its ledger hold released
+        exactly once).  A failed swap (parameter-tree mismatch)
+        restores the canary route so `rollback_version` can still
+        clean up."""
+        name = str(name)
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise UnknownModel("model %r is not registered"
+                                   % (name,))
+            can, entry.canary = entry.canary, None
+        if can is None:
+            raise ValueError("model %r has no version in flight to "
+                             "promote" % (name,))
+        try:
+            centry = self._entry(can["name"])
+            src = (centry.engine._param_src
+                   if centry.engine._param_src is not None
+                   else centry.engine._block)
+            entry.engine.refresh_params_from(src,
+                                             version=can["version"])
+        except Exception:
+            with self._lock:        # keep the canary rollbackable —
+                cur = self._models.get(name)    # its ledger hold must
+                if cur is not None and cur.canary is None:  # still
+                    cur.canary = can            # release exactly once
+            raise
+        entry.version = can["version"]
+        try:
+            self.unregister(can["name"], timeout)
+        except UnknownModel:
+            pass
+        events.incr("serve.versions_promoted")
+        events.incr("serve.versions_promoted",
+                    labels={"model": name, "version": can["version"]})
+        _bb.record("serve", "version_promoted", model=name,
+                   version=can["version"])
+        return {"model": name, "version": can["version"]}
+
+    def rollback_version(self, name, reason=None, timeout=30.0):
+        """Revert model `name`'s in-flight version: traffic mirroring
+        stops immediately (the route is cleared under the lock before
+        anything slow), the canary entry is unregistered and its
+        ledger hold released.  Idempotent — a second rollback (or a
+        rollback racing a promote) returns None and touches nothing,
+        so the release happens exactly once.  Returns the rolled-back
+        route dict."""
+        name = str(name)
+        with self._lock:
+            entry = self._models.get(name)
+            can = entry.canary if entry is not None else None
+            if entry is not None:
+                entry.canary = None
+        if can is None:
+            return None
+        try:
+            self.unregister(can["name"], timeout)
+        except UnknownModel:
+            pass
+        events.incr("serve.versions_rolled_back")
+        events.incr("serve.versions_rolled_back",
+                    labels={"model": name, "version": can["version"]})
+        _bb.record("serve", "version_rolled_back", model=name,
+                   version=can["version"],
+                   reason=str(reason) if reason else None)
+        return dict(can)
 
     # -- traffic -------------------------------------------------------
     def _entry(self, name):
@@ -630,17 +994,39 @@ class ModelRegistry:
         fut.add_done_callback(self._observed(entry.breaker))
         return res
 
+    def _traffic_entry(self, entry):
+        """Canary mirroring (ISSUE 16): a deterministic fraction
+        ACCUMULATOR (not a RNG) routes exactly `fraction` of the
+        primary's submits to the in-flight version — reproducible
+        splits, no sampling noise in the canary's labeled series.
+        The canary rides its own entry: own breaker, own engine, own
+        version-labeled telemetry."""
+        if entry.canary is None:
+            return entry
+        with self._lock:
+            can = entry.canary
+            if can is None or can["fraction"] <= 0.0:
+                return entry
+            can["acc"] += can["fraction"]
+            if can["acc"] < 1.0 - 1e-9:
+                return entry
+            can["acc"] -= 1.0
+            target = self._models.get(can["name"])
+        return target if target is not None else entry
+
     def submit(self, name, x, deadline=None, lane=None, tenant=None):
         """Route one example to model `name` through its circuit
         breaker.  Raises UnknownModel / CircuitOpen synchronously on
-        top of the engine's QueueFull / Shed / EngineClosed."""
-        entry = self._entry(name)
+        top of the engine's QueueFull / Shed / EngineClosed.  With a
+        version in flight, a deterministic fraction of submits mirrors
+        to the canary entry instead."""
+        entry = self._traffic_entry(self._entry(name))
         return self._route(entry, entry.engine.submit, x,
                            deadline=deadline, lane=lane, tenant=tenant)
 
     def submit_batch(self, name, x, deadline=None, lane=None,
                      tenant=None):
-        entry = self._entry(name)
+        entry = self._traffic_entry(self._entry(name))
         return self._route(entry, entry.engine.submit_batch, x,
                            deadline=deadline, lane=lane, tenant=tenant)
 
@@ -732,6 +1118,9 @@ class ModelRegistry:
             models = {
                 n: {"footprint_bytes": e.footprint, "basis": e.basis,
                     "devices": [repr(self._ctxs[i]) for i in e.devices],
+                    "replicas": len(e.devices),
+                    "version": e.version,
+                    "canary": dict(e.canary) if e.canary else None,
                     "breaker": e.breaker.state}
                 for n, e in self._models.items() if e is not None}
             ledger = [

@@ -262,6 +262,19 @@ class MetricsExporter:
                     out["slo"] = sblock
             except Exception:       # noqa: BLE001
                 pass
+            # supervisor state (ISSUE 16) — only when the control
+            # plane is already imported (same guard as the blackbox:
+            # a scrape must not import the serving stack)
+            try:
+                import sys as _sys
+                ctl = _sys.modules.get(
+                    "incubator_mxnet_tpu.serving.controlplane")
+                if ctl is not None:
+                    cblock = ctl.status_block()
+                    if cblock:
+                        out["controlplane"] = cblock
+            except Exception:       # noqa: BLE001
+                pass
         return out
 
     def json_text(self) -> str:

@@ -373,6 +373,17 @@ def dump_blackbox(path=None, reason="manual", exc=None, last=None):
         slo_block = _slo.block() or None
     except Exception:               # noqa: BLE001 — forensic garnish
         slo_block = None
+    # the control-plane state (ISSUE 16): guarded on the module being
+    # ALREADY imported — a training-only dump must not pull the whole
+    # serving stack in just to say "no supervisors"
+    ctl_block = None
+    try:
+        ctl_mod = sys.modules.get(
+            "incubator_mxnet_tpu.serving.controlplane")
+        if ctl_mod is not None:
+            ctl_block = ctl_mod.status_block() or None
+    except Exception:               # noqa: BLE001
+        ctl_block = None
     evs = ring_snapshot(last=last)
     doc = {
         "schema": SCHEMA,
@@ -387,6 +398,7 @@ def dump_blackbox(path=None, reason="manual", exc=None, last=None):
         "costs": cost_block,
         "fleet": fleet,
         "slo": slo_block,
+        "controlplane": ctl_block,
         "hbm": {"peaks": hbm_peaks()},
         "events": evs,
         "trace": {"traceEvents": _chrome_view(evs),

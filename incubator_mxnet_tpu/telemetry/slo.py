@@ -66,7 +66,9 @@ __all__ = ["Rule", "ThresholdRule", "BurnRateRule", "AnomalyRule",
            "active_alerts", "evaluate", "block", "register_action",
            "default_serving_rules", "install_default_serving_rules",
            "default_generation_rules",
-           "install_default_generation_rules"]
+           "install_default_generation_rules",
+           "default_controlplane_rules",
+           "install_default_controlplane_rules"]
 
 
 # -- metric readers ----------------------------------------------------
@@ -635,6 +637,48 @@ def install_default_generation_rules(engine=None, registry=None,
                 kw["quotas"] = q
     installed = [register_rule(r) for r in
                  default_generation_rules(targets=targets, **kw)]
+    return [r.name for r in installed]
+
+
+def default_controlplane_rules(fast_s=None, slow_s=None) -> list:
+    """Watchdogs over the WATCHER (ISSUE 16): the FleetSupervisor's
+    own actions are counters, so its pathologies are burn rules like
+    everyone else's —
+
+    - **rollback storm**: rollbacks burning against deploys past 50%
+      means versions are being shipped that the canary gate keeps
+      rejecting (or the gate itself is broken) — either way a human
+      should look before the loop masks a systemic problem;
+    - **scale oscillation**: scale transitions burning against ticks
+      past 25% means the hysteresis/cooldown envelope is mis-tuned
+      for the load pattern and the supervisor is flapping capacity.
+    """
+    return [
+        BurnRateRule(
+            "ctl-rollback-storm",
+            bad="controlplane.rollbacks",
+            total=["controlplane.deploys"],
+            budget=0.5, min_total=2.0, fast_s=fast_s, slow_s=slow_s,
+            description="canary rollbacks burn >50% of deploys over "
+                        "both windows — bad versions keep shipping "
+                        "(or the canary gate is broken)"),
+        BurnRateRule(
+            "ctl-scale-oscillation",
+            bad=["controlplane.scale_ups", "controlplane.scale_downs"],
+            total=["controlplane.ticks"],
+            budget=0.25, min_total=8.0, fast_s=fast_s, slow_s=slow_s,
+            description="scale transitions on >25% of supervisor "
+                        "ticks over both windows — the hysteresis/"
+                        "cooldown envelope is flapping capacity"),
+    ]
+
+
+def install_default_controlplane_rules(**kw) -> list:
+    """Build + register the supervisor watchdog rules (the
+    FleetSupervisor installs these at construction).  Returns the
+    registered rule names."""
+    installed = [register_rule(r)
+                 for r in default_controlplane_rules(**kw)]
     return [r.name for r in installed]
 
 

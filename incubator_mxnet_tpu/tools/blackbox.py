@@ -105,6 +105,30 @@ def suspected_cause(doc: dict) -> str:
                             if isinstance(info[k],
                                           (int, float, str)))[:100]
                    or "no evidence recorded"))
+    if reason.startswith("controlplane:"):
+        # proactive supervisor dumps (ISSUE 16): the rollback ring
+        # event names the breaching rule and the reverted version
+        rb = [e for e in evs if e.get("kind") == "controlplane"
+              and e.get("name") == "rollback"]
+        if reason.startswith("controlplane:rollback:") or rb:
+            last = rb[-1] if rb else {}
+            return ("canary rollback: version %r of model %r breached "
+                    "rule %r — traffic reverted, version deregistered "
+                    "(PROACTIVE dump, the fleet kept serving); read "
+                    "the controlplane block and controlplane.* ring "
+                    "events"
+                    % (last.get("version",
+                                reason.rsplit("@", 1)[-1]),
+                       last.get("model", "?"),
+                       last.get("rule", "?")))
+        if reason.startswith("controlplane:unhealthy:"):
+            return ("whole replica set of model %r went unhealthy — "
+                    "supervisor forced an emergency rebuild (resize "
+                    "in place); read replica_health in the fleet "
+                    "block and the controlplane.* ring events"
+                    % reason.rsplit(":", 1)[-1])
+        return ("fleet supervisor dump (%s) — read the controlplane "
+                "block and controlplane.* ring events" % reason)
     # integrity family first: silent corruption outranks everything a
     # run can do to itself — the bytes were wrong
     sdc = [e for e in evs

@@ -125,6 +125,13 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "quant: int8 quantized-serving + AMP training "
         "tests (CPU-fast, run in tier-1 by default)")
+    # fleet control plane (ISSUE 16): FleetSupervisor autoscaling
+    # hysteresis, canary ramp/promote/rollback, registration timeouts
+    # and ledger-release invariants
+    config.addinivalue_line(
+        "markers", "controlplane: SLO-driven fleet-supervisor "
+        "(autoscaling / canary deploy / rollback) tests (CPU-fast, "
+        "run in tier-1 by default)")
 
 
 @pytest.fixture(autouse=True)

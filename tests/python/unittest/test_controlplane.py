@@ -1,0 +1,393 @@
+"""Fleet control-plane tests (ISSUE 16 tentpole): FleetSupervisor
+autoscaling hysteresis (square-wave bounded, cooldown-armed denial),
+canary deploy/ramp/promote/rollback through the registry's versioned
+entries, bounded-build RegistrationTimeout, version-labeled telemetry
+flow, and the exactly-once HBM-ledger release invariant on every exit
+path.  CPU-only, fast (the check_controlplane chaos gate is
+slow-marked)."""
+import os
+import time
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon, fault
+from incubator_mxnet_tpu import config as cfg
+from incubator_mxnet_tpu.monitor import events
+from incubator_mxnet_tpu.serving import (FleetSupervisor,
+                                         ModelRegistry,
+                                         AdmissionDenied,
+                                         RegistrationTimeout,
+                                         project_footprint)
+from incubator_mxnet_tpu.telemetry import flightrec as _bb
+from incubator_mxnet_tpu.telemetry import slo as _slo
+
+pytestmark = pytest.mark.controlplane
+
+
+@pytest.fixture(autouse=True)
+def _clean_slo_rules():
+    """No SLO rule (supervisor watchdogs, canary rules, fakes) may
+    leak across tests."""
+    yield
+    _slo.clear_rules()
+
+
+def _dense_net(units=4, in_units=8, seed=0):
+    mx.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu"))
+        net.add(gluon.nn.Dense(units))
+    net.initialize(ctx=mx.cpu())
+    net(nd.array(onp.zeros((2, in_units), onp.float32)))
+    return net
+
+
+def _data(n=8, in_units=8, seed=3):
+    return onp.random.RandomState(seed).rand(n, in_units).astype(
+        onp.float32)
+
+
+def _registry(n=4, **kw):
+    return ModelRegistry(devices=[mx.cpu(i) for i in range(n)], **kw)
+
+
+def _committed(reg):
+    return sum(r["committed"] for r in reg.stats()["ledger"])
+
+
+class _FakeRule(_slo.Rule):
+    """Hand-toggled rule: drives the supervisor deterministically."""
+
+    def __init__(self, name, labels=None):
+        super().__init__(name, description="test fake")
+        self.firing = False
+        self._labels = labels
+
+    def check(self, now):
+        info = {"burn": 9.9}
+        if self._labels:
+            info["labels"] = dict(self._labels)
+        return bool(self.firing), info
+
+
+def _sup(reg, model="m", **kw):
+    kw.setdefault("install_rules", False)
+    kw.setdefault("cooldown_s", 0.0)
+    return FleetSupervisor(reg, model, **kw)
+
+
+# -- satellite 2: bounded registration builds --------------------------
+def test_registration_timeout_releases_ledger_exactly_once():
+    reg = _registry(2)
+    fault.install("serve.build", seconds=1.5)
+    r0 = events.get("serve.registration_timeout")
+    c0 = _committed(reg)
+    with pytest.raises(RegistrationTimeout):
+        reg.register("rt", _dense_net(seed=1), example_shape=(8,),
+                     max_batch=2, build_timeout=0.05)
+    # the hold rolled back: ledger where it started, name free, the
+    # timeout typed + counted + on the flight recorder
+    assert _committed(reg) == c0
+    assert "rt" not in reg.stats()["models"]
+    assert events.get("serve.registration_timeout") == r0 + 1
+    ring = [e for e in _bb.ring_snapshot()
+            if e.get("kind") == "serve"
+            and e.get("name") == "registration_timeout"]
+    assert ring and ring[-1]["model"] == "rt"
+    # the same name registers cleanly once the stall is gone (the
+    # abandoned builder may still be sleeping — ownership, not time,
+    # is what the handshake settles)
+    fault.clear("serve.build")
+    reg.register("rt", _dense_net(seed=1), example_shape=(8,),
+                 max_batch=2, build_timeout=30.0)
+    out = reg.submit("rt", _data(1)[0]).result(timeout=30)
+    assert out is not None
+    reg.close()
+
+
+def test_build_timeout_zero_disables_bound():
+    reg = _registry(1)
+    fault.install("serve.build", seconds=0.2)
+    reg.register("bt", _dense_net(seed=2), example_shape=(8,),
+                 max_batch=2, build_timeout=0)
+    assert "bt" in reg.stats()["models"]
+    reg.close()
+
+
+# -- satellite 3: version-labeled serve telemetry ----------------------
+def test_version_labels_flow_to_counters_and_rings():
+    reg = _registry(1)
+    reg.register("vl", _dense_net(seed=3), example_shape=(8,),
+                 max_batch=4, version="v1")
+    reg.warmup("vl")
+    for x in _data(6):
+        reg.submit("vl", x).result(timeout=30)
+    reqs = {tuple(sorted(r["labels"].items())): r["value"]
+            for r in events.labeled_snapshot().get(
+                "serve.requests", [])}
+    assert reqs.get((("version", "v1"),), 0) >= 6
+    lat = [r for r in events.labeled_percentiles("serve.e2e_us")
+           if r["labels"] == {"version": "v1"}]
+    assert lat and lat[0]["n"] >= 6
+    # the shed split carries the version too (expired deadline)
+    s0 = sum(r["value"] for r in events.labeled_snapshot().get(
+        "serve.shed", []) if r["labels"] == {"version": "v1"})
+    fault.install("serve.slow", at_calls=[1], times=8, seconds=0.3)
+    sheds = [reg.submit("vl", _data(1)[0])]     # occupies the
+    time.sleep(0.05)                            # dispatcher in the
+    for x in _data(3):                          # stall; the rest
+        sheds.append(reg.submit("vl", x,        # expire in-queue
+                                deadline=0.01))
+    shed_n = 0
+    for f in sheds:
+        try:
+            f.result(timeout=30)
+        except Exception:           # noqa: BLE001 — typed shed family
+            shed_n += 1
+    assert shed_n >= 1
+    s1 = sum(r["value"] for r in events.labeled_snapshot().get(
+        "serve.shed", []) if r["labels"] == {"version": "v1"})
+    assert s1 >= s0 + 1
+    reg.close()
+
+
+def test_canary_mirror_fraction_is_deterministic():
+    reg = _registry(2)
+    reg.register("cf", _dense_net(seed=4), example_shape=(8,),
+                 max_batch=4, version="v1")
+    reg.warmup("cf")
+    reg.register_version("cf", _dense_net(seed=5), "v2", fraction=0.5)
+    base = {r["labels"]["version"]: r["value"]
+            for r in events.labeled_snapshot().get(
+                "serve.requests", [])
+            if "version" in r["labels"]}
+    for x in _data(8):
+        reg.submit("cf", x).result(timeout=30)
+    now = {r["labels"]["version"]: r["value"]
+           for r in events.labeled_snapshot().get(
+               "serve.requests", [])
+           if "version" in r["labels"]}
+    # fraction 0.5 through the accumulator: EXACTLY every 2nd request
+    assert now.get("v2", 0) - base.get("v2", 0) == 4
+    assert now.get("v1", 0) - base.get("v1", 0) == 4
+    reg.rollback_version("cf")
+    reg.close()
+
+
+# -- satellite 4: supervisor edge cases --------------------------------
+def test_square_wave_hysteresis_bounds_transitions():
+    reg = _registry(3)
+    reg.register("sq", _dense_net(seed=6), max_batch=1, replicas=1)
+    rule = _slo.register_rule(_FakeRule("sq-hot"))
+    sup = _sup(reg, "sq", watch_rules=("sq-hot",), max_replicas=2,
+               up_rounds=2, down_rounds=3, cooldown_s=8.0)
+    t = [1000.0]
+
+    def window(firing, ticks):
+        rule.firing = firing
+        u0 = events.get("controlplane.scale_ups")
+        d0 = events.get("controlplane.scale_downs")
+        for _ in range(ticks):
+            sup.tick(now=t[0])
+            t[0] += 1.0
+        return (events.get("controlplane.scale_ups") - u0,
+                events.get("controlplane.scale_downs") - d0)
+
+    ups = downs = 0
+    for _ in range(2):
+        u, d = window(True, 6)
+        assert u <= 1 and d == 0, "hot window: at most ONE scale-up"
+        ups += u
+        d, u2 = window(False, 6)[::-1]
+        assert d <= 1 and u2 == 0, \
+            "quiet window: at most ONE scale-down"
+        downs += d
+    assert ups >= 1 and downs >= 1   # the wave did move the fleet
+    n = reg.stats()["models"]["sq"]["replicas"]
+    assert 1 <= n <= 2
+    sup.close()
+    reg.close()
+
+
+def test_rollback_during_ramp_is_exactly_once():
+    reg = _registry(2)
+    reg.register("rb", _dense_net(seed=7), example_shape=(8,),
+                 max_batch=2, version="v1")
+    reg.warmup("rb")
+    base_committed = _committed(reg)
+    bad = _slo.register_rule(_FakeRule("rb-bad",
+                                       labels={"version": "v2"}))
+    sup = _sup(reg, "rb", max_replicas=1, observe_rounds=1,
+               canary_fraction=0.2, canary_step=0.2, canary_max=0.9)
+    sup.deploy(_dense_net(seed=8), "v2")
+    assert _committed(reg) > base_committed     # canary holds HBM
+    sup.tick(now=2000.0)                        # quiet -> ramp
+    assert reg.canary("rb")["fraction"] == pytest.approx(0.4)
+    assert events.get("controlplane.ramps") >= 1
+    bad.firing = True                           # breach mid-ramp
+    r0 = events.get("controlplane.rollbacks")
+    sup.tick(now=2001.0)
+    assert events.get("controlplane.rollbacks") == r0 + 1
+    assert sup.last_rollback["rule"] == "rb-bad"
+    assert sup.last_rollback["version"] == "v2"
+    assert sup.status()["canary"] is None
+    assert reg.canary("rb") is None
+    assert "rb@v2" not in reg.stats()["models"]
+    # ledger hold released EXACTLY once: back to the primary's
+    # footprint, and neither a second breach tick nor a manual
+    # rollback releases anything again
+    assert _committed(reg) == base_committed
+    sup.tick(now=2002.0)
+    assert sup.rollback() is None
+    assert reg.rollback_version("rb") is None
+    assert _committed(reg) == base_committed
+    assert events.get("controlplane.rollbacks") == r0 + 1
+    # the proactive dump names the incident
+    assert sup.last_rollback["blackbox"]
+    assert os.path.exists(sup.last_rollback["blackbox"])
+    sup.close()
+    reg.close()
+
+
+def test_promote_waits_for_full_quiet_window():
+    reg = _registry(2)
+    reg.register("pm", _dense_net(seed=9), example_shape=(8,),
+                 max_batch=2, version="v1")
+    reg.warmup("pm")
+    base_committed = _committed(reg)
+    noise = _slo.register_rule(_FakeRule("pm-noise"))
+    # max_replicas=1: the noise rule is scale evidence too, and this
+    # test must observe the RAMP gate, not a resize
+    sup = _sup(reg, "pm", watch_rules=("pm-noise",), max_replicas=1,
+               observe_rounds=3, canary_fraction=0.5, canary_max=0.5)
+    sup.deploy(_dense_net(seed=10), "v2")
+    sup.tick(now=3000.0)
+    sup.tick(now=3001.0)            # 2 quiet ticks: window not full
+    assert reg.canary("pm") is not None
+    noise.firing = True             # alert mid-window -> window resets
+    sup.tick(now=3002.0)
+    noise.firing = False
+    sup.tick(now=3003.0)
+    sup.tick(now=3004.0)            # only 2 quiet since the alert
+    assert reg.canary("pm") is not None
+    assert reg.stats()["models"]["pm"]["version"] == "v1"
+    sup.tick(now=3005.0)            # 3rd quiet tick: full window ->
+    assert reg.canary("pm") is None         # promote (at the ceiling)
+    assert reg.stats()["models"]["pm"]["version"] == "v2"
+    assert events.get("controlplane.promotes") >= 1
+    # promote retired the canary entry: its hold released exactly once
+    assert _committed(reg) == base_committed
+    with pytest.raises(ValueError):
+        sup.promote()
+    assert _committed(reg) == base_committed
+    # promoted weights actually serve (the swap, not the label): the
+    # primary's outputs now match the promoted block's params
+    out = reg.submit("pm", _data(1)[0]).result(timeout=30)
+    assert out is not None
+    sup.close()
+    reg.close()
+
+
+def test_all_replicas_unhealthy_forces_rebuild():
+    reg = _registry(2)
+    reg.register("hm", _dense_net(seed=11), example_shape=(8,),
+                 max_batch=2, replicas=2, version="v1")
+    reg.warmup("hm")
+    c0 = _committed(reg)
+    old = reg.engine("hm")
+    old._unhealthy_until = [time.time() + 60.0] * 2
+    assert all(h == "unhealthy"
+               for h in old.stats()["replica_health"])
+    sup = _sup(reg, "hm", max_replicas=2, cooldown_s=30.0)
+    u0 = events.get("controlplane.unhealthy_fleet")
+    sup.tick(now=4000.0)
+    assert events.get("controlplane.unhealthy_fleet") == u0 + 1
+    fresh = reg.engine("hm")
+    assert fresh is not old         # emergency rebuild swapped engines
+    assert all(h == "healthy" for h in fresh.stats()["replica_health"])
+    assert _committed(reg) == c0    # same replica count, same ledger
+    # idempotent under cooldown: the next tick must NOT rebuild again
+    sup.tick(now=4001.0)
+    assert reg.engine("hm") is fresh
+    assert (_bb.last_dump_path() or "").find("unhealthy-hm") >= 0
+    sup.close()
+    reg.close()
+
+
+def test_scale_denied_arms_cooldown_and_releases_nothing():
+    net = _dense_net(seed=12)
+    fp, _ = project_footprint(net, (1, 2), (8,), "float32")
+    cfg.set("MXNET_SERVE_HBM_BUDGET", int(fp * 1.5))
+    try:
+        reg = _registry(1)
+        reg.register("sd", net, example_shape=(8,), max_batch=2)
+        c0 = _committed(reg)
+        rule = _slo.register_rule(_FakeRule("sd-hot"))
+        rule.firing = True
+        sup = _sup(reg, "sd", watch_rules=("sd-hot",), max_replicas=2,
+                   up_rounds=1, cooldown_s=10.0)
+        d0 = events.get("controlplane.scale_denied")
+        sup.tick(now=5000.0)
+        assert events.get("controlplane.scale_denied") == d0 + 1
+        assert _committed(reg) == c0    # denial left no partial hold
+        assert reg.stats()["models"]["sd"]["replicas"] == 1
+        # the denial armed the cooldown: no retry-flap on the next
+        # ticks even though the rule still fires
+        sup.tick(now=5001.0)
+        sup.tick(now=5002.0)
+        assert events.get("controlplane.scale_denied") == d0 + 1
+        sup.close()
+        reg.close()
+    finally:
+        cfg.unset("MXNET_SERVE_HBM_BUDGET")
+
+
+def test_register_version_admission_denied_releases_hold():
+    net = _dense_net(seed=13)
+    fp, _ = project_footprint(net, (1, 2), (8,), "float32")
+    cfg.set("MXNET_SERVE_HBM_BUDGET", int(fp * 1.5))
+    try:
+        reg = _registry(1)
+        reg.register("ad", net, example_shape=(8,), max_batch=2,
+                     version="v1")
+        c0 = _committed(reg)
+        with pytest.raises(AdmissionDenied):
+            reg.register_version("ad", _dense_net(seed=14), "v2")
+        assert _committed(reg) == c0
+        assert reg.canary("ad") is None
+        assert "ad@v2" not in reg.stats()["models"]
+        reg.close()
+    finally:
+        cfg.unset("MXNET_SERVE_HBM_BUDGET")
+
+
+def test_supervisor_watchdog_rules_install_and_uninstall():
+    reg = _registry(1)
+    reg.register("wd", _dense_net(seed=15), max_batch=1)
+    sup = FleetSupervisor(reg, "wd", install_rules=True)
+    names = set(_slo.rules())
+    assert {"ctl-rollback-storm", "ctl-scale-oscillation"} <= names
+    sup.close()
+    assert not ({"ctl-rollback-storm", "ctl-scale-oscillation"}
+                & set(_slo.rules()))
+    reg.close()
+
+
+# -- satellite 5: the chaos gate, wired for CI -------------------------
+@pytest.mark.slow
+def test_check_controlplane_gate():
+    import subprocess
+    import sys
+    root = os.path.abspath(os.path.join(
+        os.path.dirname(__file__), "..", "..", ".."))
+    res = subprocess.run(
+        [sys.executable,
+         os.path.join(root, "tools", "check_controlplane.py"),
+         "--trials", "2"],
+        capture_output=True, text=True, timeout=420, cwd=root)
+    assert res.returncode == 0, \
+        "check_controlplane failed:\n%s\n%s" % (res.stdout, res.stderr)
+    assert ("OK" in res.stdout) or ("SKIP" in res.stdout)

@@ -8,10 +8,33 @@ import socket
 import subprocess
 import sys
 
+import jax
 import pytest
 
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "..", "..", "nightly", "dist_sync_kvstore.py")
+
+
+def _cpu_multiprocess_collectives_supported():
+    """Whether this jax can run cross-process collectives on the CPU
+    backend (same capability probe as test_parallel, ISSUE 8
+    satellite): the worker processes compile multi-process psum
+    computations, which need a CPU collectives transport (gloo/mpi)
+    that jax only wires up where the
+    `jax_cpu_collectives_implementation` config exists (0.5.x+).
+    Without it every worker dies with 'Multiprocess computations
+    aren't implemented on the CPU backend' — a missing CAPABILITY of
+    the installed jax, not a regression in this repo, so these tests
+    skip instead of staining tier-1."""
+    return hasattr(jax.config, "jax_cpu_collectives_implementation")
+
+
+def _skip_unless_dist_capable():
+    if jax.default_backend() == "cpu" and \
+            not _cpu_multiprocess_collectives_supported():
+        pytest.skip("CPU backend lacks multiprocess collectives on "
+                    "this jax (no jax_cpu_collectives_implementation "
+                    "config) — dist kvstore workers cannot compile")
 
 
 def _free_port():
@@ -24,6 +47,7 @@ def _free_port():
 
 @pytest.mark.parametrize("nworkers", [2, 3])
 def test_dist_sync_kvstore_multiprocess(nworkers):
+    _skip_unless_dist_capable()
     port = _free_port()
     procs = []
     for rank in range(nworkers):
@@ -64,6 +88,7 @@ def test_launch_py_runs_dist_workers():
     coordinated workers end to end — here the nightly dist-kvstore
     invariants under it, exactly the reference's usage
     (tools/launch.py -n 2 python dist_sync_kvstore.py)."""
+    _skip_unless_dist_capable()
     import io
     import sys as _sys
     repo = os.path.abspath(os.path.join(
