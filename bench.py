@@ -1468,6 +1468,232 @@ def _fleet_straggler_proof(n_devices, inject_at=4, stale=6, steps=12):
     return out
 
 
+def _bench_prewarm_child():
+    """`--prewarm-child` body: one fresh process against the shared
+    AOT cache dir the parent passed via MXNET_AOT_CACHE_DIR — replay
+    the pre-warm manifest, then run two AOT-cached executables (the
+    cold invocation populates cache + manifest; the warm one must
+    load from disk with zero stale entries).  Prints ONE JSON line of
+    the aot/prewarm counters."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_compilation_cache", False)
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import aot_cache
+    from incubator_mxnet_tpu.compile import prewarm
+    from incubator_mxnet_tpu.monitor import events
+
+    rep = prewarm.replay()
+
+    def mm(w, v):
+        return v @ w
+
+    def act(w, v):
+        return jnp.tanh(v @ w)
+
+    w = jnp.ones((256, 256), jnp.float32)
+    x = jnp.ones((8, 256), jnp.float32)
+    for label, fn in (("bench.prewarm.mm", mm),
+                      ("bench.prewarm.act", act)):
+        f = aot_cache.aot_jit(fn, label=label, kind="bench")
+        jax.block_until_ready(f(w, x))
+    print(json.dumps({
+        "aot_hit": events.get("aot.hit"),
+        "aot_miss": events.get("aot.miss"),
+        "aot_stale": events.get("aot.stale"),
+        "aot_load_disabled": events.get("aot.load_disabled"),
+        "prewarm_hits": rep.get("hits", 0),
+        "prewarm_missing": rep.get("missing", 0),
+        "manifest_entries": rep.get("entries", 0)}))
+
+
+def _compile_loop_proof(n_devices):
+    """ISSUE 18 acceptance, measured: (1) lax.scan layer-stacking
+    collapses N per-layer executables into one with compile-wall AND
+    dispatch reductions and bit parity; (2) the history-trained
+    autotuner's bucket cap beats `costs.suggest_bucket_mb` on >= 2
+    mesh configs by measured step wall (the probes this sweep writes
+    ARE the evidence the tuner reads back — the loop, closed in one
+    run); (3) a fresh process warm-starts from the pre-warm manifest
+    with aot stale=0."""
+    import shutil
+    import subprocess
+    import tempfile
+    import jax as _j
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import gluon, nd, parallel
+    from incubator_mxnet_tpu.compile import autotune, stacking
+    from incubator_mxnet_tpu.telemetry import costs as _tc
+    from incubator_mxnet_tpu.telemetry import history as _hist
+    import incubator_mxnet_tpu as mx
+
+    out = {"ok": False}
+    if not os.environ.get("MXNET_HISTORY_DIR"):
+        os.environ["MXNET_HISTORY_DIR"] = \
+            tempfile.mkdtemp(prefix="mxtpu-bench-hist-")
+        _hist.reset()
+
+    # -- (1) layer-stacking: 8 structurally-identical dense layers.
+    # D=256 sits where BOTH wins are measurable on a host-bound mesh:
+    # at much larger D the per-layer compute hides the per-dispatch
+    # overhead scan removes (and scan's serialization can even lose)
+    sdim, slayers = 256, 8
+
+    def layer(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    rng = np.random.RandomState(18)
+    params = [{"w": jnp.asarray(rng.randn(sdim, sdim)
+                                .astype(np.float32) * 0.05),
+               "b": jnp.zeros((sdim,), jnp.float32)}
+              for _ in range(slayers)]
+    xs = jnp.ones((8, sdim), jnp.float32)
+    m = stacking.measure(layer, params, xs, calls=20,
+                         label="bench.stack")
+    out["stacking"] = m
+    stack_ok = bool(m["parity_ok"]
+                    and m["executables_stacked"]
+                    < m["executables_unstacked"]
+                    and m["compile_wall_stacked_s"]
+                    < m["compile_wall_unstacked_s"]
+                    and m["dispatch_stacked_us"]
+                    <= m["dispatch_unstacked_us"] * 1.05)
+
+    # -- (2) tuned-vs-heuristic bucket cap on 2 mesh configs: sweep a
+    # cap ladder (heuristic included as a candidate), probe each
+    # measured step wall into the durable history, then ask the tuner.
+    # The sweep runs ZeRO-3: the heuristic's 1/32 param-bytes rule was
+    # calibrated on the zero=2 gradient path and is blind to the
+    # forward/backward param all-gathers zero=3 adds — exactly the
+    # traffic shift a history-trained tuner sees and a one-shot
+    # heuristic cannot.  D=2048 puts ~67MB of params behind the cap,
+    # so the heuristic lands MID-ladder (~2MB), not on the clamp floor
+    D, L, CLS = 2048, 4, 16
+
+    def make_net():
+        mx.random.seed(12)
+        net = gluon.nn.HybridSequential(prefix="ct_")
+        for i in range(L):
+            net.add(gluon.nn.Dense(D, in_units=D, activation="relu",
+                                   prefix="ct_d%d_" % i))
+        net.add(gluon.nn.Dense(CLS, in_units=D, prefix="ct_out_"))
+        net.initialize(force_reinit=True)
+        net(nd.ones((2, D)))
+        return net
+
+    def build_tr(ndev, cap_mb):
+        prev = os.environ.get("MXNET_ZERO_BUCKET_MB")
+        os.environ["MXNET_ZERO_BUCKET_MB"] = str(cap_mb)
+        try:
+            mesh = parallel.make_mesh((ndev,), ("data",),
+                                      devices=_j.devices()[:ndev])
+            tr = parallel.ShardedTrainer(make_net(), optimizer="adam",
+                                         lr=1e-3, mesh=mesh, zero=3)
+            x = np.random.randn(ndev * 2, D).astype(np.float32)
+            y = np.random.randint(0, CLS, ndev * 2)
+            _j.block_until_ready(tr.step(x, y))     # warm compile
+            return tr, x, y
+        finally:
+            if prev is None:
+                os.environ.pop("MXNET_ZERO_BUCKET_MB", None)
+            else:
+                os.environ["MXNET_ZERO_BUCKET_MB"] = prev
+
+    tune_cfgs = []
+    beats = 0
+    cfg_sizes = sorted({min(4, n_devices), n_devices}) or [2]
+    if len(cfg_sizes) == 1:
+        cfg_sizes = sorted({2, cfg_sizes[0]})
+    for ndev in cfg_sizes:
+        label = "bench.tune.nd%d" % ndev
+        first = build_tr(ndev, 1.0)
+        total = sum(v.nbytes for v in first[0].params.values())
+        heur = _tc.suggest_bucket_mb(total, ndev)
+        caps = sorted({1.0, 4.0, 16.0, round(float(heur), 2)})
+        cfgs = {1.0: first}
+        for cap in caps:
+            if cap not in cfgs:
+                cfgs[cap] = build_tr(ndev, cap)
+        # interleaved best-of (the MULTICHIP sweep discipline): one VM
+        # hiccup cannot poison a single cap's number
+        walls = {cap: float("inf") for cap in caps}
+        for _ in range(4):
+            for cap in caps:
+                tr, x, y = cfgs[cap]
+                t0 = time.perf_counter()
+                for _ in range(3):
+                    loss = tr.step(x, y)
+                _j.block_until_ready(loss)
+                walls[cap] = min(
+                    walls[cap],
+                    (time.perf_counter() - t0) / 3 * 1e6)
+        del cfgs, first             # free this mesh's trainers
+        for cap in caps:
+            autotune.note_probe("zero_bucket_mb", label, cap,
+                                walls[cap])
+        tuned = autotune.suggest_bucket_cap(total, ndev, label=label,
+                                            ladder=caps)
+        heur_key = round(float(heur), 2)
+        cfg = {"n_devices": ndev, "param_bytes": int(total),
+               "heuristic_cap_mb": heur_key,
+               "tuned_cap_mb": float(tuned),
+               "tuned_source": autotune.decisions()[-1]["source"],
+               "heuristic_step_us": int(walls[heur_key]),
+               "tuned_step_us": int(walls[float(tuned)]),
+               "caps_swept": {str(c): int(w)
+                              for c, w in walls.items()}}
+        cfg["beat_heuristic"] = bool(cfg["tuned_step_us"]
+                                     < cfg["heuristic_step_us"])
+        beats += int(cfg["beat_heuristic"])
+        tune_cfgs.append(cfg)
+    out["autotune"] = {"configs": tune_cfgs,
+                       "configs_beating_heuristic": beats}
+    tune_ok = beats >= 2
+
+    # -- (3) manifest warm-start: two fresh child processes share one
+    # AOT cache dir; the warm one must replay the manifest and load
+    # every executable from disk (stale=0)
+    cache = tempfile.mkdtemp(prefix="mxtpu-bench-prewarm-")
+    try:
+        env = dict(os.environ, MXNET_AOT_CACHE_DIR=cache,
+                   JAX_PLATFORMS="cpu", MXNET_PREWARM="1")
+        env.pop(_MULTICHIP_CHILD_MARK, None)
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--prewarm-child"]
+        here = os.path.dirname(os.path.abspath(__file__))
+        runs = []
+        for _ in range(2):
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=300, env=env, cwd=here)
+            line = next((ln for ln in reversed(
+                (res.stdout or "").strip().splitlines())
+                if ln.startswith("{")), None)
+            if line is None:
+                raise RuntimeError("prewarm child rc=%d: %s"
+                                   % (res.returncode,
+                                      (res.stderr or "")[-200:]))
+            runs.append(json.loads(line))
+        cold, warm = runs
+        out["prewarm"] = {"cold": cold, "warm": warm}
+        warm_ok = bool(warm["aot_stale"] == 0 and warm["aot_hit"] > 0
+                       and warm["prewarm_hits"] > 0
+                       and warm["manifest_entries"] > 0)
+        if warm["aot_load_disabled"] > 0:
+            # PR 7 jaxlib load breaker: an environment waiver, the
+            # check_feed/fleet-trace convention
+            out["prewarm"]["waived_host"] = "aot load breaker tripped"
+            warm_ok = None
+    finally:
+        shutil.rmtree(cache, ignore_errors=True)
+
+    out["stacking_ok"] = stack_ok
+    out["autotune_ok"] = tune_ok
+    out["prewarm_ok"] = warm_ok
+    out["ok"] = bool(stack_ok and tune_ok
+                     and warm_ok is not False)
+    return out
+
+
 _MULTICHIP_CHILD_MARK = "_BENCH_MULTICHIP_CHILD"
 
 
@@ -1492,10 +1718,12 @@ def run_multichip(n_devices=8):
         env.setdefault("MXNET_BLACKBOX_DIR", "/tmp")
         cmd = [sys.executable, os.path.abspath(__file__),
                "--multichip-child", str(n_devices)]
-        # 600s: the sweep plus the ISSUE 11 fleet proof (an elastic
-        # run + a 2-worker decode service) in one child
+        # 900s: the sweep plus the ISSUE 11 fleet proof (an elastic
+        # run + a 2-worker decode service) plus the ISSUE 18 compile
+        # proof (a bucket-cap sweep + two pre-warm children) in one
+        # child
         res = subprocess.run(cmd, capture_output=True, text=True,
-                             timeout=600, env=env,
+                             timeout=900, env=env,
                              cwd=os.path.dirname(os.path.abspath(__file__)))
         for line in reversed((res.stdout or "").strip().splitlines()
                              or [""]):
@@ -1661,6 +1889,14 @@ def _multichip_scenario(n_devices):
     except Exception as e:          # noqa: BLE001
         out["fleet"] = {"ok": False, "error": ("%s: %s" % (
             type(e).__name__, e))[:200]}
+    # compile-loop proof (ISSUE 18): layer-stacking deltas + parity,
+    # autotuned-vs-heuristic bucket cap on 2 mesh configs, pre-warm
+    # manifest warm-start.  Same guard discipline as the fleet proof
+    try:
+        out["compile"] = _compile_loop_proof(n_devices)
+    except Exception as e:          # noqa: BLE001
+        out["compile"] = {"ok": False, "error": ("%s: %s" % (
+            type(e).__name__, e))[:200]}
     print(json.dumps(out))
     return out
 
@@ -1692,12 +1928,20 @@ def _write_multichip_scaling(parsed, rc=0):
     parsed["weak_eff_target_waived_host_bound"] = (not target_met
                                                    and waived)
     fleet = parsed.get("fleet", {})
+    comp = parsed.get("compile", {})
+    cstack = comp.get("stacking", {})
+    ctune = comp.get("autotune", {})
+    cwarm = (comp.get("prewarm") or {}).get("warm", {})
     tail = ("multichip scaling: weak_eff=%.2f (legacy %.2f, %.1fx) "
             "zero=%s sched=%s buckets cap=%.1fMB zero3 param "
             "bytes/replica=%.0f%% of unsharded, %d collective rows, "
             "%d host cores%s\n"
             "fleet: straggler r%s detected@step%s (heartbeat would "
             "say slow@step%s), trace merge %s proc / steps %s -> %s\n"
+            "compile: stack %s exes -> %s (compile wall %.2fs -> "
+            "%.2fs, dispatch %sus -> %sus, parity %s), tuner beat "
+            "heuristic on %s/2 cfgs, warm-start stale=%s "
+            "prewarm_hits=%s -> %s\n"
             % (eff, eff_l, parsed.get("weak_eff_gain", 0.0),
                parsed.get("zero_level"),
                parsed.get("overlap_schedule"),
@@ -1711,11 +1955,23 @@ def _write_multichip_scaling(parsed, rc=0):
                fleet.get("heartbeat_slow_step", "?"),
                fleet.get("trace_processes", 0),
                fleet.get("trace_cross_process_steps", []),
-               "ok" if fleet.get("ok") else "FAILED"))
+               "ok" if fleet.get("ok") else "FAILED",
+               cstack.get("executables_unstacked", "?"),
+               cstack.get("executables_stacked", "?"),
+               cstack.get("compile_wall_unstacked_s", 0.0),
+               cstack.get("compile_wall_stacked_s", 0.0),
+               cstack.get("dispatch_unstacked_us", "?"),
+               cstack.get("dispatch_stacked_us", "?"),
+               cstack.get("parity_ok", "?"),
+               ctune.get("configs_beating_heuristic", 0),
+               cwarm.get("aot_stale", "?"),
+               cwarm.get("prewarm_hits", "?"),
+               "ok" if comp.get("ok") else "FAILED"))
     blob = {"n_devices": parsed.get("multichip_devices", 0), "rc": rc,
             "ok": (rc == 0 and exercised and improved
                    and (target_met or waived)
-                   and bool(fleet.get("ok"))),
+                   and bool(fleet.get("ok"))
+                   and bool(comp.get("ok"))),
             "skipped": False, "tail": tail, "parsed": parsed}
     here = os.path.dirname(os.path.abspath(__file__))
     with open(os.path.join(here, "MULTICHIP_scaling.json"), "w") as fh:
@@ -3296,6 +3552,11 @@ if __name__ == "__main__":
     if len(sys.argv) >= 2 and sys.argv[1] == "--multichip-child":
         # marked child of run_multichip (same virtual-platform recipe)
         _multichip_scenario(int(sys.argv[2]))
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--prewarm-child":
+        # fresh-process warm-start probe against the shared AOT cache
+        # dir in MXNET_AOT_CACHE_DIR (ISSUE 18 compile proof)
+        _bench_prewarm_child()
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "quant":
         # standalone quant bench (ISSUE 15): ONE JSON line; quant_*

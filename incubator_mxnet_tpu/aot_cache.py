@@ -94,26 +94,37 @@ def trim_cache(max_entries=None):
     unbounded).  Cache hits refresh mtime, so recently-served
     executables survive — the bound long-lived serving hosts need
     (every new model/bucket/shape otherwise grows the dir forever).
-    Best-effort and race-tolerant (concurrent processes may evict the
-    same entry); returns the number of entries removed."""
+    Blobs listed in the pre-warm manifest (ISSUE 18) are the declared
+    cross-process working set: they evict LAST — every unlisted blob
+    goes first, and a manifest replay refreshes their mtimes (hit
+    semantics), so pre-warmed executables survive churn from one-off
+    signatures.  Best-effort and race-tolerant (concurrent processes
+    may evict the same entry); returns the number of entries removed."""
     if max_entries is None:
         max_entries = int(_cfg.get("MXNET_AOT_CACHE_MAX"))
     d = cache_dir()
     if not d or max_entries <= 0:
         return 0
+    protected = set()
+    try:
+        from .compile import prewarm as _pw
+        protected = _pw.listed_blobs(d)
+    except Exception:           # noqa: BLE001 — the manifest is
+        pass                    # forensic garnish, never a blocker
     try:
         entries = []
         for name in os.listdir(d):
             if not name.endswith(".pjrtx"):
                 continue
             try:
-                entries.append((os.path.getmtime(os.path.join(d, name)),
+                entries.append((name in protected,
+                                os.path.getmtime(os.path.join(d, name)),
                                 name))
             except OSError:
                 continue        # concurrently evicted/renamed
-        entries.sort()          # oldest mtime first
+        entries.sort()          # unlisted first, then oldest mtime
         removed = 0
-        for _, name in entries[:max(0, len(entries) - max_entries)]:
+        for _, _, name in entries[:max(0, len(entries) - max_entries)]:
             try:
                 os.remove(os.path.join(d, name))
                 removed += 1
@@ -122,6 +133,17 @@ def trim_cache(max_entries=None):
         return removed
     except OSError:
         return 0
+
+
+def _note_prewarm(label, kind, path):
+    """File this (label, blob) pair in the pre-warm manifest (ISSUE
+    18) after a successful compile-or-load — the cross-process memory
+    `compile/prewarm.replay()` and a fresh serving warmup read."""
+    try:
+        from .compile import prewarm as _pw
+        _pw.note(label, os.path.basename(path), exe_kind=kind)
+    except Exception:               # noqa: BLE001 — best-effort
+        pass
 
 
 def _stale_reason(exc) -> str:
@@ -308,6 +330,7 @@ class _AotJitted:
                                     _t.perf_counter() - t2)
                 self._note_cost(sig, lowered, out,
                                 _t.perf_counter() - t2, loaded=True)
+                _note_prewarm(self._label, self._kind, path)
                 if dbg:
                     print("[aot] HIT lower=%.1fs key=%.1fs load=%.1fs"
                           % (t1 - t0, t2 - t1, _t.perf_counter() - t2))
@@ -360,6 +383,7 @@ class _AotJitted:
             with open(tmp, "wb") as f:
                 f.write(blob)
             os.replace(tmp, path)       # atomic: concurrent procs race safely
+            _note_prewarm(self._label, self._kind, path)
             trim_cache()                # keep-K bound (MXNET_AOT_CACHE_MAX)
             if not _SELF_VERIFIED[0]:
                 # one round trip per process: prove THIS backend can

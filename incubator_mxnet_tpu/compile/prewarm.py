@@ -1,0 +1,223 @@
+"""Pre-warmed shared AOT cache (ISSUE 18 tentpole part 3).
+
+The executable disk cache (aot_cache.py) already makes the SECOND
+process that needs an executable fast — but only once that process
+gets around to tracing the same signature organically.  This module
+closes the remaining gap with a persistent, cross-process MANIFEST of
+what the cache holds: every successful compile-or-load appends one
+``(label, signature, blob)`` line, and any later process — serving
+warmup, ``bench.py``, the test suite — can replay the manifest before
+first traffic:
+
+- ``replay()`` touches every manifest-listed blob that still exists
+  (an mtime refresh, i.e. the same LRU credit a real hit earns — the
+  keep-K eviction in ``aot_cache.trim_cache`` additionally evicts
+  UNLISTED blobs first, so a pre-warmed working set survives churn).
+- ``serve_hint(label)`` recovers the example shape / wire dtype /
+  bucket ladder a previous process warmed a serving engine with, so
+  ``ServingEngine.warmup()`` no longer needs ``example_shape=`` on a
+  warm cache: the manifest IS the signature memory.
+
+Format: ``prewarm-manifest.jsonl`` inside the AOT cache dir —
+append-only JSONL, no cross-process locking (the history.py shard
+discipline: concurrent writers append whole lines; torn tail lines
+are skipped on read; newest entry wins per key).  Best-effort
+everywhere: a missing/corrupt manifest degrades to the pre-ISSUE-18
+behavior, never an error.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .. import config as _cfg
+from ..monitor import events
+from ..telemetry import flightrec as _bb
+
+__all__ = ["manifest_path", "enabled", "note", "note_serve", "entries",
+           "listed_blobs", "serve_hint", "replay", "stats", "reset"]
+
+MANIFEST_NAME = "prewarm-manifest.jsonl"
+
+_LOCK = threading.Lock()
+_NOTED = set()                  # (label, blob) this process appended
+_STATS = {"noted": 0, "replays": 0, "hits": 0, "missing": 0}
+
+
+def manifest_path(directory=None) -> str:
+    """The manifest file path ('' when no AOT cache dir is set —
+    a manifest describes blobs, so it lives next to them)."""
+    d = directory if directory is not None \
+        else str(_cfg.get("MXNET_AOT_CACHE_DIR") or "")
+    if not d:
+        return ""
+    return os.path.join(d, MANIFEST_NAME)
+
+
+def enabled() -> bool:
+    return bool(_cfg.get("MXNET_PREWARM")) and \
+        bool(_cfg.get("MXNET_AOT_CACHE_DIR"))
+
+
+def _append(entry, directory=None):
+    path = manifest_path(directory)
+    if not path:
+        return 0
+    entry = dict(entry, ts=time.time())
+    line = json.dumps(entry, sort_keys=True, default=str) + "\n"
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a") as f:
+            f.write(line)
+    except OSError:
+        return 0
+    with _LOCK:
+        _STATS["noted"] += 1
+    events.incr("prewarm.noted")
+    return 1
+
+
+def note(label, blob, exe_kind="aot", directory=None):
+    """Record one (label, blob) pair after a successful compile-or-load
+    (aot_cache calls this).  Deduplicated per process; no-op when the
+    manifest is disabled."""
+    if directory is None and not enabled():
+        return 0
+    key = (str(label), str(blob))
+    with _LOCK:
+        if key in _NOTED:
+            return 0
+        _NOTED.add(key)
+    return _append({"kind": "blob", "label": str(label),
+                    "exe_kind": str(exe_kind), "blob": str(blob)},
+                   directory)
+
+
+def note_serve(label, example_shape, wire_dtype, buckets,
+               directory=None):
+    """Record a serving engine's warmup signature — example shape,
+    wire dtype, bucket ladder — so a LATER process's ``warmup()`` can
+    recover it from the manifest instead of requiring the operator to
+    repeat ``example_shape=``."""
+    if directory is None and not enabled():
+        return 0
+    return _append({"kind": "serve", "label": str(label),
+                    "example_shape": [int(d) for d in example_shape],
+                    "wire_dtype": str(wire_dtype),
+                    "buckets": [int(b) for b in buckets]},
+                   directory)
+
+
+def entries(label_prefix=None, directory=None):
+    """The manifest, read and deduplicated (newest wins per key:
+    ``(label, blob)`` for blob entries, ``label`` for serve entries).
+    Torn tail lines of a killed writer are skipped, never raised."""
+    path = manifest_path(directory)
+    if not path:
+        return []
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return []
+    dedup = {}
+    for ln in lines:
+        if not ln:
+            continue
+        try:
+            e = json.loads(ln)
+        except ValueError:
+            continue                # torn tail line
+        if not isinstance(e, dict):
+            continue
+        label = str(e.get("label", ""))
+        if label_prefix is not None and \
+                not label.startswith(str(label_prefix)):
+            continue
+        if e.get("kind") == "serve":
+            dedup[("serve", label)] = e
+        else:
+            dedup[("blob", label, str(e.get("blob", "")))] = e
+    out = list(dedup.values())
+    out.sort(key=lambda e: e.get("ts", 0))
+    return out
+
+
+def listed_blobs(directory=None):
+    """Blob basenames the manifest lists — ``trim_cache`` evicts
+    everything else first."""
+    return {str(e["blob"]) for e in entries(directory=directory)
+            if e.get("kind") == "blob" and e.get("blob")}
+
+
+def serve_hint(label, directory=None):
+    """The newest serve entry for ``label`` (exact match), or None —
+    the warmup-signature memory a fresh serving process replays."""
+    best = None
+    for e in entries(directory=directory):
+        if e.get("kind") == "serve" and str(e.get("label")) == \
+                str(label):
+            best = e
+    return best
+
+
+def replay(label_prefix=None, directory=None):
+    """Replay the manifest against the blob store: refresh the mtime of
+    every listed blob that still exists (hit semantics — the same LRU
+    credit `aot_cache`'s real hit path gives), count the missing ones,
+    and leave a ring event naming the outcome.  The actual
+    deserialize still happens lazily through ``aot_jit`` when the
+    executable is first needed; this makes the eviction order and the
+    hit accounting see the pre-warm NOW, before first traffic.
+
+    Returns ``{"entries", "hits", "missing", "serve_hints"}``."""
+    d = directory if directory is not None \
+        else str(_cfg.get("MXNET_AOT_CACHE_DIR") or "")
+    ents = entries(label_prefix=label_prefix, directory=d or None)
+    hits = missing = serve_hints = 0
+    for e in ents:
+        if e.get("kind") == "serve":
+            serve_hints += 1
+            continue
+        blob = str(e.get("blob", ""))
+        path = os.path.join(d, blob) if d else ""
+        if path and os.path.exists(path):
+            try:
+                os.utime(path)
+            except OSError:
+                pass
+            hits += 1
+        else:
+            missing += 1
+    with _LOCK:
+        _STATS["replays"] += 1
+        _STATS["hits"] += hits
+        _STATS["missing"] += missing
+    events.incr("prewarm.replays")
+    if hits:
+        events.incr("prewarm.hit", hits)
+    if missing:
+        events.incr("prewarm.missing", missing)
+    out = {"entries": len(ents), "hits": hits, "missing": missing,
+           "serve_hints": serve_hints}
+    _bb.record("prewarm", "replay", label=str(label_prefix or "*"),
+               **out)
+    return out
+
+
+def stats():
+    """This process's manifest activity (the blackbox autotune block's
+    ``prewarm`` line)."""
+    with _LOCK:
+        return dict(_STATS)
+
+
+def reset():
+    """Tests: drop the per-process dedup/stat state (a new manifest
+    dir takes full effect)."""
+    with _LOCK:
+        _NOTED.clear()
+        for k in _STATS:
+            _STATS[k] = 0

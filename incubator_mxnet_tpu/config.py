@@ -494,10 +494,30 @@ register("MXNET_ZERO_BUCKET_MB", float, 0.0,
          "Gradient-bucket size cap in MB for the ZeRO-2/3 "
          "reduce-scatter (parallel/zero.py): grads of small/indivisible "
          "params are concatenated into buckets no larger than this "
-         "before their collective launches.  0 = auto: steered by the "
-         "cost registry's measured per-step bytes when a train-step "
-         "row exists (costs.suggest_bucket_mb), else a 4 MB default "
-         "that sits below the backend's large-collective cliff")
+         "before their collective launches.  0 = auto: the compile "
+         "autotuner (compile/autotune.py) picks the cap from measured "
+         "cross-run history — probe rows first, then cost rows — "
+         "falling back to the one-shot costs.suggest_bucket_mb "
+         "heuristic (which then warns that it was the deciding input) "
+         "when history is cold")
+register("MXNET_AUTOTUNE", bool, True,
+         "History-trained autotuner (compile/autotune.py): resolve "
+         "executable-shaping knobs (ZeRO bucket cap, batch size, "
+         "serve bucket ladders, donation, remat) from measured "
+         "kind=\"autotune\" probe rows and kind=\"cost\" executable "
+         "rows persisted across runs under MXNET_HISTORY_DIR, with "
+         "typed autotune/decision records (ring event + history row + "
+         "blackbox block).  0 = every suggest_* returns its fallback "
+         "(the pre-ISSUE-18 heuristics) and records nothing")
+register("MXNET_PREWARM", bool, True,
+         "Pre-warm manifest (compile/prewarm.py): record every "
+         "successful AOT compile-or-load as a (label, blob) line in "
+         "prewarm-manifest.jsonl inside MXNET_AOT_CACHE_DIR, plus "
+         "serving warmup signatures, so later processes replay the "
+         "manifest (mtime-refresh hit semantics; eviction protects "
+         "listed blobs) and serving warmup recovers its example "
+         "signature with no operator input.  Requires the AOT cache "
+         "dir; 0 = manifest neither written nor read")
 register("MXNET_ZERO_SOLO_KB", int, 256,
          "Param size in KB above which a param with a data-divisible "
          "axis gets its OWN reduce-scatter along that axis (no "

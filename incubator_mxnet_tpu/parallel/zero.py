@@ -116,10 +116,20 @@ class BucketPlan:
                        else _cfg.get("MXNET_ZERO_BUCKET_MB"))
         total = sum(int(_np.prod(s)) * itemsize for s in shapes.values())
         if cap_mb <= 0:
-            # cost-registry steering: a measured row for this step
-            # family sets the cap from real per-step bytes
-            cap_mb = _costs.suggest_bucket_mb(total, n_shards,
-                                              label_prefix=label)
+            # compile-loop steering (ISSUE 18): the autotuner resolves
+            # the cap from measured cross-run history (probe rows,
+            # then cost rows), falling back to the one-shot registry
+            # heuristic when history is cold — which then warns that
+            # it was the deciding input
+            try:
+                from ..compile import autotune as _autotune
+                cap_mb = _autotune.suggest_bucket_cap(total, n_shards,
+                                                      label=label)
+            except Exception:   # noqa: BLE001 — the tuner is
+                # best-effort; a broken history dir must not block
+                # building the plan
+                cap_mb = _costs.suggest_bucket_mb(total, n_shards,
+                                                  label_prefix=label)
         self.cap_bytes = int(cap_mb * 1e6)
         self.cap_mb = cap_mb
         solo_min = int(solo_min_kb if solo_min_kb is not None

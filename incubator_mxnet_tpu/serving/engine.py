@@ -1274,9 +1274,31 @@ class InferenceEngine:
         dict; after it, `serve.traces` stays flat under any mix of
         request sizes ≤ the largest bucket."""
         if self._example_shape is None and example_shape is None:
-            raise ValueError(
-                "warmup() before any request needs example_shape= "
-                "(and wire_dtype=) — the executable signature")
+            # pre-warm manifest (ISSUE 18): a previous process that
+            # warmed this cost label recorded its signature — replay
+            # it so a fresh serving host warms with no operator input
+            # (and its bucket executables resolve straight off the
+            # shared AOT disk cache, stale=0)
+            hint = None
+            try:
+                from ..compile import prewarm as _prewarm
+                hint = _prewarm.serve_hint(self._cost_label)
+            except Exception:       # noqa: BLE001 — the manifest is
+                hint = None         # advisory, never a blocker
+            if hint and hint.get("example_shape") is not None:
+                example_shape = tuple(hint["example_shape"])
+                wire_dtype = wire_dtype or hint.get("wire_dtype")
+                events.incr("serve.warmup_from_manifest")
+                _bb.record("serve", "warmup_manifest",
+                           label=self._cost_label,
+                           shape=str(example_shape),
+                           dtype=str(wire_dtype))
+            else:
+                raise ValueError(
+                    "warmup() before any request needs example_shape= "
+                    "(and wire_dtype=) — the executable signature "
+                    "(no pre-warm manifest entry for label %r either)"
+                    % self._cost_label)
         # route through the SAME signature gate as submits: a warmup
         # conflicting with an already-locked shape/dtype must raise,
         # not silently re-point the executable set away from traffic
@@ -1286,6 +1308,14 @@ class InferenceEngine:
             wire_dtype or self._wire_dtype or "float32")
         dtype = _np.dtype(self._wire_dtype)
         t0 = time.monotonic()
+        try:
+            # refresh the manifest-listed blobs' LRU credit before the
+            # loads below (hit semantics, ISSUE 18) — a long-lived
+            # host's keep-K trim must not evict the warm set first
+            from ..compile import prewarm as _prewarm
+            _prewarm.replay(label_prefix=self._cost_label)
+        except Exception:           # noqa: BLE001
+            _prewarm = None
         per_bucket = {}
         for i in range(len(self._ctxs)):
             for b in self._buckets:
@@ -1295,6 +1325,15 @@ class InferenceEngine:
                 per_bucket[b] = round(time.monotonic() - tb, 4)
         self._warm = True
         events.incr("serve.warmups")
+        if _prewarm is not None:
+            try:
+                # durably record THIS warmup's signature so the next
+                # process can warm from the manifest alone
+                _prewarm.note_serve(self._cost_label,
+                                    self._example_shape,
+                                    self._wire_dtype, self._buckets)
+            except Exception:       # noqa: BLE001
+                pass
         return {"buckets": list(self._buckets),
                 "devices": len(self._ctxs),
                 "wall_s": round(time.monotonic() - t0, 3),

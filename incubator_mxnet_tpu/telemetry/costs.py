@@ -157,8 +157,11 @@ def note_collective(label, op, wire_bytes, n_shards, dtype="float32"):
     return key
 
 
+_HEURISTIC_WARNED = set()
+
+
 def suggest_bucket_mb(param_bytes, n_shards, label_prefix=None,
-                      default_mb=4.0):
+                      default_mb=4.0, deciding=False):
     """Bucket-size cap steering (ISSUE 10 tentpole b): pick the
     MXNET_ZERO_BUCKET_MB default from measured per-executable bytes.
 
@@ -170,7 +173,29 @@ def suggest_bucket_mb(param_bytes, n_shards, label_prefix=None,
     each well under the backend's large-collective cliff.  Without a
     row, the same 1/32 rule applies to the param bytes themselves.
     Clamped to [1, 16] MB; an explicit MXNET_ZERO_BUCKET_MB (> 0)
-    always wins at the call site."""
+    always wins at the call site.
+
+    ISSUE 18 deprecation shim: the compile autotuner
+    (compile/autotune.py) is the default steering now, and this
+    one-shot heuristic survives as its COLD-HISTORY fallback.
+    ``deciding=True`` is the autotuner saying "no measured evidence
+    existed — this heuristic's answer is the deciding input": that
+    warns once per label (so tuned-vs-heuristic provenance is visible
+    in the blackbox via the `autotune/heuristic_fallback` ring event)
+    without penalizing advisory callers."""
+    if deciding:
+        key = str(label_prefix or "<unlabeled>")
+        if key not in _HEURISTIC_WARNED:
+            _HEURISTIC_WARNED.add(key)
+            from . import flightrec as _bb
+            _bb.record("autotune", "heuristic_fallback", label=key)
+            import warnings
+            warnings.warn(
+                "costs.suggest_bucket_mb is the DECIDING input for "
+                "executable %r: the autotune history holds no measured "
+                "probe/cost rows for it yet — the one-shot heuristic "
+                "steers this build; run with MXNET_HISTORY_DIR set so "
+                "the next run tunes from measurements" % key)
     basis = float(param_bytes)
     if label_prefix:
         bracket = label_prefix + "["

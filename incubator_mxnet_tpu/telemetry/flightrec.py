@@ -384,6 +384,17 @@ def dump_blackbox(path=None, reason="manual", exc=None, last=None):
             ctl_block = ctl_mod.status_block() or None
     except Exception:               # noqa: BLE001
         ctl_block = None
+    # the compile-loop decisions (ISSUE 18): same already-imported
+    # guard — a run that never tuned must not pull the compile
+    # subsystem in just to say "no decisions"
+    tune_block = None
+    try:
+        tune_mod = sys.modules.get(
+            "incubator_mxnet_tpu.compile.autotune")
+        if tune_mod is not None:
+            tune_block = tune_mod.block() or None
+    except Exception:               # noqa: BLE001
+        tune_block = None
     evs = ring_snapshot(last=last)
     doc = {
         "schema": SCHEMA,
@@ -399,6 +410,7 @@ def dump_blackbox(path=None, reason="manual", exc=None, last=None):
         "fleet": fleet,
         "slo": slo_block,
         "controlplane": ctl_block,
+        "autotune": tune_block,
         "hbm": {"peaks": hbm_peaks()},
         "events": evs,
         "trace": {"traceEvents": _chrome_view(evs),

@@ -66,7 +66,8 @@ import json
 import sys
 import time
 
-from .teletop import _fleet_lines, _fmt_qty, _slo_lines
+from .teletop import (_autotune_lines, _fleet_lines, _fmt_qty,
+                      _slo_lines)
 
 __all__ = ["load_dump", "render", "suspected_cause", "merge_traces",
            "verify_main", "merge_main", "history_main", "sparkline",
@@ -266,6 +267,11 @@ def render(doc: dict, events_tail=40) -> str:
                             _fmt_qty(t.get("cum_flops", 0)),
                             _fmt_qty(t.get("cum_bytes", 0), "B"),
                             t.get("compile_wall_s", 0)))
+
+    # the compile-loop decisions (ISSUE 18) render next to the cost
+    # table they were trained on: chosen config, evidence tier, the
+    # tuned-vs-heuristic provenance, manifest hit counts
+    lines += _autotune_lines(doc.get("autotune"))
 
     peaks = doc.get("hbm", {}).get("peaks", {})
     if peaks:

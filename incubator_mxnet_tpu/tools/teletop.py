@@ -135,6 +135,38 @@ def _cost_lines(costs):
     return lines
 
 
+def _autotune_lines(tune):
+    """The compile-loop block (ISSUE 18) as table rows, next to the
+    cost table: one line per autotune decision — knob, label, chosen
+    value, evidence tier, the heuristic's answer (the tuned-vs-
+    heuristic delta an operator audits) — plus the pre-warm manifest
+    activity (replayed hits / noted / missing)."""
+    if not tune:
+        return []
+    decs = tune.get("decisions") or []
+    pw = tune.get("prewarm") or {}
+    if not decs and not any(pw.values()):
+        return []
+    lines = ["", "autotune (%d decision(s))" % len(decs),
+             "%-14s %-22s %12s %-10s %12s"
+             % ("knob", "label", "chosen", "source", "heuristic"),
+             "-" * 78]
+    for d in decs[-15:]:
+        heur = d.get("heuristic")
+        lines.append("%-14s %-22s %12s %-10s %12s"
+                     % (str(d.get("knob", "?"))[:14],
+                        str(d.get("label", ""))[:22],
+                        str(d.get("chosen", "?"))[:12],
+                        str(d.get("source", "?"))[:10],
+                        "" if heur is None else str(heur)[:12]))
+    if any(pw.values()):
+        lines.append("%-14s %s" % (
+            "prewarm", "%d replayed hit(s) / %d noted / %d missing"
+            % (pw.get("hits", 0), pw.get("noted", 0),
+               pw.get("missing", 0))))
+    return lines
+
+
 def _fleet_lines(fleet):
     """The merged per-replica fleet view (ISSUE 11) as one table:
     a row per replica — step, step/dispatch/collective µs, HBM peak,
@@ -230,6 +262,11 @@ def render(snap: dict, prefix: str = "") -> str:
         # exporter snapshot carries rows+totals — render what's there
         lines += _cost_lines(costs if "rows" in costs
                              else {"rows": [], "totals": costs})
+
+    # the compile-loop decisions ride next to the cost table they
+    # were trained on (blackbox dumps carry the block; a live
+    # exporter snapshot without one contributes no rows)
+    lines += _autotune_lines(snap.get("autotune"))
 
     lines += _fleet_lines(snap.get("fleet"))
     lines += _slo_lines(snap.get("slo"))

@@ -132,6 +132,13 @@ def pytest_configure(config):
         "markers", "controlplane: SLO-driven fleet-supervisor "
         "(autoscaling / canary deploy / rollback) tests (CPU-fast, "
         "run in tier-1 by default)")
+    # the compile loop (ISSUE 18): history-trained autotuner,
+    # lax.scan layer-stacking parity, pre-warm manifest replay; the
+    # check_compile gate wrapper itself is slow-marked
+    config.addinivalue_line(
+        "markers", "compile: compile-loop (autotuner / stacking / "
+        "pre-warm manifest) tests (CPU-fast, run in tier-1 by "
+        "default)")
 
 
 @pytest.fixture(autouse=True)
