@@ -48,7 +48,14 @@ __all__ = ["enabled", "note_probe", "measured_candidates", "suggest",
            "suggest_bucket_cap", "suggest_batch_size",
            "suggest_serve_buckets", "suggest_donate", "suggest_remat",
            "decisions", "block", "reset", "BUCKET_CAP_LADDER",
-           "SEARCH_SPACE"]
+           "SEARCH_SPACE", "invalidate", "invalidated",
+           "prior_decision", "drift_evidence", "DRIFT_FACTOR"]
+
+#: contradiction factor for the cost-drift alert (ISSUE 19 satellite):
+#: a new run's measured evidence more than this factor away from what
+#: a prior decision recorded (in either direction) means the decision
+#: no longer rests on reality
+DRIFT_FACTOR = 2.0
 
 #: candidate ZeRO bucket caps in MB (the MXNET_ZERO_BUCKET_MB clamp
 #: range [1, 16], log-spaced — the granularity the probe sweeps walk)
@@ -70,10 +77,47 @@ SEARCH_SPACE = {
 
 _LOCK = threading.Lock()
 _DECISIONS = []                 # process-local decision log (blackbox)
+_INVALIDATED = set()            # (knob, label) flagged by a fired
+                                # cost-drift rule: the next suggest for
+                                # the key re-resolves from THIS run's
+                                # evidence only
 
 
 def enabled() -> bool:
     return bool(_cfg.get("MXNET_AUTOTUNE"))
+
+
+def _current_run():
+    """This process's history run id (None when history is off)."""
+    if not _hist.enabled():
+        return None
+    try:
+        return _hist.get_writer().run
+    except Exception:           # noqa: BLE001
+        return None
+
+
+def invalidate(knob, label):
+    """Flag (knob, label): its prior evidence contradicted a new run's
+    measurements (the cost-drift rule fired) — the next ``suggest``
+    for the key must re-resolve from current-run evidence and record
+    the flip as a ``*-refresh`` decision."""
+    with _LOCK:
+        _INVALIDATED.add((str(knob), str(label or "")))
+
+
+def invalidated(knob=None, label=None):
+    """With arguments: is (knob, label) flagged?  Without: the sorted
+    list of flagged (knob, label) pairs."""
+    with _LOCK:
+        if knob is None:
+            return sorted(_INVALIDATED)
+        return (str(knob), str(label or "")) in _INVALIDATED
+
+
+def _clear_invalidated(knob, label):
+    with _LOCK:
+        _INVALIDATED.discard((str(knob), str(label or "")))
 
 
 # -- probes (the measured tier's input) --------------------------------
@@ -88,11 +132,14 @@ def note_probe(knob, label, value, score_us, **fields):
                                 "value": str(value)}, **fields)
 
 
-def measured_candidates(knob, label):
+def measured_candidates(knob, label, run=None):
     """Probe evidence for (knob, label) across every run in the
-    history dir: ``{value_str: {"mean_us", "n", "runs"}}``."""
+    history dir (``run=`` restricts to one run — the drift-refresh
+    path trusts only current-run rows):
+    ``{value_str: {"mean_us", "n", "runs"}}``."""
     rows = _hist.query(name="probe", kind="autotune",
-                       labels={"knob": str(knob), "label": str(label)})
+                       labels={"knob": str(knob), "label": str(label)},
+                       run=run)
     out = {}
     for r in rows:
         v = (r.get("labels") or {}).get("value")
@@ -133,13 +180,26 @@ def _decide(knob, label, chosen, source, heuristic=None, evidence=None):
         v = float(chosen)
     except (TypeError, ValueError):
         v = 1.0
+    # evidence BASIS rides on the durable row (ISSUE 19 satellite):
+    # the next run's cost-drift rule compares its own measurements
+    # against what THIS decision rested on — without these fields the
+    # contradiction would be undetectable across processes
+    extra = {}
+    ev = evidence or {}
+    if "basis_bytes" in ev:
+        extra["basis_bytes"] = int(ev["basis_bytes"])
+    cand = ev.get("candidates") or {}
+    if str(chosen) in cand:
+        extra["best_us"] = float(cand[str(chosen)])
+    if ev.get("drift_refresh"):
+        extra["drift_refresh"] = True
     _hist.record("autotune", "decision", v,
                  labels={"knob": dec["knob"], "label": dec["label"],
                          "source": dec["source"]},
                  chosen=str(chosen),
                  heuristic=str(heuristic) if heuristic is not None
                  else None,
-                 rows=int((evidence or {}).get("rows", 0)))
+                 rows=int(ev.get("rows", 0)), **extra)
     return chosen
 
 
@@ -152,7 +212,12 @@ def suggest(knob, label, candidates, fallback, heuristic=None):
     if not enabled():
         value, _src, _ev = fallback()
         return value
-    meas = measured_candidates(knob, label)
+    # a fired cost-drift rule invalidated this key: prior-run evidence
+    # contradicted reality, so re-resolve from THIS run's rows only
+    # and mark the flip as a typed ``*-refresh`` decision
+    refresh = invalidated(knob, label)
+    meas = measured_candidates(
+        knob, label, run=_current_run() if refresh else None)
     if candidates is not None:
         legal = {str(c) for c in candidates}
         meas = {v: m for v, m in meas.items() if v in legal}
@@ -165,26 +230,36 @@ def suggest(knob, label, candidates, fallback, heuristic=None):
             "candidates": {v: round(m["mean_us"], 1)
                            for v, m in meas.items()},
         }
+        if refresh:
+            evidence["drift_refresh"] = True
+            _clear_invalidated(knob, label)
         try:
             chosen = type(candidates[0])(best) if candidates \
                 else float(best)
         except (TypeError, ValueError):
             chosen = best
-        return _decide(knob, label, chosen, "measured",
+        return _decide(knob, label, chosen,
+                       "measured-refresh" if refresh else "measured",
                        heuristic=heuristic, evidence=evidence)
     value, source, evidence = fallback()
+    if refresh:
+        evidence = dict(evidence or {})
+        evidence["drift_refresh"] = True
+        source = "%s-refresh" % source
+        _clear_invalidated(knob, label)
     return _decide(knob, label, value, source, heuristic=heuristic,
                    evidence=evidence)
 
 
 # -- cost-model helpers (the modeled tier) -----------------------------
-def _family_cost_rows(label):
+def _family_cost_rows(label, run=None):
     """Cross-run cost rows for one executable family (`label` exact or
     ``label[...]``/``label:...`` children — the bracket rule the
-    registry uses, widened to the collective `:rs:`/`:ag:` rows)."""
+    registry uses, widened to the collective `:rs:`/`:ag:` rows).
+    ``run=`` restricts to one run (drift judges a single run's rows)."""
     if not label:
         return []
-    rows = _hist.query(name=str(label), kind="cost")
+    rows = _hist.query(name=str(label), kind="cost", run=run)
     out = []
     for r in rows:
         n = str(r.get("name", ""))
@@ -194,10 +269,10 @@ def _family_cost_rows(label):
     return out
 
 
-def _measured_step_bytes(label):
+def _measured_step_bytes(label, run=None):
     """The family's largest measured per-step bytes_accessed across
     runs (0 when history has no resolved row) + the evidence dict."""
-    rows = _family_cost_rows(label)
+    rows = _family_cost_rows(label, run=run)
     basis, runs = 0.0, set()
     for r in rows:
         b = float(r.get("bytes_accessed", 0.0) or 0.0)
@@ -322,6 +397,68 @@ def suggest_remat(label, hbm_budget_bytes, default=False):
                    evidence={"rows": 0})
 
 
+# -- cost-model drift (ISSUE 19 satellite) -----------------------------
+def prior_decision(knob, label):
+    """The latest durable decision row for (knob, label) from a PRIOR
+    run that recorded comparable evidence (``best_us`` for measured
+    decisions, ``basis_bytes`` for modeled ones).  None when no such
+    row exists — a decision without a recorded basis cannot be
+    contradicted."""
+    if not _hist.enabled():
+        return None
+    rows = _hist.query(name="decision", kind="autotune",
+                       labels={"knob": str(knob),
+                               "label": str(label or "")})
+    cur = _current_run()
+    for r in reversed(rows):            # query sorts oldest-first
+        if "best_us" in r or "basis_bytes" in r:
+            # the NEWEST evidence-bearing decision being this run's
+            # own means the key was already re-resolved here (e.g. a
+            # drift refresh) — nothing stale left to contradict
+            return None if r.get("run") == cur else r
+    return None
+
+
+def drift_evidence(knob, label):
+    """Judge THIS run's measured evidence against the latest prior
+    run's decision for (knob, label).
+
+    Returns None when unjudgeable (no prior decision with a recorded
+    basis, or this run has produced no comparable measurement yet),
+    else ``{"prior", "current", "ratio", "basis", "chosen",
+    "prior_run", "drift"}`` — ratio = current/prior, ``drift`` true
+    when the contradiction exceeds `DRIFT_FACTOR` in either
+    direction.  The SLO layer's cost-drift rule is a thin wrapper
+    around this."""
+    prior = prior_decision(knob, label)
+    if prior is None:
+        return None
+    cur_run = _current_run()
+    if cur_run is None:
+        return None
+    if "best_us" in prior:
+        chosen = str(prior.get("chosen", ""))
+        m = measured_candidates(knob, label, run=cur_run).get(chosen)
+        if not m:
+            return None
+        prior_v, cur_v, basis = \
+            float(prior["best_us"]), float(m["mean_us"]), "probe_us"
+    else:
+        cur_bytes, _ev = _measured_step_bytes(label, run=cur_run)
+        if cur_bytes <= 0:
+            return None
+        prior_v, cur_v, basis = \
+            float(prior["basis_bytes"]), float(cur_bytes), "bytes"
+    if prior_v <= 0:
+        return None
+    ratio = cur_v / prior_v
+    return {"prior": round(prior_v, 1), "current": round(cur_v, 1),
+            "ratio": round(ratio, 3), "basis": basis,
+            "chosen": prior.get("chosen"),
+            "prior_run": prior.get("run"),
+            "drift": ratio > DRIFT_FACTOR or ratio < 1.0 / DRIFT_FACTOR}
+
+
 # -- introspection (teletop / blackbox) --------------------------------
 def decisions():
     """This process's decision log, oldest first."""
@@ -345,6 +482,7 @@ def block():
 
 
 def reset():
-    """Tests: drop the process-local decision log."""
+    """Tests: drop the process-local decision log + drift flags."""
     with _LOCK:
         del _DECISIONS[:]
+        _INVALIDATED.clear()

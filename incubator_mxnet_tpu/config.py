@@ -662,3 +662,27 @@ register("MXNET_INT64_TENSOR_SIZE", bool, False,
          "only (flips jax_enable_x64 before any trace). Off by "
          "default for the reference's reason: wider index math costs "
          "speed/memory on every gather")
+register("MXNET_REQTRACE", bool, True,
+         "Per-request lifecycle journal (telemetry/reqtrace.py): every "
+         "serving/generation request gets a compact phase-stamped "
+         "record; tail outliers and terminal failures are promoted to "
+         "exemplars with full waterfalls on dumps, history rows and "
+         "firing SLO alerts.  On by default — the journal is pre-sized "
+         "structs filled from stamps the engines already take, held "
+         "to <2% by tools/check_overhead.py's serving trial")
+register("MXNET_REQTRACE_RING", int, 512,
+         "Per-engine request-journal ring size (retired records kept "
+         "for snapshots/teletop).  Bounded deque: old records fall "
+         "off; exemplars live in their own retention (below)")
+register("MXNET_REQTRACE_WINDOW", int, 256,
+         "Per-lane rolling window of completed-request e2e samples "
+         "the promotion threshold (p99) is computed over.  Promotion "
+         "needs at least 20 samples in the lane window first")
+register("MXNET_REQTRACE_EXEMPLARS", int, 32,
+         "Promoted exemplars retained per engine journal (the "
+         "process-wide cross-engine set alerts attach from keeps the "
+         "newest 64 regardless)")
+register("MXNET_REQTRACE_PIN_P99_US", float, 0.0,
+         "When > 0, replaces the rolling per-lane p99 promotion "
+         "threshold with this fixed e2e value in µs — deterministic "
+         "promotion for tests and drills.  0 = rolling threshold")

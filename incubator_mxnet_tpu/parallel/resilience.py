@@ -419,6 +419,20 @@ class ResilientTrainer:
             tele.record_step(loss=loss, ok=ok, wall_s=t2 - t0,
                              data_wait_s=t1 - t0, compute_s=t2 - t1,
                              traces=self._trace_count)
+        # autotune probe from the guarded step's measured wall (ISSUE
+        # 19 satellite) — OK steps only: a skipped/overflowed step's
+        # wall is not batch-size evidence.  Cadence-gated, past the
+        # compiling first step.
+        if ok and t._n_step % 128 == 2:
+            try:
+                from ..compile import autotune as _autotune
+                rows = int(batch_g.shape[0]) if batch_g.shape else 1
+                _autotune.note_probe(
+                    "batch_size", "resilient.step", rows,
+                    (t2 - t0) * 1e6 / max(1, rows),
+                    source="resilient.step", step=stepno)
+            except Exception:       # noqa: BLE001
+                pass
         self.scaler.update(overflow=not ok)
         if ok:
             self.bad_steps = 0

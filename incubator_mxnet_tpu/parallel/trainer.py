@@ -605,6 +605,21 @@ class ShardedTrainer:
             tele.record_step(wall_s=t2 - t0, data_wait_s=t1 - t0,
                              dispatch_s=t2 - t1,
                              traces=self._trace_count)
+        # autotune probe from the trainer's OWN measured wall (ISSUE
+        # 19 satellite: probe writers outside bench/): per-example
+        # step wall at THIS batch size, durable evidence for every
+        # later run's suggest_batch_size.  Cadence-gated (history is
+        # never a per-step cost) and past the compiling first step.
+        if self._n_step % 128 == 2:
+            try:
+                from ..compile import autotune as _autotune
+                rows = int(batch.shape[0]) if batch.shape else 1
+                _autotune.note_probe(
+                    "batch_size", "sharded.step", rows,
+                    (t2 - t0) * 1e6 / max(1, rows),
+                    source="trainer.step", step=self._n_step - 1)
+            except Exception:       # noqa: BLE001
+                pass
         return loss
 
     def _broadcast_solo_params(self):

@@ -285,6 +285,27 @@ class FleetSupervisor:
                             "version": can["version"]})
         detail = {k: v for k, v in (info or {}).items()
                   if isinstance(v, (int, float, str, bool))}
+        # the canary's worst promoted request exemplar (ISSUE 19)
+        # rides the ring event + rollback record: the proactive dump
+        # below carries the full reqtrace waterfall, this names WHICH
+        # request indicted the version
+        exemplar = None
+        try:
+            from ..telemetry import reqtrace as _rt
+            for cand in _rt.exemplars(model=self._model):
+                if cand.get("version") not in (None, can["version"]):
+                    continue        # another version's request
+                if exemplar is None or cand.get("e2e_us", 0) > \
+                        exemplar.get("e2e_us", 0):
+                    exemplar = cand
+        except Exception:           # noqa: BLE001 — forensic garnish
+            exemplar = None
+        if exemplar is not None:
+            detail.setdefault("exemplar_rid", exemplar.get("rid"))
+            detail.setdefault("exemplar_e2e_us",
+                              exemplar.get("e2e_us"))
+            detail.setdefault("exemplar_phase",
+                              exemplar.get("dominant"))
         _bb.record("controlplane", "rollback", model=self._model,
                    version=can["version"],
                    rule=str(rule) if rule else None,
@@ -300,6 +321,8 @@ class FleetSupervisor:
                "rule": str(rule) if rule else None,
                "fraction": can["fraction"],
                "blackbox": _bb.last_dump_path()}
+        if exemplar is not None:
+            rec["exemplar"] = dict(exemplar)
         self.last_rollback = rec
         return rec
 

@@ -100,6 +100,7 @@ from ..base import MXNetError
 from ..context import Context, current_context
 from ..monitor import events
 from ..telemetry import flightrec as _bb
+from ..telemetry import reqtrace as _reqtrace
 from ..telemetry import spans as _tele
 
 __all__ = ["InferenceEngine", "QueueFull", "DeadlineExceeded",
@@ -135,7 +136,7 @@ def serve_counters():
 
 class _Request:
     __slots__ = ("data", "n", "future", "t_enq", "deadline", "single",
-                 "tele", "lane", "tenant")
+                 "tele", "lane", "tenant", "rec")
 
     def __init__(self, data, n, future, deadline, single, lane=None,
                  tenant=None):
@@ -153,6 +154,10 @@ class _Request:
         # request's submit→dispatch→infer chain shares one trace id
         # across the three threads it crosses
         self.tele = _tele.current()
+        # lifecycle journal record (ISSUE 19): phase stamps land on it
+        # as the request crosses queue→coalesce→dispatch→infer→join;
+        # None when journaling is off (stamps guard on it)
+        self.rec = None
 
 
 class _OverQuota(Exception):
@@ -393,6 +398,15 @@ class InferenceEngine:
         # shed splits so canary traffic is attributable; None = no
         # labeled children (single-version engines add no labelsets)
         self._version = str(version) if version is not None else None
+        # per-request lifecycle journal (ISSUE 19): bounded ring +
+        # tail-exemplar promotion; the model tag is the cost label's
+        # model part (serve.infer:<model>) so exemplars join the cost
+        # registry's attribution
+        self._journal = _reqtrace.journal(
+            "serve",
+            self._cost_label.split(":", 1)[1]
+            if ":" in self._cost_label else self._cost_label,
+            version=self._version)
         # model.bad_version taint: >0 stalls every batch by this many
         # seconds and sign-flips outputs (deterministic degradation)
         self._degrade_s = 0.0
@@ -553,12 +567,14 @@ class InferenceEngine:
         self.refresh_params()
         if version is not None:
             self._version = str(version)
+            self._journal.version = self._version
         events.incr("serve.param_swaps")
 
     def set_version(self, version):
         """Re-tag the version label on this engine's serve.* splits
         (promotes re-point the primary's label at the new version)."""
         self._version = str(version) if version is not None else None
+        self._journal.version = self._version
 
     def degrade(self, stall_s):
         """Taint this engine (model.bad_version fault site): every
@@ -710,11 +726,16 @@ class InferenceEngine:
         fut = Future()
         req = _Request(arr, arr.shape[0], fut, deadline, single,
                        lane=lane, tenant=tenant)
+        req.rec = self._journal.start(req.t_enq, lane, tenant)
+        if req.rec is not None:
+            req.rec.n = req.n
         if req.deadline is not None and req.deadline <= req.t_enq:
             # born expired: queueing it could only burn queue slots on
             # work that is already lost — shed, deadline-typed
             self._shed_mark(lane, tenant, "deadline", deadline=True)
-            raise DeadlineExceeded("deadline is not in the future")
+            exc = DeadlineExceeded("deadline is not in the future")
+            self._journal.retire(req.rec, exc=exc)
+            raise exc
         # closed-check + enqueue are ATOMIC against close()'s final
         # flush (which sets _closed then drains the queue under the
         # same lock): a put that wins the race lands BEFORE the flush
@@ -722,6 +743,20 @@ class InferenceEngine:
         # tenant-quota hold increments under the SAME lock, and
         # _retire's decrement is the single release point — counts
         # can't leak or double-release across the shed/expiry paths.
+        try:
+            self._submit_locked(req, deadline, lane, tenant)
+        except MXNetError as e:
+            # synchronous refusals (quota sheds / QueueFull / closed)
+            # never reach _finish — this is their journal retire point
+            # (terminal records always promote; the whole wall lands
+            # in the queue phase, the budget phase of a refusal)
+            rec, req.rec = req.rec, None
+            self._journal.retire(rec, exc=e)
+            raise
+        self._ensure_dispatcher()
+        return fut
+
+    def _submit_locked(self, req, deadline, lane, tenant):
         with self._lock:
             if self._closed or self._draining:
                 events.incr("serve.rejected")
@@ -778,8 +813,6 @@ class InferenceEngine:
             self._finish(victim, exc=Shed(  # _retire re-takes it
                 "displaced by %r-lane traffic under overload "
                 "(queue full); back off or escalate lanes" % lane))
-        self._ensure_dispatcher()
-        return fut
 
     def _ensure_dispatcher(self):
         if self._thread is not None and self._thread.is_alive():
@@ -859,6 +892,12 @@ class InferenceEngine:
         except Exception:               # noqa: BLE001 — cancelled/done
             events.incr("serve.cancelled")
         self._retire(req)
+        # the single journal-retire point for every ACCEPTED request
+        # (refusals retire in _submit, cancels in _execute): phase
+        # math + tail-promotion happen here, off the submit path
+        rec, req.rec = req.rec, None
+        if rec is not None:
+            self._journal.retire(rec, exc=exc)
 
     def _collect(self):
         """Coalesce queued requests into one bucket's worth: pull
@@ -905,8 +944,10 @@ class InferenceEngine:
                         # strong engine ref lapses between idle polls
                         # (abandonment/GC liveness)
                         return []
+            if item.rec is not None:    # end of queue-wait: the
+                item.rec.t_collect = time.monotonic()   # coalesce
             if item.deadline is not None and \
-                    time.monotonic() > item.deadline:
+                    time.monotonic() > item.deadline:   # phase starts
                 self._expire(item)
                 continue
             if total + item.n > max_b:
@@ -973,6 +1014,8 @@ class InferenceEngine:
         now = time.monotonic()
         fresh = []
         for r in reqs:
+            if r.rec is not None:       # coalesce done, batch formed
+                r.rec.t_exec = now
             if r.deadline is not None and now > r.deadline:
                 self._expire(r)
             else:
@@ -988,6 +1031,9 @@ class InferenceEngine:
                 # device time; the future is already CANCELLED
                 events.incr("serve.cancelled")
                 self._retire(r)
+                rec, r.rec = r.rec, None
+                self._journal.retire(rec, status="cancelled",
+                                     reason="cancelled while queued")
             else:
                 live.append(r)          # RUNNING: cancel() is now inert
         if not live:
@@ -996,9 +1042,15 @@ class InferenceEngine:
         bucket = self._bucket_for(total)
         # queue-depth sample per dispatched batch: the black-box
         # timeline shows backlog growth leading up to a death, which
-        # counters (totals) cannot reconstruct
-        _bb.record("serve", "queue", depth=self._q.qsize(),
-                   bucket=bucket, n=total)
+        # counters (totals) cannot reconstruct.  Stamped at the batch's
+        # earliest ADMISSION, not at dispatch (ISSUE 19 satellite, the
+        # emit_foreign end-stamp family): the depth belongs where the
+        # oldest victim started waiting, so the dump timeline shows the
+        # backlog GROWING before the slow exemplar instead of the
+        # sample landing after the queue already drained
+        _bb.record_at(_tele.wall_of(min(r.t_enq for r in live)),
+                      "serve", "queue", depth=self._q.qsize(),
+                      bucket=bucket, n=total)
         dev_i = self._pick_replica()
         if self._pools is None:
             self._run_and_fan(live, total, bucket, dev_i)
@@ -1105,6 +1157,9 @@ class InferenceEngine:
         t0 = time.monotonic()
         for r in live:
             events.observe_time("serve.queue_us", t0 - r.t_enq)
+            if r.rec is not None:       # dispatch handoff complete;
+                r.rec.t_infer0 = t0     # device time starts here
+                r.rec.bucket = bucket
         # the dispatch span parents onto the first request's submit-side
         # context, so the cross-thread submit→dispatch→infer chain
         # shares one trace; nested serve.infer inherits automatically
@@ -1132,7 +1187,11 @@ class InferenceEngine:
                     self._finish(r, exc=e)
                 return
             self._replica_ok(dev_i)
-            dt_svc = time.monotonic() - t0
+            t1 = time.monotonic()
+            dt_svc = t1 - t0
+            for r in live:
+                if r.rec is not None:   # device done; join/D2H next
+                    r.rec.t_infer1 = t1
             with self._lock:    # feed the deadline-feasibility EWMA
                 prev = self._svc_ewma.get(bucket)
                 self._svc_ewma[bucket] = dt_svc if prev is None \
@@ -1234,6 +1293,8 @@ class InferenceEngine:
                 lambda a: NDArray(a[lo] if single else a[lo:hi],
                                   ctx=ctx), out)
             off = hi
+            if r.rec is not None:       # slice done; what remains is
+                r.rec.t_fin = time.monotonic()  # future resolution
             self._finish(r, result=res)
             dt = time.monotonic() - r.t_enq
             events.observe_time("serve.e2e_us", dt)
@@ -1325,6 +1386,21 @@ class InferenceEngine:
                 per_bucket[b] = round(time.monotonic() - tb, 4)
         self._warm = True
         events.incr("serve.warmups")
+        # probe row OUTSIDE bench (ISSUE 19 satellite / ROADMAP item 2
+        # follow-on): the warmup's own measured wall trains the
+        # autotuner's measured tier for the serve-bucket ladder, so
+        # production serving hosts contribute evidence — until now
+        # only bench wrote probes and serving only consumed
+        try:
+            from ..compile import autotune as _autotune
+            if per_bucket:
+                _autotune.note_probe(
+                    "serve_buckets", self._cost_label,
+                    ",".join(str(b) for b in self._buckets),
+                    sum(per_bucket.values()) * 1e6,
+                    source="serve.warmup", devices=len(self._ctxs))
+        except Exception:           # noqa: BLE001 — evidence is
+            pass                    # advisory, never blocks warmup
         if _prewarm is not None:
             try:
                 # durably record THIS warmup's signature so the next

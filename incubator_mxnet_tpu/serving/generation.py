@@ -83,6 +83,7 @@ from .. import fault
 from ..context import Context, current_context
 from ..monitor import events
 from ..telemetry import flightrec as _bb
+from ..telemetry import reqtrace as _reqtrace
 from ..telemetry import spans as _tele
 from .engine import (DeadlineExceeded, EngineClosed, QueueFull, Shed,
                      _LaneQueue, _OverQuota, _parse_lane_quotas,
@@ -291,7 +292,8 @@ class GenerationStream:
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "deadline", "lane", "tenant",
-                 "stream", "t_enq", "tele", "future", "n", "acct")
+                 "stream", "t_enq", "tele", "future", "n", "acct",
+                 "rec")
 
     def __init__(self, prompt, max_new, deadline, lane, tenant):
         self.prompt = prompt
@@ -306,6 +308,7 @@ class _GenRequest:
         self.n = 1
         self.acct = False       # queue/tenant accounting released once
         self.tele = _tele.current()
+        self.rec = None         # reqtrace.Record (journal lifecycle)
 
 
 class _Slot:
@@ -372,6 +375,10 @@ class GenerationEngine:
         self._max_new_default = int(max_new_default or self._L)
         self._continuous = bool(continuous)
         self._label = str(cost_label or "serve.gen")
+        self._journal = _reqtrace.journal(
+            "gen",
+            self._label.split(":", 1)[1]
+            if ":" in self._label else self._label)
 
         cap = max(1, int(queue_cap if queue_cap is not None
                          else _cfg.get("MXNET_SERVE_QUEUE_CAP")))
@@ -565,6 +572,19 @@ class GenerationEngine:
         _np.asarray(nxt)                # sync
         self._warm = True
         events.incr("gen.warmups")
+        # probe row from the warmup's own measured walls (ISSUE 19
+        # satellite: probe writers outside bench/) — autotune evidence
+        # for the prompt-bucket ladder, durable when history is on
+        try:
+            from ..compile import autotune as _autotune
+            if per_bucket:
+                _autotune.note_probe(
+                    "gen_buckets", self._label,
+                    ",".join(str(b) for b in self._buckets),
+                    sum(per_bucket.values()) * 1e6,
+                    source="gen.warmup", slots=self._S)
+        except Exception:               # noqa: BLE001
+            pass
         return {"prompt_buckets": list(self._buckets),
                 "slots": self._S, "max_len": self._L,
                 "wall_s": round(time.monotonic() - t0, 3),
@@ -623,41 +643,56 @@ class GenerationEngine:
                              % (lane, ",".join(self._lanes)))
         tenant = str(tenant) if tenant is not None else None
         req = _GenRequest(prompt, max_new, deadline, lane, tenant)
+        req.rec = self._journal.start(req.t_enq, lane, tenant)
         if req.deadline is not None and req.deadline <= req.t_enq:
             self._shed_mark(lane, tenant, "deadline", deadline=True)
-            raise DeadlineExceeded("deadline is not in the future")
-        with self._lock:
-            if self._closed or self._draining:
-                events.incr("gen.rejected")
-                raise EngineClosed("engine is draining/closed")
-            if tenant is not None and self._tenant_quota > 0 and \
-                    self._tenant_q.get(tenant, 0) >= self._tenant_quota:
-                self._shed(lane, tenant, "tenant_quota",
-                           "tenant %r over quota (%d queued, cap %d)"
-                           % (tenant, self._tenant_q.get(tenant, 0),
-                              self._tenant_quota))
-            try:
-                self._q.put_nowait(req)
-            except _OverQuota as oq:
-                self._shed(lane, tenant, "lane_quota",
-                           "lane %r over quota (%d queued, cap %d); "
-                           "excess work is shed under overload — see "
-                           "MXNET_SERVE_LANE_QUOTAS"
-                           % (oq.lane, oq.depth, oq.cap))
-            except queue.Full:
-                events.incr("gen.rejected")
-                raise QueueFull(
-                    "generation queue at capacity (%d); retry later "
-                    "or raise MXNET_SERVE_QUEUE_CAP" % self._q.maxsize)
-            if tenant is not None:
-                self._tenant_q[tenant] = \
-                    self._tenant_q.get(tenant, 0) + 1
-            if deadline is not None:
-                dq = self._lane_deadline_s.get(lane)
-                if dq is None:
-                    dq = self._lane_deadline_s[lane] = \
-                        self._deque_cls(maxlen=256)
-                dq.append(float(deadline))
+            exc = DeadlineExceeded("deadline is not in the future")
+            rec, req.rec = req.rec, None
+            self._journal.retire(rec, exc=exc)
+            raise exc
+        try:
+            with self._lock:
+                if self._closed or self._draining:
+                    events.incr("gen.rejected")
+                    raise EngineClosed("engine is draining/closed")
+                if tenant is not None and self._tenant_quota > 0 and \
+                        self._tenant_q.get(tenant, 0) >= \
+                        self._tenant_quota:
+                    self._shed(
+                        lane, tenant, "tenant_quota",
+                        "tenant %r over quota (%d queued, cap %d)"
+                        % (tenant, self._tenant_q.get(tenant, 0),
+                           self._tenant_quota))
+                try:
+                    self._q.put_nowait(req)
+                except _OverQuota as oq:
+                    self._shed(
+                        lane, tenant, "lane_quota",
+                        "lane %r over quota (%d queued, cap %d); "
+                        "excess work is shed under overload — see "
+                        "MXNET_SERVE_LANE_QUOTAS"
+                        % (oq.lane, oq.depth, oq.cap))
+                except queue.Full:
+                    events.incr("gen.rejected")
+                    raise QueueFull(
+                        "generation queue at capacity (%d); retry "
+                        "later or raise MXNET_SERVE_QUEUE_CAP"
+                        % self._q.maxsize)
+                if tenant is not None:
+                    self._tenant_q[tenant] = \
+                        self._tenant_q.get(tenant, 0) + 1
+                if deadline is not None:
+                    dq = self._lane_deadline_s.get(lane)
+                    if dq is None:
+                        dq = self._lane_deadline_s[lane] = \
+                            self._deque_cls(maxlen=256)
+                    dq.append(float(deadline))
+        except (Shed, QueueFull, EngineClosed) as e:
+            # synchronous refusals never reach _resolve — this is
+            # their journal retire point
+            rec, req.rec = req.rec, None
+            self._journal.retire(rec, exc=e)
+            raise
         events.incr("gen.requests")
         events.incr("gen.requests", labels={"lane": lane})
         if tenant is not None:
@@ -757,6 +792,8 @@ class GenerationEngine:
                 req = self._q.get_nowait()
             except queue.Empty:
                 return
+            if req.rec is not None:     # queue phase ends at the pop
+                req.rec.t_collect = time.monotonic()
             slot = free.pop(0)
             if not self._admit_one(req, slot):
                 free.insert(0, slot)    # shed — the slot stays free
@@ -775,6 +812,8 @@ class GenerationEngine:
             return False
         now = time.monotonic()
         bucket = self._bucket_for(req.prompt.size)
+        if req.rec is not None:
+            req.rec.bucket = bucket
         if req.deadline is not None:
             est = self._prefill_ewma.get(bucket, 0.0) \
                 + (self._step_ewma or 0.0)
@@ -789,6 +828,9 @@ class GenerationEngine:
                 return False
         if not req.stream.future.set_running_or_notify_cancel():
             events.incr("gen.cancelled")
+            rec, req.rec = req.rec, None
+            self._journal.retire(rec, status="cancelled",
+                                 reason="cancelled while queued")
             self._retire_accounting(req)
             return False
         import jax
@@ -827,6 +869,8 @@ class GenerationEngine:
             _bb.record("gen", "join_failed", error=type(e).__name__)
             return False
         span.stop()
+        if req.rec is not None:         # prefill phase ends here
+            req.rec.t_exec = time.monotonic()
         dt = time.monotonic() - t0
         prev = self._prefill_ewma.get(bucket)
         self._prefill_ewma[bucket] = dt if prev is None \
@@ -941,6 +985,8 @@ class GenerationEngine:
         if slot is None:
             return
         req = slot.req
+        if req.rec is not None:         # decode phase ends here
+            req.rec.t_fin = time.monotonic()
         self._resolve(req, exc=exc, accepted=True)
         events.incr("gen.retires")
         e2e = time.monotonic() - req.t_enq
@@ -969,6 +1015,9 @@ class GenerationEngine:
 
     def _resolve(self, req, exc=None, accepted=True):
         req.stream._finish(exc)
+        rec, req.rec = req.rec, None    # single journal retire point
+        if rec is not None:             # for accepted requests (swap
+            self._journal.retire(rec, exc=exc)  # keeps re-runs no-op)
         if accepted:
             self._retire_accounting(req)
 

@@ -1113,16 +1113,47 @@ class ModelRegistry:
         from ..telemetry import slo as _slo
         return _slo.install_default_serving_rules(registry=self, **kw)
 
+    def slow_requests(self, name=None, lane=None):
+        """The promoted slow-request exemplars (ISSUE 19) of one
+        hosted model's engine — or of every hosted model when ``name``
+        is None — newest last.  The per-request autopsy surface:
+        each row carries the full phase waterfall, terminal status
+        and dominant phase (`tools/blackbox.py autopsy` renders the
+        same rows from a dump)."""
+        if name is not None:
+            names = [str(name)]
+        else:
+            with self._lock:
+                names = [n for n, e in self._models.items()
+                         if e is not None]
+        out = []
+        for n in names:
+            j = getattr(self._entry(n).engine, "_journal", None)
+            if j is None:
+                continue
+            for ex in j.exemplars():
+                if lane is None or ex.get("lane") == lane:
+                    out.append(ex)
+        out.sort(key=lambda e: e.get("ts", 0))
+        return out
+
     def stats(self):
         with self._lock:
-            models = {
-                n: {"footprint_bytes": e.footprint, "basis": e.basis,
-                    "devices": [repr(self._ctxs[i]) for i in e.devices],
+            models = {}
+            for n, e in self._models.items():
+                if e is None:
+                    continue
+                j = getattr(e.engine, "_journal", None)
+                models[n] = {
+                    "footprint_bytes": e.footprint, "basis": e.basis,
+                    "devices": [repr(self._ctxs[i])
+                                for i in e.devices],
                     "replicas": len(e.devices),
                     "version": e.version,
                     "canary": dict(e.canary) if e.canary else None,
-                    "breaker": e.breaker.state}
-                for n, e in self._models.items() if e is not None}
+                    "breaker": e.breaker.state,
+                    "reqtrace": None if j is None else
+                    {"records": j.records, "promoted": j.promoted}}
             ledger = [
                 {"device": repr(c), "budget": b, "committed": u,
                  "free": (b - u) if b > 0 else None}

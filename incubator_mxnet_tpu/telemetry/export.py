@@ -149,6 +149,47 @@ class MetricsExporter:
                             1 if name in active else 0))
         return lines
 
+    @staticmethod
+    def _reqtrace_lines(prefix):
+        """Exemplar annotations for the labeled latency summaries
+        (ISSUE 19): per (engine, lane), the WORST promoted request
+        exemplar rides the scrape as a gauge family whose labels name
+        the request — rid, terminal status, dominant phase — so the
+        dashboard showing a lane's p99 can link straight to the
+        autopsy instead of a faceless quantile.  Guarded on reqtrace
+        being ALREADY imported: a scrape never pulls the tracing
+        layer in just to say 'no requests'."""
+        import sys as _sys
+        rt = _sys.modules.get("incubator_mxnet_tpu.telemetry.reqtrace")
+        if rt is None:
+            return []
+        worst = {}                  # (engine, lane) -> exemplar
+        for ex in rt.exemplars():
+            key = (ex.get("engine"), ex.get("lane"))
+            if key not in worst or \
+                    ex.get("e2e_us", 0) > worst[key].get("e2e_us", 0):
+                worst[key] = ex
+        if not worst:
+            return []
+        esc = MetricsExporter._escape_label
+        m = _metric_name(prefix, "request_exemplar_e2e_us")
+        mp = _metric_name(prefix, "request_exemplar_phase_us")
+        lines = ["# TYPE %s gauge" % m]
+        phase_lines = ["# TYPE %s gauge" % mp]
+        for (engine, lane), ex in sorted(
+                worst.items(), key=lambda kv: (str(kv[0][0]),
+                                               str(kv[0][1]))):
+            base = 'engine="%s",lane="%s"' % (esc(engine), esc(lane))
+            lines.append(
+                '%s{%s,rid="%s",status="%s",phase="%s"} %s'
+                % (m, base, ex.get("rid"), esc(ex.get("status")),
+                   esc(ex.get("dominant")), _fmt(ex.get("e2e_us", 0))))
+            for ph, us in sorted((ex.get("phases") or {}).items()):
+                phase_lines.append(
+                    '%s{%s,rid="%s",phase="%s"} %s'
+                    % (mp, base, ex.get("rid"), esc(ph), _fmt(us)))
+        return lines + (phase_lines if len(phase_lines) > 1 else [])
+
     def prometheus_text(self) -> str:
         """Prometheus exposition text (version 0.0.4): counters +
         quantile summaries for every observed sample series (labeled
@@ -221,6 +262,10 @@ class MetricsExporter:
                 lines += self._slo_lines(self._prefix)
             except Exception:       # noqa: BLE001 — alerting must
                 pass                # never break a scrape either
+            try:
+                lines += self._reqtrace_lines(self._prefix)
+            except Exception:       # noqa: BLE001 — exemplars must
+                pass                # never break a scrape either
         return "\n".join(lines) + "\n"
 
     def json_dict(self) -> dict:
@@ -273,6 +318,18 @@ class MetricsExporter:
                     cblock = ctl.status_block()
                     if cblock:
                         out["controlplane"] = cblock
+            except Exception:       # noqa: BLE001
+                pass
+            # the request journals + promoted slow-request exemplars
+            # (ISSUE 19) — same already-imported guard
+            try:
+                import sys as _sys
+                rt = _sys.modules.get(
+                    "incubator_mxnet_tpu.telemetry.reqtrace")
+                if rt is not None:
+                    rblock = rt.block()
+                    if rblock:
+                        out["reqtrace"] = rblock
             except Exception:       # noqa: BLE001
                 pass
         return out

@@ -395,6 +395,17 @@ def dump_blackbox(path=None, reason="manual", exc=None, last=None):
             tune_block = tune_mod.block() or None
     except Exception:               # noqa: BLE001
         tune_block = None
+    # the request journals + promoted exemplars (ISSUE 19): same
+    # already-imported guard — a dump from a process that never ran an
+    # engine must not import the tracing layer to say "no requests"
+    rt_block = None
+    try:
+        rt_mod = sys.modules.get(
+            "incubator_mxnet_tpu.telemetry.reqtrace")
+        if rt_mod is not None:
+            rt_block = rt_mod.block() or None
+    except Exception:               # noqa: BLE001
+        rt_block = None
     evs = ring_snapshot(last=last)
     doc = {
         "schema": SCHEMA,
@@ -411,6 +422,7 @@ def dump_blackbox(path=None, reason="manual", exc=None, last=None):
         "slo": slo_block,
         "controlplane": ctl_block,
         "autotune": tune_block,
+        "reqtrace": rt_block,
         "hbm": {"peaks": hbm_peaks()},
         "events": evs,
         "trace": {"traceEvents": _chrome_view(evs),

@@ -43,7 +43,15 @@ Model:
   marker     durable run markers (checkpoint / rollback / preemption /
              mesh transitions), ``v`` = 1
   slo        alert transitions (telemetry/slo.py), ``v`` = 1 fired /
-             0 cleared
+             0 cleared — firing serving/generation transitions also
+             carry the scalar ``exemplar_*`` fields of the attached
+             slow-request exemplar
+  reqtrace   one row per PROMOTED request exemplar (telemetry/
+             reqtrace.py): ``v`` = e2e µs, ``labels`` =
+             {engine, lane, model}, the per-phase waterfall inlined
+             under ``phases`` — written at retire time, tail/failure
+             requests only, so slow-request autopsies survive the
+             process and query across runs
   =========  ==========================================================
 
 - **Bounded**: a shard past ``MXNET_HISTORY_SHARD_KB`` is COMPACTED in

@@ -62,13 +62,15 @@ from ..monitor import events
 from . import flightrec as _bb
 
 __all__ = ["Rule", "ThresholdRule", "BurnRateRule", "AnomalyRule",
+           "CostDriftRule",
            "register_rule", "unregister_rule", "clear_rules", "rules",
            "active_alerts", "evaluate", "block", "register_action",
            "default_serving_rules", "install_default_serving_rules",
            "default_generation_rules",
            "install_default_generation_rules",
            "default_controlplane_rules",
-           "install_default_controlplane_rules"]
+           "install_default_controlplane_rules",
+           "default_cost_drift_rules", "install_cost_drift_rules"]
 
 
 # -- metric readers ----------------------------------------------------
@@ -343,6 +345,55 @@ class AnomalyRule(Rule):
             "series": self.series, "pct": self.pct}
 
 
+class CostDriftRule(Rule):
+    """Cost-model regression: this run's measured ``kind="cost"`` /
+    probe evidence contradicts the evidence a PRIOR run's autotune
+    decision was based on (compile/autotune.py records the basis —
+    ``best_us`` or ``basis_bytes`` — on every decision row).
+
+    Judged entirely from durable history via
+    ``autotune.drift_evidence(knob, label)``: unjudgeable (None) until
+    both a prior decision with a recorded basis and fresh current-run
+    measurements exist, firing when they disagree beyond
+    ``autotune.DRIFT_FACTOR`` in either direction.  Firing also calls
+    ``autotune.invalidate(knob, label)`` so the next ``suggest_*`` for
+    the key ignores stale cross-run evidence and re-resolves from this
+    run's rows — recording a ``drift_refresh`` decision event, which
+    makes the rule unjudgeable again (the contradiction is resolved)
+    and clears the alert after the debounce rounds."""
+
+    kind = "cost_drift"
+
+    def __init__(self, name, knob, label, description=""):
+        super().__init__(
+            name, description or
+            "measured cost for %s[%s] vs prior-run decision evidence"
+            % (knob, label))
+        self.knob = str(knob)
+        self.label = str(label or "")
+
+    def check(self, now):
+        try:
+            from ..compile import autotune as _at
+        except Exception:           # noqa: BLE001
+            return None, {}
+        ev = _at.drift_evidence(self.knob, self.label)
+        if ev is None:
+            return None, {}
+        firing = bool(ev.get("drift"))
+        if firing:
+            _at.invalidate(self.knob, self.label)
+        return firing, {
+            "prior": round(float(ev["prior"]), 3),
+            "current": round(float(ev["current"]), 3),
+            "ratio": round(float(ev["ratio"]), 3),
+            "basis": str(ev["basis"]),
+            "chosen": str(ev.get("chosen")),
+            "prior_run": str(ev.get("prior_run")),
+            "factor": float(_at.DRIFT_FACTOR),
+            "labels": {"knob": self.knob, "label": self.label}}
+
+
 # -- registry + alert lifecycle ----------------------------------------
 _LOCK = threading.Lock()
 _RULES = {}                 # name -> Rule
@@ -419,6 +470,36 @@ def register_action(fn) -> None:
         _ACTIONS.append(fn)
 
 
+def _attach_exemplar(name, info):
+    """Attach the worst matching promoted request exemplar (ISSUE 19)
+    to a firing serving/generation rule's info, IN PLACE: the full
+    waterfall under ``info["exemplar"]`` (rides into the active-alerts
+    block, /metrics.json and the proactive dump), plus scalar
+    ``exemplar_*`` fields that survive the ring event's and history
+    row's scalar filters — the on-call sees the autopsy, not just the
+    gauge."""
+    if name.startswith("serve-"):
+        engine = "serve"
+    elif name.startswith("gen-"):
+        engine = "gen"
+    else:
+        return                      # only request-path rules carry one
+    try:
+        from . import reqtrace as _rt
+        ex = _rt.worst_exemplar(
+            lane=(info.get("labels") or {}).get("lane"),
+            engine=engine)
+    except Exception:               # noqa: BLE001 — attachment is
+        return                      # garnish, never breaks the alert
+    if not ex:
+        return
+    info["exemplar"] = dict(ex)
+    info["exemplar_rid"] = ex.get("rid")
+    info["exemplar_e2e_us"] = ex.get("e2e_us")
+    info["exemplar_status"] = ex.get("status")
+    info["exemplar_phase"] = ex.get("dominant")
+
+
 def _transition(name, firing, info):
     events.incr("slo.fired" if firing else "slo.cleared")
     events.incr("slo.fired" if firing else "slo.cleared",
@@ -430,7 +511,9 @@ def _transition(name, firing, info):
         from . import history as _hist
         _hist.record("slo", name, 1.0 if firing else 0.0,
                      labels={"rule": name},
-                     event="fired" if firing else "cleared")
+                     event="fired" if firing else "cleared",
+                     **{k: v for k, v in info.items()
+                        if k.startswith("exemplar_")})
     except Exception:               # noqa: BLE001
         pass
     if firing:
@@ -481,6 +564,8 @@ def evaluate(now=None) -> list:
                             dict(prev, unjudgeable=True))
             continue
         _UNJUDGED.pop(name, None)
+        if firing:
+            _attach_exemplar(name, info)
         with _LOCK:
             was = name in _ACTIVE
             if firing:
@@ -699,4 +784,42 @@ def install_default_serving_rules(registry=None, engine=None,
                 kw["quotas"] = q
     installed = [register_rule(r) for r in
                  default_serving_rules(targets=targets, **kw)]
+    return [r.name for r in installed]
+
+
+def default_cost_drift_rules(keys=None) -> list:
+    """One ``CostDriftRule`` per autotune key that has EVIDENCE to
+    contradict: ``keys`` is an iterable of ``(knob, label)`` pairs,
+    or None to discover them from the durable decision rows that
+    recorded a basis (``best_us`` / ``basis_bytes``).  No history, no
+    prior evidence → no rules — a fresh deployment has nothing to
+    drift from."""
+    if keys is None:
+        keys, seen = [], set()
+        try:
+            from . import history as _hist
+            if not _hist.enabled():
+                return []
+            for r in _hist.query(name="decision", kind="autotune"):
+                if "best_us" not in r and "basis_bytes" not in r:
+                    continue
+                lb = r.get("labels") or {}
+                k = (lb.get("knob"), lb.get("label") or "")
+                if k[0] and k not in seen:
+                    seen.add(k)
+                    keys.append(k)
+        except Exception:           # noqa: BLE001
+            return []
+    return [CostDriftRule("autotune-cost-drift-%s-%s"
+                          % (knob, label or "any"), knob, label)
+            for knob, label in keys]
+
+
+def install_cost_drift_rules(keys=None) -> list:
+    """Build + register the autotune cost-drift rules (ISSUE 19
+    satellite: decisions carried across runs get re-litigated when
+    this run's measurements contradict their recorded evidence).
+    Returns the registered rule names."""
+    installed = [register_rule(r)
+                 for r in default_cost_drift_rules(keys=keys)]
     return [r.name for r in installed]

@@ -75,7 +75,21 @@ from . import flightrec as _bb
 
 __all__ = ["SpanContext", "TraceContext", "enabled", "enable", "span",
            "current", "recording", "propagate", "set_global_step",
-           "get_global_step", "emit_foreign"]
+           "get_global_step", "emit_foreign", "wall_of"]
+
+
+def wall_of(t_mono):
+    """The `time.time()` epoch stamp corresponding to a
+    `time.monotonic()` reading taken earlier in THIS process.
+
+    Interval stamps on the hot path are monotonic (immune to clock
+    steps), but the flight-recorder ring and `record_at` speak epoch
+    time.  Both clocks advance at wall rate, so the reading was
+    (monotonic-now − t_mono) seconds ago.  This is the conversion the
+    admission-time stamping discipline rides on (ISSUE 19 satellite —
+    same family as `emit_foreign`'s end-stamping): convert the
+    ORIGINAL stamp at emit time rather than stamping delivery time."""
+    return time.time() - (time.monotonic() - float(t_mono))
 
 _ids = itertools.count(1)       # CPython-atomic next(); no lock needed
 _tls = threading.local()

@@ -58,6 +58,15 @@ using `tools/bench_diff.py`'s direction heuristics (``*_us``/``p99``/
 ``stale`` lower-better, throughput/hit higher-better), prints the
 regressions, and exits 1 when any directional series regressed past
 ``--threshold`` percent.
+
+`autopsy` (ISSUE 19) renders ONE promoted slow-request exemplar from
+a dump's reqtrace block as a per-phase waterfall with a
+phase-dominance verdict — the "why was THIS request slow" answer a
+firing lane alert attaches to its own dump:
+
+    python -m ... autopsy dump.json               # the worst one
+    python -m ... autopsy dump.json --rid 42
+    python -m ... autopsy dump.json --lane high --all
 """
 from __future__ import annotations
 
@@ -67,10 +76,11 @@ import sys
 import time
 
 from .teletop import (_autotune_lines, _fleet_lines, _fmt_qty,
-                      _slo_lines)
+                      _reqtrace_lines, _slo_lines)
 
 __all__ = ["load_dump", "render", "suspected_cause", "merge_traces",
            "verify_main", "merge_main", "history_main", "sparkline",
+           "autopsy_main", "autopsy_lines", "slow_request_family",
            "main"]
 
 
@@ -81,6 +91,46 @@ def load_dump(path: str) -> dict:
         raise ValueError("%s is not a black-box dump (schema=%r)"
                          % (path, doc.get("schema")))
     return doc
+
+
+#: dominant phase -> (family, what the operator does about it)
+_PHASE_FAMILY = {
+    "queue": ("queue-dominated",
+              "the request sat waiting for admission — add capacity, "
+              "shed earlier, or rebalance lane quotas"),
+    "coalesce": ("coalesce-dominated",
+                 "the batching window held the request while the "
+                 "batch filled — shrink the coalesce delay or the "
+                 "batch-size target"),
+    "dispatch": ("dispatch-dominated",
+                 "the batch waited for a free replica/dispatch slot — "
+                 "replicas are saturated or unhealthy"),
+    "infer": ("device-dominated",
+              "device execution itself was the wall — the batch's "
+              "compute, not the serving machinery"),
+    "prefill": ("device-dominated",
+                "prompt prefill was the wall — long prompts or a "
+                "cold prefill executable"),
+    "decode": ("decode-dominated",
+               "token-by-token decode was the wall — long emissions "
+               "or slow decode steps"),
+    "join": ("join-dominated",
+             "device→host join / fan-out was the wall — D2H "
+             "transfers or result distribution"),
+    "resolve": ("resolve-dominated",
+                "future resolution was the wall — a slow consumer "
+                "callback holding the fan-out thread"),
+}
+
+
+def slow_request_family(exemplar: dict):
+    """(family, advice) for an exemplar's dominant/budget phase —
+    the slow-request taxonomy `suspected_cause` and ``autopsy``
+    share."""
+    phase = exemplar.get("budget_phase") or exemplar.get("dominant")
+    return _PHASE_FAMILY.get(
+        phase, ("unattributed", "no phase dominated; read the "
+                                "waterfall"))
 
 
 def suspected_cause(doc: dict) -> str:
@@ -97,6 +147,23 @@ def suspected_cause(doc: dict) -> str:
     if reason.startswith("slo:"):
         info = (doc.get("slo") or {}).get("active", {}).get(
             reason[4:], {})
+        ex = info.get("exemplar")
+        if isinstance(ex, dict):
+            # the attached slow-request exemplar (ISSUE 19) names the
+            # FAMILY, not just the firing rule
+            family, advice = slow_request_family(ex)
+            return ("SLO alert %r fired, %s: exemplar request #%s "
+                    "(lane %s, %s) spent %dµs of its %dµs e2e in "
+                    "%r — %s; run `blackbox autopsy <dump>` for the "
+                    "waterfall"
+                    % (reason[4:], family, ex.get("rid"),
+                       ex.get("lane"), ex.get("status"),
+                       (ex.get("phases") or {}).get(
+                           ex.get("budget_phase")
+                           or ex.get("dominant"), 0),
+                       ex.get("e2e_us", 0),
+                       ex.get("budget_phase") or ex.get("dominant"),
+                       advice))
         return ("SLO alert %r fired — PROACTIVE dump, the run was "
                 "still alive (%s); read the slo block and the slo.* "
                 "ring events"
@@ -286,6 +353,9 @@ def render(doc: dict, events_tail=40) -> str:
     # the SLO rule/alert state (ISSUE 12): a proactive slo:<rule>
     # dump's firing evidence, or "was anything firing" for any other
     lines += _slo_lines(doc.get("slo"))
+    # the request journals + promoted slow-request exemplars (ISSUE
+    # 19) — `blackbox autopsy` renders one exemplar's full waterfall
+    lines += _reqtrace_lines(doc.get("reqtrace"))
 
     lines += ["", "suspected cause: " + suspected_cause(doc)]
     return "\n".join(lines)
@@ -698,6 +768,110 @@ def verify_main(argv) -> int:
     return rc
 
 
+# -- autopsy (ISSUE 19) ------------------------------------------------
+def _dump_exemplars(doc):
+    """Every exemplar a dump carries: the reqtrace block's recent
+    ring, plus any exemplar attached to a firing SLO alert (a
+    proactive slo:<rule> dump may have rotated its ring past the one
+    the alert named)."""
+    seen, out = set(), []
+    for ex in (doc.get("reqtrace") or {}).get("exemplars") or []:
+        if isinstance(ex, dict) and ex.get("rid") not in seen:
+            seen.add(ex.get("rid"))
+            out.append(ex)
+    for info in ((doc.get("slo") or {}).get("active") or {}).values():
+        ex = info.get("exemplar") if isinstance(info, dict) else None
+        if isinstance(ex, dict) and ex.get("rid") not in seen:
+            seen.add(ex.get("rid"))
+            out.append(ex)
+    return out
+
+
+def autopsy_lines(ex: dict) -> list:
+    """One exemplar's full phase waterfall + the dominance verdict —
+    the 'why was THIS request slow' rendering."""
+    e2e = float(ex.get("e2e_us") or 0.0)
+    phases = ex.get("phases") or {}
+    head = "autopsy — request #%s (%s%s, lane %s, status %s)" % (
+        ex.get("rid", "?"), ex.get("engine", "?"),
+        " %s" % ex.get("model") if ex.get("model") else "",
+        ex.get("lane", "-"), ex.get("status", "?"))
+    lines = [head, "=" * len(head)]
+    if ex.get("ts"):
+        lines.append("admitted %s   e2e %dµs   batch n=%s bucket=%s"
+                     % (time.strftime("%Y-%m-%d %H:%M:%S",
+                                      time.localtime(ex["ts"])),
+                        e2e, ex.get("n", 1), ex.get("bucket", "-")))
+    if ex.get("reason"):
+        lines.append("terminated: %s" % ex["reason"])
+    lines += ["", "%-10s %12s %6s  %s" % ("phase", "µs", "%", ""),
+              "-" * 62]
+    # ladder order, not size order: the waterfall reads top-to-bottom
+    # as the request's life
+    order = ("queue", "coalesce", "dispatch", "infer", "prefill",
+             "decode", "join", "resolve")
+    budget = ex.get("budget_phase") or ex.get("dominant")
+    for ph in sorted(phases, key=lambda p: (
+            order.index(p) if p in order else len(order), p)):
+        us = float(phases[ph])
+        frac = us / e2e if e2e > 0 else 0.0
+        bar = "#" * max(1 if us > 0 else 0, int(round(frac * 36)))
+        mark = "  <- budget" if ph == budget else ""
+        lines.append("%-10s %12d %5.1f%%  %s%s"
+                     % (ph, us, frac * 100.0, bar, mark))
+    family, advice = slow_request_family(ex)
+    lines += ["", "verdict: %s — %.1f%% of e2e in %r; %s"
+              % (family,
+                 (float(phases.get(budget, 0.0)) / e2e * 100.0)
+                 if e2e > 0 else 0.0,
+                 budget, advice)]
+    return lines
+
+
+def autopsy_main(argv) -> int:
+    """``blackbox autopsy`` body: render the waterfall of one
+    promoted slow-request exemplar from a dump — by --rid, or the
+    worst-e2e exemplar (preferring one attached to a firing alert)."""
+    ap = argparse.ArgumentParser(
+        prog="blackbox autopsy",
+        description="per-phase waterfall + phase-dominance verdict "
+                    "for a promoted slow-request exemplar")
+    ap.add_argument("dump", help="black-box dump JSON path")
+    ap.add_argument("--rid", type=int, default=None,
+                    help="exemplar request id (default: the worst)")
+    ap.add_argument("--lane", default=None,
+                    help="restrict to one lane")
+    ap.add_argument("--all", action="store_true",
+                    help="render every matching exemplar")
+    args = ap.parse_args(argv)
+    try:
+        doc = load_dump(args.dump)
+    except Exception as e:          # noqa: BLE001 — operator tool
+        print("blackbox: cannot read %s: %s" % (args.dump, e),
+              file=sys.stderr)
+        return 1
+    pool = _dump_exemplars(doc)
+    if args.lane is not None:
+        pool = [e for e in pool if e.get("lane") == args.lane]
+    if args.rid is not None:
+        pool = [e for e in pool if e.get("rid") == args.rid]
+    if not pool:
+        print("blackbox autopsy: no matching exemplar in %s (the "
+              "dump's reqtrace block is empty — tracing off, or no "
+              "request crossed its lane p99)" % args.dump,
+              file=sys.stderr)
+        return 1
+    pool.sort(key=lambda e: -float(e.get("e2e_us") or 0.0))
+    chosen = pool if args.all else pool[:1]
+    out = []
+    for ex in chosen:
+        if out:
+            out.append("")
+        out += autopsy_lines(ex)
+    print("\n".join(out))
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "verify":
@@ -706,6 +880,8 @@ def main(argv=None) -> int:
         return merge_main(argv[1:])
     if argv and argv[0] == "history":
         return history_main(argv[1:])
+    if argv and argv[0] == "autopsy":
+        return autopsy_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="blackbox",
         description="summarize a flight-recorder black-box dump "

@@ -222,6 +222,52 @@ def _slo_lines(slo):
     return lines
 
 
+def _reqtrace_lines(rt):
+    """The request-journal block (ISSUE 19) as table rows: one line
+    per (engine, lane) — window size, rolling p99, and the SLOWEST
+    retired request's rid / e2e / dominant phase — then one line per
+    recent promoted exemplar, so the operator's eye goes from 'lane
+    p99 is high' straight to WHICH request and WHICH phase."""
+    if not rt:
+        return []
+    journals = rt.get("journals") or []
+    exemplars = rt.get("exemplars") or []
+    if not journals and not exemplars:
+        return []
+    lines = ["", "reqtrace (%d journal(s), %d exemplar(s))"
+             % (len(journals), len(exemplars)),
+             "%-6s %-10s %-8s %6s %10s %8s %10s %-10s"
+             % ("kind", "model", "lane", "win", "p99_us", "rid",
+                "slow_us", "dominant"),
+             "-" * 78]
+    for j in journals:
+        for lane in sorted(j.get("lanes") or {}):
+            row = j["lanes"][lane]
+            slow = row.get("slowest") or {}
+            p99 = row.get("p99_us")
+            lines.append(
+                "%-6s %-10s %-8s %6d %10s %8s %10s %-10s"
+                % (str(j.get("engine", "?"))[:6],
+                   str(j.get("model", ""))[:10], str(lane)[:8],
+                   row.get("window_n", 0),
+                   "-" if p99 is None else "%d" % p99,
+                   slow.get("rid", "-"),
+                   "-" if "e2e_us" not in slow
+                   else "%d" % slow["e2e_us"],
+                   str(slow.get("dominant", ""))[:10]))
+    for ex in exemplars[-8:]:
+        phases = ex.get("phases") or {}
+        water = " ".join("%s=%d" % (k, v) for k, v in sorted(
+            phases.items(), key=lambda kv: -kv[1])[:4])
+        lines.append(
+            "  #%-6s %-6s %-8s %-9s %9dus %s"
+            % (ex.get("rid", "?"), str(ex.get("engine", "?"))[:6],
+               str(ex.get("lane", "-"))[:8],
+               str(ex.get("status", "?"))[:9],
+               int(ex.get("e2e_us", 0)), water[:40]))
+    return lines
+
+
 def render(snap: dict, prefix: str = "") -> str:
     """The snapshot as one fixed-width table block."""
     counters = {k: v for k, v in snap.get("counters", {}).items()
@@ -270,6 +316,7 @@ def render(snap: dict, prefix: str = "") -> str:
 
     lines += _fleet_lines(snap.get("fleet"))
     lines += _slo_lines(snap.get("slo"))
+    lines += _reqtrace_lines(snap.get("reqtrace"))
 
     derived = _derived(snap.get("counters", {}))
     if derived:

@@ -139,6 +139,12 @@ def pytest_configure(config):
         "markers", "compile: compile-loop (autotuner / stacking / "
         "pre-warm manifest) tests (CPU-fast, run in tier-1 by "
         "default)")
+    # request-level tail tracing (ISSUE 19): per-phase latency
+    # journals, exemplar promotion, alert-attached autopsies, the
+    # cost-drift rule
+    config.addinivalue_line(
+        "markers", "reqtrace: request-journal / exemplar / autopsy "
+        "tests (CPU-fast, run in tier-1 by default)")
 
 
 @pytest.fixture(autouse=True)

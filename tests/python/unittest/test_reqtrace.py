@@ -1,0 +1,537 @@
+"""Request-level tail tracing tests (telemetry.reqtrace — ISSUE 19):
+ring bounding under churn, deterministic tail promotion at a pinned
+p99, typed termination records from real engine refusals, the
+exemplar-on-alert end-to-end path (firing lane rule → attached
+waterfall → proactive dump → autopsy CLI), teletop/autopsy golden
+substrings, admission-time ring stamping (the emit_foreign end-stamp
+family), the cost-drift rule lifecycle (fire → invalidate → refresh
+decision → clear), the new probe writers outside bench/, and the
+two-process durable-exemplar proof.  CPU-only, fast (the overhead
+gate wrapper is slow-marked)."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd
+from incubator_mxnet_tpu.monitor import events
+from incubator_mxnet_tpu.serving import (DeadlineExceeded,
+                                         InferenceEngine, Shed)
+from incubator_mxnet_tpu.telemetry import flightrec as _bb
+from incubator_mxnet_tpu.telemetry import history, reqtrace, slo
+from incubator_mxnet_tpu.telemetry.spans import wall_of
+
+pytestmark = pytest.mark.reqtrace
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                     "..", "..", ".."))
+
+
+@pytest.fixture
+def hist_dir(tmp_path, monkeypatch):
+    """Private MXNET_HISTORY_DIR + fresh writer + clean rule/journal
+    registries on both sides of every test."""
+    d = tmp_path / "hist"
+    monkeypatch.setenv("MXNET_HISTORY_DIR", str(d))
+    history.reset()
+    slo.clear_rules()
+    reqtrace.reset()
+    yield str(d)
+    slo.clear_rules()
+    history.reset()
+    reqtrace.reset()
+
+
+@pytest.fixture
+def clean_journals():
+    reqtrace.reset()
+    yield
+    reqtrace.reset()
+
+
+def _retire_one(j, e2e_s, lane="high", status=None, exc=None,
+                stamps=True):
+    """Synthesize one retired request with an exact e2e: explicit
+    t_done makes promotion deterministic regardless of test-host
+    scheduling."""
+    t0 = time.monotonic() - e2e_s
+    rec = j.start(t0, lane)
+    assert rec is not None
+    if stamps:
+        rec.t_collect = t0 + e2e_s * 0.70       # queue dominates
+        rec.t_exec = t0 + e2e_s * 0.75
+        rec.t_infer0 = t0 + e2e_s * 0.78
+        rec.t_infer1 = t0 + e2e_s * 0.95
+        rec.t_fin = t0 + e2e_s * 0.99
+    return j.retire(rec, exc=exc, status=status, t_done=t0 + e2e_s)
+
+
+# ---------------------------------------------------------------------------
+# the journal itself
+# ---------------------------------------------------------------------------
+
+def test_ring_bounded_under_churn(clean_journals):
+    j = reqtrace.Journal("serve", "m", ring=8, window=16)
+    for i in range(100):
+        _retire_one(j, 0.001 + i * 1e-6)
+    snap = j.snapshot()
+    assert j.records == 100
+    assert snap["ring"] == 8                # bounded, newest kept
+    assert snap["lanes"]["high"]["window_n"] == 16
+    # exemplar retention is bounded too
+    j2 = reqtrace.Journal("serve", "m2", keep=4)
+    for i in range(30):
+        _retire_one(j2, 0.001, status="shed")   # every failure promotes
+    assert j2.promoted == 30
+    assert len(j2.exemplars()) == 4
+
+
+def test_disabled_journal_is_free(clean_journals):
+    prev = reqtrace.enable(False)
+    try:
+        j = reqtrace.Journal("serve", "m")
+        assert j.start(time.monotonic(), "high") is None
+        assert j.retire(None) is None           # caller's guard path
+        assert j.records == 0 and j.snapshot()["lanes"] == {}
+    finally:
+        reqtrace.enable(prev)
+
+
+def test_pinned_p99_promotion_is_deterministic(clean_journals,
+                                               monkeypatch):
+    """With MXNET_REQTRACE_PIN_P99_US set, promotion is a pure
+    threshold compare: below never promotes, above always does —
+    no warm-up window, no host-speed dependence."""
+    monkeypatch.setenv("MXNET_REQTRACE_PIN_P99_US", "5000")
+    j = reqtrace.Journal("serve", "m")
+    for _ in range(50):
+        _retire_one(j, 0.004)                   # 4000µs < pin
+    assert j.promoted == 0
+    _retire_one(j, 0.006)                       # 6000µs > pin
+    assert j.promoted == 1
+    ex = j.exemplars()[0]
+    assert ex["status"] == "ok" and ex["lane"] == "high"
+    assert abs(ex["e2e_us"] - 6000.0) < 1.0
+    # the waterfall partitions e2e exactly, queue dominates by
+    # construction and is named both dominant and budget phase
+    assert abs(sum(ex["phases"].values()) - ex["e2e_us"]) \
+        <= 0.05 * ex["e2e_us"]
+    assert ex["dominant"] == "queue" == ex["budget_phase"]
+
+
+def test_rolling_p99_needs_min_window(clean_journals):
+    """Below MIN_WINDOW ok-samples the threshold is infinite: a cold
+    lane never promotes on latency alone (failures still do)."""
+    j = reqtrace.Journal("serve", "m")
+    for i in range(reqtrace.MIN_WINDOW - 1):
+        _retire_one(j, 10.0 + i)                # absurdly slow, but cold
+    assert j.promoted == 0
+    _retire_one(j, 0.001, exc=Shed("lane over quota"))
+    assert j.promoted == 1                      # failure: always
+
+
+def test_termination_status_mapping(clean_journals):
+    j = reqtrace.Journal("serve", "m")
+    r1 = _retire_one(j, 0.001, exc=Shed("lane high over quota"),
+                     stamps=False)
+    r2 = _retire_one(j, 0.001, exc=DeadlineExceeded("past deadline"),
+                     stamps=False)
+    r3 = _retire_one(j, 0.001, exc=RuntimeError("boom"), stamps=False)
+    assert (r1.status, r2.status, r3.status) == \
+        ("shed", "deadline", "error")
+    assert "boom" in r3.reason
+    # a request that died before any stamp charges its whole wall to
+    # the first phase and names it the budget phase
+    exs = j.exemplars()
+    assert all(e["budget_phase"] == "queue" for e in exs)
+    assert all(set(e["phases"]) == {"queue"} for e in exs)
+
+
+# ---------------------------------------------------------------------------
+# real engines write the journal
+# ---------------------------------------------------------------------------
+
+def _dense_net(seed=7):
+    mx.random.seed(seed)
+    net = gluon.nn.HybridSequential(prefix="rq_")
+    net.add(gluon.nn.Dense(16, in_units=8, activation="relu",
+                           prefix="rq_d1_"),
+            gluon.nn.Dense(4, in_units=16, prefix="rq_d2_"))
+    net.initialize(force_reinit=True)
+    net.hybridize()
+    net(nd.array(onp.zeros((1, 8), onp.float32), ctx=mx.cpu()))
+    return net
+
+
+def test_engine_journal_roundtrip(clean_journals):
+    """Every served request leaves a record; the slowest lane row has
+    the full 6-phase serve waterfall summing to its e2e."""
+    eng = InferenceEngine(_dense_net(), ctx=mx.cpu(), max_batch=8,
+                          max_wait_us=500)
+    try:
+        x = onp.ones(8, onp.float32)
+        for f in [eng.submit(x) for _ in range(12)]:
+            f.result(timeout=60)
+    finally:
+        eng.close()
+    j = eng._journal
+    assert j.records == 12
+    snap = j.snapshot()
+    s = snap["lanes"]["high"]["slowest"]
+    assert set(s["phases"]) == {"queue", "coalesce", "dispatch",
+                                "infer", "join", "resolve"}
+    assert abs(sum(s["phases"].values()) - s["e2e_us"]) \
+        <= 0.05 * s["e2e_us"]
+
+
+def test_engine_refusals_are_recorded(clean_journals):
+    """A born-expired deadline is refused synchronously AND leaves a
+    typed 'deadline' journal record."""
+    eng = InferenceEngine(_dense_net(), ctx=mx.cpu(), max_batch=8,
+                          max_wait_us=500)
+    try:
+        x = onp.ones(8, onp.float32)
+        eng.submit(x).result(timeout=60)        # warm
+        with pytest.raises(DeadlineExceeded):
+            eng.submit(x, deadline=-1.0)        # relative: born expired
+    finally:
+        eng.close()
+    recs = [e for e in eng._journal.exemplars()
+            if e["status"] == "deadline"]
+    assert recs and recs[0]["budget_phase"] == "queue"
+    assert eng._journal.records >= 2
+
+
+# ---------------------------------------------------------------------------
+# admission-time stamping (satellite 3)
+# ---------------------------------------------------------------------------
+
+def test_wall_of_converts_monotonic_to_epoch():
+    t = time.monotonic() - 0.5
+    w = wall_of(t)
+    assert abs((time.time() - w) - 0.5) < 0.05
+
+
+def test_exemplar_ring_event_stamped_at_admission(clean_journals):
+    """The promoted exemplar's flight-recorder event carries the
+    request's ADMISSION wall time, not the retire/delivery time —
+    on the dump timeline the victim lines up with the queue growth
+    that caused it."""
+    _bb.clear()
+    j = reqtrace.Journal("serve", "m")
+    _retire_one(j, 0.5, status="shed")          # admitted 0.5s ago
+    evs = [e for e in _bb.ring_snapshot()
+           if e["kind"] == "reqtrace" and e["name"] == "exemplar"]
+    assert evs, "promotion must leave a ring event"
+    age = time.time() - evs[-1]["ts"]
+    assert 0.4 < age < 0.7, \
+        "event stamped %.3fs ago; admission was 0.5s ago" % age
+
+
+# ---------------------------------------------------------------------------
+# exemplar-on-alert end to end (acceptance path)
+# ---------------------------------------------------------------------------
+
+def _fire_shed_alert(monkeypatch):
+    """Promote 5 synthetic exemplars, overload lane 'high', fire the
+    default shed rule.  Returns (worst exemplar, dump path)."""
+    monkeypatch.setenv("MXNET_REQTRACE_PIN_P99_US", "1000")
+    _bb.clear()
+    j = reqtrace.journal("serve", "demo")
+    for i in range(5):
+        _retire_one(j, 0.010 + i * 0.002)       # all promote (pin 1ms)
+    worst = reqtrace.worst_exemplar(lane="high", engine="serve")
+    assert worst and abs(worst["e2e_us"] - 18000.0) < 1.0
+
+    names = slo.install_default_serving_rules(
+        targets={"high": 0.25}, fast_s=1.0, slow_s=2.0)
+    assert "serve-shed-high" in names
+    t0 = time.time()
+    events.incr("serve.requests", 50, labels={"lane": "high"})
+    slo.evaluate(now=t0)
+    events.incr("serve.shed", 50,
+                labels={"lane": "high", "reason": "lane_quota"})
+    events.incr("serve.requests", 50, labels={"lane": "high"})
+    firing = slo.evaluate(now=t0 + 0.5)
+    assert "serve-shed-high" in firing
+    dump = _bb.last_dump_path()
+    assert dump and "slo-serve-shed-high" in os.path.basename(dump)
+    return worst, dump
+
+
+def test_exemplar_attached_to_firing_alert_and_dump(hist_dir,
+                                                    monkeypatch):
+    worst, dump = _fire_shed_alert(monkeypatch)
+
+    # the active alert carries the full waterfall + scalar fields
+    info = slo.active_alerts()["serve-shed-high"]
+    assert info["exemplar"]["rid"] == worst["rid"]
+    assert info["exemplar_rid"] == worst["rid"]
+    assert info["exemplar_phase"] == "queue"
+
+    # the proactive dump has BOTH the reqtrace block and the attached
+    # exemplar, waterfall summing to e2e within 5%
+    doc = json.load(open(dump))
+    ex = doc["slo"]["active"]["serve-shed-high"]["exemplar"]
+    assert ex["rid"] == worst["rid"]
+    assert abs(sum(ex["phases"].values()) - ex["e2e_us"]) \
+        <= 0.05 * ex["e2e_us"]
+    rt = doc["reqtrace"]
+    assert any(jn["model"] == "demo" for jn in rt["journals"])
+    assert any(e["rid"] == worst["rid"] for e in rt["exemplars"])
+
+    # the firing transition's history row keeps the scalar pointers
+    rows = history.query("serve-shed-high", kind="slo")
+    fired = [r for r in rows if r.get("event") == "fired"]
+    assert fired and fired[-1]["exemplar_rid"] == worst["rid"]
+
+
+def test_autopsy_cli_names_dominant_phase(hist_dir, monkeypatch,
+                                          capsys):
+    _worst, dump = _fire_shed_alert(monkeypatch)
+    from incubator_mxnet_tpu.tools import blackbox as bb_cli
+    assert bb_cli.main(["autopsy", dump]) == 0
+    out = capsys.readouterr().out
+    assert "autopsy — request #" in out
+    assert "<- budget" in out
+    assert "verdict:" in out and "'queue'" in out
+    # summarize view shows the reqtrace section + suspected cause
+    assert bb_cli.main([dump]) == 0
+    out = capsys.readouterr().out
+    assert "reqtrace" in out
+    assert "run `blackbox autopsy" in out
+    # --rid miss is a clean rc=1, not a traceback
+    assert bb_cli.main(["autopsy", dump, "--rid", "999999"]) == 1
+
+
+def test_autopsy_lines_golden(clean_journals):
+    from incubator_mxnet_tpu.tools.blackbox import (autopsy_lines,
+                                                    slow_request_family)
+    ex = {"rid": 7, "engine": "serve", "model": "demo", "lane": "high",
+          "status": "ok", "e2e_us": 10000.0, "n": 4, "bucket": 8,
+          "ts": time.time(), "dominant": "queue",
+          "budget_phase": "queue",
+          "phases": {"queue": 9000.0, "coalesce": 200.0,
+                     "dispatch": 100.0, "infer": 500.0,
+                     "join": 150.0, "resolve": 50.0}}
+    txt = "\n".join(autopsy_lines(ex))
+    assert "request #7" in txt and "lane high" in txt
+    assert "90.0%" in txt and "<- budget" in txt
+    fam, advice = slow_request_family(ex)
+    assert fam and advice
+    # waterfall rows come in ladder order (life of the request)
+    assert txt.index("queue") < txt.index("coalesce") \
+        < txt.index("infer") < txt.index("resolve")
+
+
+def test_teletop_shows_slowest_rows(clean_journals, monkeypatch):
+    monkeypatch.setenv("MXNET_REQTRACE_PIN_P99_US", "1000")
+    j = reqtrace.journal("serve", "demo")
+    _retire_one(j, 0.012)
+    from incubator_mxnet_tpu import telemetry
+    from incubator_mxnet_tpu.tools import teletop
+    out = teletop.render(
+        json.loads(telemetry.MetricsExporter().json_text()))
+    assert "reqtrace" in out
+    assert "demo" in out and "high" in out and "queue" in out
+
+
+def test_prometheus_exemplar_gauges(clean_journals, monkeypatch):
+    monkeypatch.setenv("MXNET_REQTRACE_PIN_P99_US", "1000")
+    j = reqtrace.journal("serve", "demo")
+    rec = _retire_one(j, 0.015)
+    from incubator_mxnet_tpu import telemetry
+    txt = telemetry.MetricsExporter().prometheus_text()
+    assert "mxnet_request_exemplar_e2e_us" in txt
+    assert 'engine="serve"' in txt and 'lane="high"' in txt
+    assert 'rid="%d"' % rec.rid in txt
+    assert 'mxnet_request_exemplar_phase_us' in txt \
+        and 'phase="queue"' in txt
+
+
+# ---------------------------------------------------------------------------
+# cost-model drift (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_cost_drift_rule_lifecycle(hist_dir):
+    """Prior run decided serve_buckets from measured probes; this
+    run's probes contradict that basis 3x → the drift rule fires,
+    invalidates the key, the next suggest re-resolves from this run's
+    rows as a typed refresh decision, and the rule clears."""
+    from incubator_mxnet_tpu.compile import autotune
+    autotune.reset()
+    # -- fake PRIOR run: two probed candidates, '8,16' won at 100µs
+    prior = history.HistoryWriter(directory=hist_dir, run="run-prior")
+    for v, us in (("8,16", 100.0), ("4,8", 300.0)):
+        prior.append("autotune", "probe", us,
+                     labels={"knob": "serve_buckets",
+                             "label": "serve.infer:demo", "value": v})
+    prior.append("autotune", "decision", 1.0,
+                 labels={"knob": "serve_buckets",
+                         "label": "serve.infer:demo",
+                         "source": "measured"},
+                 chosen="8,16", rows=2, best_us=100.0)
+    prior.flush()
+
+    # -- THIS run measures the chosen value at 3x the decision basis
+    for _ in range(3):
+        autotune.note_probe("serve_buckets", "serve.infer:demo",
+                            "8,16", 300.0, source="test")
+    history.flush()
+    ev = autotune.drift_evidence("serve_buckets", "serve.infer:demo")
+    assert ev and ev["drift"] and ev["basis"] == "probe_us"
+    assert abs(ev["ratio"] - 3.0) < 0.01
+
+    names = slo.install_cost_drift_rules()
+    assert any("serve_buckets" in n for n in names)
+    rule = [n for n in names if "serve_buckets" in n][0]
+    t0 = time.time()
+    assert rule in slo.evaluate(now=t0)
+    info = slo.active_alerts()[rule]
+    assert info["labels"] == {"knob": "serve_buckets",
+                              "label": "serve.infer:demo"}
+    assert abs(info["ratio"] - 3.0) < 0.01
+    # firing invalidated the key
+    assert autotune.invalidated("serve_buckets", "serve.infer:demo")
+
+    # -- next suggest must re-resolve from THIS run only, typed
+    autotune.note_probe("serve_buckets", "serve.infer:demo",
+                        "4,8", 200.0, source="test")
+    history.flush()
+    chosen = autotune.suggest("serve_buckets", "serve.infer:demo",
+                              candidates=["8,16", "4,8"],
+                              fallback=lambda: ("8,16", "default", {}))
+    assert chosen == "4,8"          # this run's argmin, not the stale
+    dec = autotune.decisions()[-1]
+    assert dec["source"] == "measured-refresh"
+    assert dec["evidence"]["drift_refresh"] is True
+    assert not autotune.invalidated("serve_buckets",
+                                    "serve.infer:demo")
+
+    # -- the refresh decision silences the rule (unjudgeable), which
+    # clears after the debounce rounds
+    history.flush()
+    assert autotune.drift_evidence(
+        "serve_buckets", "serve.infer:demo") is None
+    for i in range(slo.UNJUDGED_CLEAR_ROUNDS):
+        assert rule not in slo.evaluate(now=t0 + 1 + i)
+    assert rule not in slo.active_alerts()
+    autotune.reset()
+
+
+def test_cost_drift_unjudgeable_without_prior(hist_dir):
+    from incubator_mxnet_tpu.compile import autotune
+    autotune.reset()
+    assert autotune.drift_evidence("serve_buckets", "nope") is None
+    r = slo.CostDriftRule("autotune-cost-drift-x", "serve_buckets",
+                          "nope")
+    assert r.check(time.time()) == (None, {})
+
+
+# ---------------------------------------------------------------------------
+# probe writers outside bench/ (satellite 2)
+# ---------------------------------------------------------------------------
+
+def test_serving_warmup_writes_probe(hist_dir, clean_journals):
+    eng = InferenceEngine(_dense_net(), ctx=mx.cpu(), max_batch=8,
+                          max_wait_us=500)
+    try:
+        eng.submit(onp.ones(8, onp.float32)).result(timeout=60)
+        eng.warmup()
+    finally:
+        eng.close()
+    history.flush()
+    rows = history.query("probe", kind="autotune",
+                         labels={"knob": "serve_buckets"})
+    assert rows and rows[-1]["source"] == "serve.warmup"
+    assert rows[-1]["v"] > 0
+
+
+def test_trainer_step_writes_probe(hist_dir):
+    from incubator_mxnet_tpu import parallel
+    net = gluon.nn.HybridSequential(prefix="rqt_")
+    net.add(gluon.nn.Dense(8, in_units=4, prefix="rqt_d1_"))
+    net.initialize(force_reinit=True)
+    net(nd.ones((2, 4)))
+    tr = parallel.ShardedTrainer(net, optimizer="sgd", lr=1e-2)
+    x = onp.random.RandomState(0).randn(8, 4).astype(onp.float32)
+    y = onp.zeros(8, onp.int64)
+    for _ in range(3):              # probe fires on warm step 2
+        tr.step(x, y)
+    history.flush()
+    rows = history.query("probe", kind="autotune",
+                         labels={"knob": "batch_size",
+                                 "label": "sharded.step"})
+    assert rows and rows[-1]["labels"]["value"] == "8"
+    assert rows[-1]["source"] == "trainer.step"
+
+
+# ---------------------------------------------------------------------------
+# two-process durable-exemplar proof
+# ---------------------------------------------------------------------------
+
+_RUN1 = r"""
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MXNET_HISTORY_DIR"] = sys.argv[1]
+os.environ["MXNET_REQTRACE_PIN_P99_US"] = "1000"
+from incubator_mxnet_tpu.telemetry import history, reqtrace
+j = reqtrace.journal("serve", "demo")
+t0 = time.monotonic() - 0.02
+rec = j.start(t0, "high")
+rec.t_collect = t0 + 0.015
+rec.t_exec = t0 + 0.016
+rec.t_infer0 = t0 + 0.0165
+rec.t_infer1 = t0 + 0.019
+rec.t_fin = t0 + 0.0195
+j.retire(rec, t_done=t0 + 0.02)
+assert j.promoted == 1, j.promoted
+history.flush()
+print("RUN1_ID=%s" % history.get_writer().run)
+"""
+
+
+def test_two_process_exemplar_history(hist_dir):
+    """Run 1 (separate process) promotes an exemplar; run 2 (this
+    process) reads its durable row — the slow request survives the
+    process that served it."""
+    env = dict(os.environ)
+    env.pop("MXNET_HISTORY_DIR", None)
+    res = subprocess.run(
+        [sys.executable, "-c", _RUN1, hist_dir], cwd=_ROOT, env=env,
+        capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr[-2000:]
+    run1 = [ln for ln in res.stdout.splitlines()
+            if ln.startswith("RUN1_ID=")][0].split("=", 1)[1]
+    assert history.get_writer().run != run1
+    rows = history.query("exemplar", kind="reqtrace", run=run1,
+                         labels={"engine": "serve"})
+    assert rows, "run 1's exemplar row not visible to run 2"
+    r = rows[-1]
+    assert r["labels"]["lane"] == "high"
+    assert r["status"] == "ok" and r["dominant"] == "queue"
+    assert abs(r["v"] - 20000.0) < 500.0        # e2e µs rides as v
+    assert abs(sum(r["phases"].values()) - r["v"]) <= 0.05 * r["v"]
+
+
+# ---------------------------------------------------------------------------
+# the overhead gate (slow: tier-1 skips it, CI runs it)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_reqtrace_overhead_gate():
+    """tools/check_overhead.py --what serve: tracing-on vs tracing-off
+    serving loop stays under the 2% budget."""
+    script = os.path.join(_ROOT, "tools", "check_overhead.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(script), "--what", "serve",
+         "--requests", "400", "--repeats", "2"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "check_overhead_reqtrace" in res.stdout

@@ -9,6 +9,14 @@ exits nonzero when the measured overhead exceeds the threshold
 
     JAX_PLATFORMS=cpu python tools/check_overhead.py
     python tools/check_overhead.py --steps 200 --threshold 2.0
+    python tools/check_overhead.py --what serve   # reqtrace gate only
+
+Two gates share the harness (ISSUE 19): the train loop measures the
+flight recorder (`flightrec.enable`), and the serving loop measures
+the per-request tracer (`reqtrace.enable`) over submit→result round
+trips — the <2%% tracing-overhead contract reqtrace.py promises.
+Each writes its own gate_report artifact (`check_overhead`,
+`check_overhead_reqtrace`).
 
 Methodology: each mode gets its own freshly-built trainer (so compile
 cost is identical and excluded by warmup), modes run interleaved
@@ -80,12 +88,118 @@ def _timed_loop(recorder_on, steps, warmup, hidden, batch):
         flightrec.enable(prev)
 
 
+def _build_engine(hidden=32, in_dim=8, seed=11):
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, nd
+    from incubator_mxnet_tpu.serving import InferenceEngine
+    mx.random.seed(seed)
+    net = gluon.nn.HybridSequential(prefix="ovs_")
+    net.add(gluon.nn.Dense(hidden, in_units=in_dim,
+                           activation="relu", prefix="ovs_d1_"),
+            gluon.nn.Dense(hidden, in_units=hidden, prefix="ovs_d2_"))
+    net.initialize(force_reinit=True)
+    net.hybridize()
+    net(nd.array(np.zeros((1, in_dim), np.float32), ctx=mx.cpu()))
+    eng = InferenceEngine(net, ctx=mx.cpu(), max_batch=8,
+                          max_wait_us=200)
+    x = np.random.RandomState(seed).rand(in_dim).astype(np.float32)
+    return eng, x
+
+
+def _timed_serve_loop(tracing_on, requests, warmup, window=64):
+    """One serving trial half: `requests` submit→result round trips
+    through a fresh engine with request tracing forced on or off
+    (`reqtrace.enable`).  Futures resolve in bounded windows so the
+    queue never grows past `window` — the measured wall is the
+    steady-state submit path (journal start/stamp/retire), not a
+    growing backlog."""
+    from incubator_mxnet_tpu.telemetry import reqtrace
+    prev = reqtrace.enable(bool(tracing_on))
+    eng = None
+    try:
+        eng, x = _build_engine()
+        for f in [eng.submit(x) for _ in range(max(1, warmup))]:
+            f.result(timeout=30)        # compile + warm the path
+        t0 = time.perf_counter()
+        pend = []
+        for _ in range(requests):
+            pend.append(eng.submit(x))
+            if len(pend) >= window:
+                for f in pend:
+                    f.result(timeout=30)
+                pend = []
+        for f in pend:
+            f.result(timeout=30)
+        return time.perf_counter() - t0
+    finally:
+        if eng is not None:
+            eng.close()
+        reqtrace.enable(prev)
+
+
+def _run_gate(gate, what, run_one, args):
+    """One best-of-`--trials` interleaved off/on gate: `run_one(mode)`
+    returns the timed wall with the instrumented path off (False) or
+    on (True).  Returns (failed, trial_rows, overheads) and writes
+    the gate_report artifact."""
+    import statistics
+    from gate_report import write_report
+    overheads = []
+    trial_rows = []
+    for t in range(max(1, args.trials)):
+        best = {False: float("inf"), True: float("inf")}
+        for r in range(args.repeats):
+            for mode in (False, True):
+                wall = run_one(mode)
+                best[mode] = min(best[mode], wall)
+                print("[%s] trial %d round %d %s=%-5s wall=%.3fs"
+                      % (gate, t, r, what, mode, wall))
+        overhead = 100.0 * (best[True] - best[False]) / best[False]
+        overheads.append(overhead)
+        trial_rows.append({
+            "trial": t, "best_off_s": round(best[False], 4),
+            "best_on_s": round(best[True], 4),
+            "overhead_pct": round(overhead, 3),
+            "verdict": "pass" if overhead <= args.threshold
+            else "fail"})
+        print("[%s] trial %d: best off=%.3fs on=%.3fs "
+              "overhead=%.2f%% (threshold %.2f%%)"
+              % (gate, t, best[False], best[True], overhead,
+                 args.threshold))
+        if overhead <= args.threshold:
+            break
+    print("[%s] per-trial overhead: [%s]  median=%.2f%%  best=%.2f%%"
+          % (gate, ", ".join("%.2f%%" % o for o in overheads),
+             statistics.median(overheads), min(overheads)))
+    failed = min(overheads) > args.threshold
+    write_report(
+        gate, "fail" if failed else "pass", trial_rows,
+        rc=1 if failed else 0,
+        params={"threshold_pct": args.threshold, "steps": args.steps,
+                "requests": args.requests,
+                "repeats": args.repeats, "trials": args.trials},
+        extra={"median_overhead_pct": round(
+            statistics.median(overheads), 3)})
+    return failed
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="check_overhead",
-        description="fail (rc!=0) when the flight recorder costs more "
-        "than --threshold %% on a synthetic train loop")
+        description="fail (rc!=0) when the flight recorder (train "
+        "loop) or the request tracer (serving loop) costs more than "
+        "--threshold %%")
+    ap.add_argument("--what", choices=("train", "serve", "all"),
+                    default="all",
+                    help="train = flight-recorder overhead on the "
+                    "synthetic train loop; serve = reqtrace overhead "
+                    "on a serving submit/result loop; all = both "
+                    "gates (default)")
     ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--requests", type=int, default=600,
+                    help="serving-loop submit/result round trips per "
+                    "timed window")
     ap.add_argument("--warmup", type=int, default=10)
     ap.add_argument("--repeats", type=int, default=2,
                     help="interleaved off/on pairs per trial; best "
@@ -100,50 +214,27 @@ def main(argv=None) -> int:
                     help="max tolerated overhead percent")
     args = ap.parse_args(argv)
 
-    import statistics
-    from gate_report import write_report
-    overheads = []
-    trial_rows = []
-    for t in range(max(1, args.trials)):
-        best = {False: float("inf"), True: float("inf")}
-        for r in range(args.repeats):
-            for mode in (False, True):
-                wall = _timed_loop(mode, args.steps, args.warmup,
-                                   args.hidden, args.batch)
-                best[mode] = min(best[mode], wall)
-                print("trial %d round %d recorder=%-5s wall=%.3fs "
-                      "(%.0f steps/s)"
-                      % (t, r, mode, wall, args.steps / wall))
-        overhead = 100.0 * (best[True] - best[False]) / best[False]
-        overheads.append(overhead)
-        trial_rows.append({
-            "trial": t, "best_off_s": round(best[False], 4),
-            "best_on_s": round(best[True], 4),
-            "overhead_pct": round(overhead, 3),
-            "verdict": "pass" if overhead <= args.threshold
-            else "fail"})
-        print("trial %d: best off=%.3fs on=%.3fs overhead=%.2f%% "
-              "(threshold %.2f%%)"
-              % (t, best[False], best[True], overhead, args.threshold))
-        if overhead <= args.threshold:
-            break
-    print("per-trial overhead: [%s]  median=%.2f%%  best=%.2f%%"
-          % (", ".join("%.2f%%" % o for o in overheads),
-             statistics.median(overheads), min(overheads)))
-    failed = min(overheads) > args.threshold
-    write_report(
-        "check_overhead", "fail" if failed else "pass", trial_rows,
-        rc=1 if failed else 0,
-        params={"threshold_pct": args.threshold, "steps": args.steps,
-                "repeats": args.repeats, "trials": args.trials},
-        extra={"median_overhead_pct": round(
-            statistics.median(overheads), 3)})
-    if failed:
-        print("FAIL: flight-recorder overhead above threshold in all "
-              "%d trial(s)" % len(overheads), file=sys.stderr)
-        return 1
-    print("OK")
-    return 0
+    rc = 0
+    if args.what in ("train", "all"):
+        failed = _run_gate(
+            "check_overhead", "recorder",
+            lambda mode: _timed_loop(mode, args.steps, args.warmup,
+                                     args.hidden, args.batch), args)
+        if failed:
+            print("FAIL: flight-recorder overhead above threshold in "
+                  "all trial(s)", file=sys.stderr)
+            rc = 1
+    if args.what in ("serve", "all"):
+        failed = _run_gate(
+            "check_overhead_reqtrace", "tracing",
+            lambda mode: _timed_serve_loop(mode, args.requests,
+                                           args.warmup), args)
+        if failed:
+            print("FAIL: request-tracing overhead above threshold in "
+                  "all trial(s)", file=sys.stderr)
+            rc = 1
+    print("OK" if rc == 0 else "FAILED")
+    return rc
 
 
 if __name__ == "__main__":
