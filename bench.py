@@ -1089,6 +1089,33 @@ def run_generate(duration_s=5.0, capacity_s=1.5, hi_frac=0.2,
     return out
 
 
+def _peak_hbm_block():
+    """``{"peak_hbm_bytes": {device: {bytes, source}}}`` for a bench
+    json block (ISSUE 20): the per-device peak watermark memwatch
+    observed this process (max across phases, forced sample so it
+    works with MXNET_MEMWATCH=0 too), with the sampling source
+    spelled out — PJRT ``memory_stats`` on a real accelerator, the
+    ``live_arrays`` fallback on this CPU host — so a trajectory diff
+    can tell a real footprint regression from a measurement-source
+    change.  {} when nothing is measurable."""
+    try:
+        from incubator_mxnet_tpu.telemetry import memwatch as _mw
+        smp = _mw.sample(tag="bench", force=True)
+        if not smp:
+            return {}
+        marks = _mw.watermarks()
+        out = {}
+        for dev, row in (smp.get("devices") or {}).items():
+            peak = max([int(row.get("peak_bytes", 0)),
+                        int(row.get("used_bytes", 0))] +
+                       [int(m.get(dev, 0)) for m in marks.values()])
+            out[dev] = {"bytes": peak,
+                        "source": str(row.get("source", "?"))}
+        return {"peak_hbm_bytes": out} if out else {}
+    except Exception:               # noqa: BLE001 — observability
+        return {}                   # must never fail a bench
+
+
 def _merge_bench_serve(patch, rc=0):
     """Merge `patch` keys into BENCH_serve.json's parsed record
     (creating it if absent) — `bench.py generate` rides in the same
@@ -1116,6 +1143,8 @@ def _write_bench_serve(parsed, rc=0):
         m = re.fullmatch(r"BENCH_r(\d+)\.json", f)
         if m:
             n = max(n, int(m.group(1)))
+    parsed = dict(parsed)
+    parsed.update(_peak_hbm_block())
     line = json.dumps(parsed)
     blob = {"n": n, "cmd": "python bench.py serve", "rc": rc,
             "tail": line + "\n", "parsed": parsed}
@@ -1311,6 +1340,8 @@ def _write_multichip_elastic(parsed, rc=0):
     """MULTICHIP_elastic.json in the MULTICHIP_r* schema
     ({n_devices, rc, ok, skipped, tail}) so the multichip trajectory
     tooling picks the elastic scenario up alongside the scaling runs."""
+    parsed = dict(parsed)
+    parsed.update(_peak_hbm_block())
     # ok only when the scenario actually EXERCISED elasticity: a clean
     # rc with no shrink/grow means the fault never fired (heartbeat
     # regression, kill_at >= steps) — reporting that as a pass would be
@@ -1907,6 +1938,8 @@ def _write_multichip_scaling(parsed, rc=0):
     overlap-first path beat the legacy path, and ZeRO-3's per-replica
     memory is genuinely sharded — the claims this PR makes, measured;
     the raw weak_eff rides in parsed + tail with host context."""
+    parsed = dict(parsed)
+    parsed.update(_peak_hbm_block())
     eff = parsed.get("weak_eff", 0.0)
     eff_l = parsed.get("weak_eff_legacy", 0.0)
     frac = parsed.get("zero3_param_frac_of_unsharded", 1.0)
@@ -2169,6 +2202,8 @@ def _write_bench_integrity(parsed, rc=0):
     ok only when every injected corruption was DETECTED and RECOVERED
     (quarantine exact + budget respected + clean stream bit-identical,
     checkpoint salvaged, divergent replica evicted, run completed)."""
+    parsed = dict(parsed)
+    parsed.update(_peak_hbm_block())
     exercised = (
         parsed.get("integrity_records_quarantined") ==
         parsed.get("integrity_records_poisoned") and
@@ -2505,6 +2540,8 @@ def _write_bench_controlplane(parsed, rc=0):
     own (bad version rolled back with the breaching rule named +
     blackbox dumped, load spike absorbed by a ledger-admitted
     scale-up with the hi lane back inside its deadline)."""
+    parsed = dict(parsed)
+    parsed.update(_peak_hbm_block())
     ok = parsed.get("controlplane_ok")
     if ok is True:
         tail = ("controlplane ok: v2 rolled back by rule %s "
@@ -3427,6 +3464,7 @@ def main():
         "vs_baseline": round(headline / V100_IMAGES_PER_SEC, 4),
         "batch": batch,
         "path": "gluon hybridize->CachedOp->Trainer (north-star config 1)",
+        **_peak_hbm_block(),
         **extra,
     }))
     return 0 if headline else 1     # headline failure -> non-zero exit

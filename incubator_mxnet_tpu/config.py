@@ -686,3 +686,36 @@ register("MXNET_REQTRACE_PIN_P99_US", float, 0.0,
          "When > 0, replaces the rolling per-lane p99 promotion "
          "threshold with this fixed e2e value in µs — deterministic "
          "promotion for tests and drills.  0 = rolling threshold")
+register("MXNET_MEMWATCH", bool, True,
+         "Sampled per-device memory observatory (telemetry/"
+         "memwatch.py): PJRT memory_stats (jax.live_arrays fallback "
+         "on statless backends), tenant attribution against the "
+         "serving ledger / KV pools / ZeRO plans, per-phase peak "
+         "watermarks, and the mem-drift SLO rule's evidence.  On by "
+         "default — sampling rides the exporter tick and dump/warmup "
+         "transitions, never a request or step path; held to <2% by "
+         "tools/check_overhead.py's memwatch serving trial")
+register("MXNET_MEMWATCH_MIN_S", float, 0.25,
+         "Probe throttle: an unforced memwatch.sample() within this "
+         "many seconds of the previous sample returns it unchanged "
+         "instead of re-probing (live_arrays scans are O(live "
+         "buffers)) — phase transitions and forced OOM/dump/bench "
+         "samples always probe; 0 disables the throttle (tests)")
+register("MXNET_MEMWATCH_RING", int, 128,
+         "Bounded ring of retained memwatch samples (teletop pane + "
+         "dump block read the newest; watermarks aggregate across "
+         "the whole run regardless)")
+register("MXNET_MEMWATCH_DRIFT_FACTOR", float, 1.5,
+         "slo.MemDriftRule threshold: a tenant whose measured "
+         "resident bytes contradict its ledger commitment by more "
+         "than this factor (either direction) fires the mem-drift "
+         "alert and re-reconciles the ledger row")
+register("MXNET_MEMWATCH_FRESH_S", float, 30.0,
+         "Maximum age in seconds for a memwatch sample to count as "
+         "FRESH: the controlplane HBM-pressure upgrade, the "
+         "registry's stats() measured_bytes/drift columns and the "
+         "drift rule all fall back to ledger estimates (or go "
+         "unjudgeable) on staler samples")
+register("MXNET_MEMWATCH_TOP", int, 5,
+         "Top-N consumers carried on a firing mem-drift alert, the "
+         "blackbox memwatch block and the memautopsy verdict table")

@@ -72,6 +72,12 @@ Sites are plain strings; the built-in ones:
                         arrival rate DOUBLES — the deterministic
                         trigger for the FleetSupervisor's scale-up
                         path
+    serve.oom           InferenceEngine / GenerationEngine warmup:
+                        raises TransientFault with a
+                        "RESOURCE_EXHAUSTED" message — the injected
+                        allocation failure the memwatch OOM-forensics
+                        path (proactive blackbox dump + memautopsy)
+                        is exercised with on a CPU host
     model.bad_version   ModelRegistry.register_version: the version
                         admitted while armed is TAINTED — its engine
                         stalls every batch by MXNET_CTL_DEGRADE_S and

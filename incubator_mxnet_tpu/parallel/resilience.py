@@ -401,6 +401,13 @@ class ResilientTrainer:
             # observable here: dispatch → loss/ok materialized
             ok = bool(ok)
             loss = float(loss)
+        except Exception as e:
+            # allocator OOM through the guarded path: capture a
+            # blackbox dump with the memory attribution join before
+            # the unwind releases the arrays (ISSUE 20)
+            from ..telemetry import memwatch as _mw
+            _mw.guard_oom("train.step", e)
+            raise
         finally:
             step_span.stop()
         t2 = time.perf_counter()

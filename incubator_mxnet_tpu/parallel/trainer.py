@@ -262,6 +262,15 @@ class ShardedTrainer:
         if len(self.mesh.axis_names) == 1 and jax.process_count() == 1:
             from .dispatch import DispatchPool
             self._dispatch = DispatchPool(mesh_devices(self.mesh))
+        # memory observatory (ISSUE 20): weak-track this trainer so
+        # the attribution join can price its parameter placement (and
+        # ZeRO plan) against measured device bytes — a WeakSet add,
+        # nothing on the step path
+        try:
+            from ..telemetry import memwatch as _mw
+            _mw.track_trainer(self)
+        except Exception:           # noqa: BLE001 — observability
+            pass                    # must never block construction
 
     def _place_value(self, value, sharding):
         """Host value → global array on `sharding`.  Multi-controller:
@@ -581,8 +590,16 @@ class ShardedTrainer:
         if rng_bits is None:
             rng_bits = jax.random.key_data(_rnd.split_key())
         t1 = time.perf_counter() if tele is not None else 0.0
-        self.params, self.opt_state, loss = self._step(
-            self.params, self.opt_state, batch, labels, rng_bits)
+        try:
+            self.params, self.opt_state, loss = self._step(
+                self.params, self.opt_state, batch, labels, rng_bits)
+        except Exception as e:
+            # allocator OOM at dispatch: dump committed-vs-measured
+            # per tenant before the unwind frees the evidence
+            # (ISSUE 20); zero-cost until an exception actually raises
+            from ..telemetry import memwatch as _mw
+            _mw.guard_oom("train.step", e)
+            raise
         self._n_step += 1
         if self._zero_plan is not None:
             # bytes-on-wire attribution: bump every bucket collective's

@@ -447,11 +447,41 @@ class FleetSupervisor:
             return 0
 
     def _hbm_pressured(self):
+        """Whether any pool device sits above the pressure fraction of
+        its budget.  Upgraded by the memory observatory (ISSUE 20):
+        when a FRESH measured sample exists, the MEASURED watermark
+        judges pressure instead of the committed-ledger estimate —
+        admission projections routinely drift from allocator reality,
+        and shrinking capacity off a wrong ledger is the supervisor
+        hurting the fleet.  Every pressure decision records BOTH
+        values (ledger + measured, with the judging basis), so the
+        forensic trail shows which number the supervisor believed."""
+        pressured, decisive = False, None
         for row in self._reg.stats()["ledger"]:
-            if row["budget"] > 0 and \
-                    row["committed"] >= self._pressure * row["budget"]:
-                return True
-        return False
+            if row["budget"] <= 0:
+                continue
+            ledger = row["committed"]
+            # stats() annotates measured_bytes from a fresh memwatch
+            # sample (None on stale/absent samples) — the freshness
+            # contract lives in one place
+            m = row.get("measured_bytes")
+            basis = "measured" if m is not None else "ledger"
+            used = m if m is not None else ledger
+            if used >= self._pressure * row["budget"]:
+                pressured = True
+                decisive = {"device": row["device"],
+                            "budget": int(row["budget"]),
+                            "ledger_bytes": int(ledger),
+                            "measured_bytes": (int(m) if m is not None
+                                               else None),
+                            "basis": basis}
+                break
+        events.incr("controlplane.hbm_pressure_checks",
+                    labels={"pressured": str(bool(pressured)).lower()})
+        if pressured:
+            _bb.record("controlplane", "hbm_pressure",
+                       model=self._model, **decisive)
+        return pressured
 
     def _tick_scale(self, now, alerts):
         evidence = sorted(n for n in alerts if n in self._scale_rules)

@@ -1378,12 +1378,26 @@ class InferenceEngine:
         except Exception:           # noqa: BLE001
             _prewarm = None
         per_bucket = {}
-        for i in range(len(self._ctxs)):
-            for b in self._buckets:
-                x = _np.zeros((b,) + self._example_shape, dtype)
-                tb = time.monotonic()
-                self._run(i, x)
-                per_bucket[b] = round(time.monotonic() - tb, 4)
+        try:
+            # the deterministic OOM drill: the serve.oom fault site
+            # raises a RESOURCE_EXHAUSTED-shaped failure here, through
+            # the same catch the real allocator failure takes
+            fault.maybe_raise(
+                "serve.oom", 0, msg="RESOURCE_EXHAUSTED: out of "
+                "memory while warming %r (injected)" % self._cost_label)
+            for i in range(len(self._ctxs)):
+                for b in self._buckets:
+                    x = _np.zeros((b,) + self._example_shape, dtype)
+                    tb = time.monotonic()
+                    self._run(i, x)
+                    per_bucket[b] = round(time.monotonic() - tb, 4)
+        except Exception as e:
+            # an allocator OOM while materializing the bucket ladder:
+            # dump committed-vs-measured BEFORE unwinding releases the
+            # buffers that prove who was resident (ISSUE 20)
+            from ..telemetry import memwatch as _mw
+            _mw.guard_oom("serve.warmup", e)
+            raise
         self._warm = True
         events.incr("serve.warmups")
         # probe row OUTSIDE bench (ISSUE 19 satellite / ROADMAP item 2

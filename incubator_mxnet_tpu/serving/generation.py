@@ -554,22 +554,34 @@ class GenerationEngine:
         contract).  Returns a summary dict."""
         import jax
         t0 = time.monotonic()
-        if self._cache is None:
-            self._init_cache_arrays()
-        dev = self._ctx.jax_device
         per_bucket = {}
-        for b in self._buckets:
-            src = jax.device_put(
-                _np.full((1, b), self._bos, _np.int32), dev)
-            vl = jax.device_put(_np.full((1,), b, _np.int32), dev)
-            tb = time.monotonic()
-            row = self._prefill(self._params, src, vl)
-            jax.block_until_ready(jax.tree_util.tree_leaves(row)[0])
-            per_bucket[b] = round(time.monotonic() - tb, 4)
-        self._cache = self._join(self._cache, row,
-                                 jax.device_put(_np.int32(0), dev))
-        nxt, self._cache = self._decode(self._params, self._cache)
-        _np.asarray(nxt)                # sync
+        try:
+            # same deterministic OOM drill + forensic catch as the
+            # one-shot engine's warmup: the KV slot cache allocated
+            # here is exactly the residency an OOM dump must attribute
+            fault.maybe_raise(
+                "serve.oom", 0, msg="RESOURCE_EXHAUSTED: out of "
+                "memory while warming %r (injected)" % self._label)
+            if self._cache is None:
+                self._init_cache_arrays()
+            dev = self._ctx.jax_device
+            for b in self._buckets:
+                src = jax.device_put(
+                    _np.full((1, b), self._bos, _np.int32), dev)
+                vl = jax.device_put(_np.full((1,), b, _np.int32), dev)
+                tb = time.monotonic()
+                row = self._prefill(self._params, src, vl)
+                jax.block_until_ready(
+                    jax.tree_util.tree_leaves(row)[0])
+                per_bucket[b] = round(time.monotonic() - tb, 4)
+            self._cache = self._join(self._cache, row,
+                                     jax.device_put(_np.int32(0), dev))
+            nxt, self._cache = self._decode(self._params, self._cache)
+            _np.asarray(nxt)            # sync
+        except Exception as e:
+            from ..telemetry import memwatch as _mw
+            _mw.guard_oom("gen.warmup", e)
+            raise
         self._warm = True
         events.incr("gen.warmups")
         # probe row from the warmup's own measured walls (ISSUE 19

@@ -59,6 +59,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 
 import numpy as _np
 
@@ -69,11 +70,24 @@ from ..context import Context, current_context
 from ..monitor import events
 from ..telemetry import costs as _costs
 from ..telemetry import flightrec as _bb
+from ..telemetry import memwatch as _mw
 from .engine import (InferenceEngine, QueueFull, DeadlineExceeded,
                      EngineClosed, Shed)
 
 __all__ = ["ModelRegistry", "AdmissionDenied", "CircuitOpen",
-           "UnknownModel", "RegistrationTimeout", "project_footprint"]
+           "UnknownModel", "RegistrationTimeout", "project_footprint",
+           "live_registries"]
+
+#: every live registry, weakly — the memwatch attribution join and
+#: the mem-drift reconcile walk these (the controlplane's
+#: _SUPERVISORS pattern)
+_REGISTRIES = weakref.WeakSet()
+
+
+def live_registries():
+    """The live ModelRegistry instances (weak — closed/collected
+    registries drop out)."""
+    return [r for r in list(_REGISTRIES) if not r._closed]
 
 
 class AdmissionDenied(MXNetError):
@@ -286,6 +300,7 @@ class ModelRegistry:
         self._models = {}           # name -> _Entry
         self._closed = False
         _bb.install_crash_hooks()
+        _REGISTRIES.add(self)
 
     @staticmethod
     def _device_budget(ctx, budget):
@@ -369,7 +384,13 @@ class ModelRegistry:
                 _cfg.get("MXNET_SERVE_BUILD_TIMEOUT_S"))
         if build_timeout <= 0:
             fault.maybe_slow("serve.build")
-            return ctor()
+            try:
+                return ctor()
+            except Exception as e:
+                # an allocator OOM during the build IS the forensic
+                # moment: dump who was resident before unwinding
+                _mw.guard_oom("serve.build", e)
+                raise
         box = {"engine": None, "exc": None, "abandoned": False}
         done = threading.Event()
         claim = threading.Lock()
@@ -411,6 +432,7 @@ class ModelRegistry:
                     "build_timeout=); ledger hold rolled back — "
                     "retry or raise the bound" % (name, build_timeout))
         if box["exc"] is not None:
+            _mw.guard_oom("serve.build", box["exc"])
             raise box["exc"]
         return box["engine"]
 
@@ -465,15 +487,19 @@ class ModelRegistry:
             # (construction replicates params onto devices — slow)
             self._models[name] = None
         try:
-            engine = self._build_engine(
-                name,
-                lambda: InferenceEngine(
-                    block, devices=[self._ctxs[i] for i in idxs],
-                    buckets=bset, max_batch=max_batch,
-                    example_shape=example_shape,
-                    wire_dtype=wire_dtype,
-                    cost_label=label, **engine_kw),
-                build_timeout)
+            # deploys watermark under their own memwatch phase: param
+            # replication is the residency step change the steady
+            # envelope must not absorb
+            with _mw.phase("deploy"):
+                engine = self._build_engine(
+                    name,
+                    lambda: InferenceEngine(
+                        block, devices=[self._ctxs[i] for i in idxs],
+                        buckets=bset, max_batch=max_batch,
+                        example_shape=example_shape,
+                        wire_dtype=wire_dtype,
+                        cost_label=label, **engine_kw),
+                    build_timeout)
         except Exception:
             with self._lock:    # roll the admission back — a failed
                 for i in idxs:  # (or timed-out) build must not leak
@@ -1045,7 +1071,11 @@ class ModelRegistry:
         out = {}
         for n in names:
             entry = self._entry(n)
-            out[n] = entry.engine.warmup(**kw)
+            # warmup residency is a phase of its own in the memory
+            # observatory: the compile/replication spike watermarks
+            # under "warmup", never inflating the steady envelope
+            with _mw.phase("warmup"):
+                out[n] = entry.engine.warmup(**kw)
             self.reconcile(n)
         return out if name is None else out[str(name)]
 
@@ -1064,12 +1094,27 @@ class ModelRegistry:
         if measured <= 0 or measured == entry.footprint:
             return measured
         with self._lock:
-            delta = measured - entry.footprint
+            prior = entry.footprint
+            delta = measured - prior
             for i in entry.devices:
                 self._committed[i] = max(0, self._committed[i] + delta)
             entry.footprint, entry.basis = measured, "measured"
+        pct = (delta / prior) if prior > 0 else 1.0
         _bb.record("serve", "footprint_reconciled", model=entry.name,
-                   measured_bytes=int(measured), delta_bytes=int(delta))
+                   measured_bytes=int(measured), delta_bytes=int(delta),
+                   pct_moved=round(pct, 4))
+        if abs(pct) > 0.10:
+            # a reconcile that MOVES the row >10% means the projection
+            # (or a prior measurement) was materially wrong — its own
+            # event + counter so drift trends are countable without
+            # parsing every reconcile (ISSUE 20 satellite)
+            events.incr("serve.footprint_reconcile_large")
+            events.incr("serve.footprint_reconcile_large",
+                        labels={"model": entry.name})
+            _bb.record("serve", "footprint_reconcile_large",
+                       model=entry.name, prior_bytes=int(prior),
+                       measured_bytes=int(measured),
+                       pct_moved=round(pct, 4))
         return measured
 
     # -- introspection / lifecycle -------------------------------------
@@ -1154,11 +1199,27 @@ class ModelRegistry:
                     "breaker": e.breaker.state,
                     "reqtrace": None if j is None else
                     {"records": j.records, "promoted": j.promoted}}
-            ledger = [
-                {"device": repr(c), "budget": b, "committed": u,
-                 "free": (b - u) if b > 0 else None}
-                for c, b, u in zip(self._ctxs, self._budgets,
-                                   self._committed)]
+            # measured columns (ISSUE 20 satellite): a FRESH memwatch
+            # sample annotates each ledger row with the allocator's
+            # view and the drift ratio; stale/absent samples leave
+            # None — the reader always knows whether it is looking at
+            # measurement or just the ledger again
+            measured = None
+            try:
+                measured = _mw.fresh_device_bytes()
+            except Exception:       # noqa: BLE001
+                measured = None
+            ledger = []
+            for c, b, u in zip(self._ctxs, self._budgets,
+                               self._committed):
+                m = None if measured is None else \
+                    measured.get(_mw.device_key(c))
+                ledger.append(
+                    {"device": repr(c), "budget": b, "committed": u,
+                     "free": (b - u) if b > 0 else None,
+                     "measured_bytes": m,
+                     "drift": (round(m / u, 4)
+                               if m is not None and u > 0 else None)})
         return {"models": models, "ledger": ledger}
 
     def drain_all(self, timeout=30.0):

@@ -20,7 +20,8 @@ from __future__ import annotations
 
 import threading
 
-__all__ = ["Storage", "memory_info", "memory_events"]
+__all__ = ["Storage", "memory_info", "memory_events",
+           "live_arrays_events"]
 
 
 def memory_info(device=None):
@@ -74,6 +75,62 @@ def memory_events(devices=None, counters=None):
                     "bytes_in_use": used,
                     "peak_bytes": max(peak, used),
                     "bytes_limit": limit})
+    return out
+
+
+def live_arrays_events(devices=None, counters=None):
+    """`memory_events`-shaped rows computed from `jax.live_arrays()`
+    — the measured-bytes fallback for backends whose PJRT
+    ``memory_stats`` reports nothing (CPU jax, the axon plugin).
+    Each row carries ``source="live_arrays"``; the per-device sum
+    counts every addressable shard on the device that holds it, so
+    replicated and sharded arrays both attribute where their bytes
+    actually live.  There is no allocator here, so ``peak_bytes`` ==
+    ``bytes_in_use`` and ``bytes_limit`` is 0 (unreported)."""
+    import jax
+    if counters is None:
+        from .monitor import events as counters
+    per_dev = {}
+    for arr in jax.live_arrays():
+        try:
+            shards = arr.addressable_shards
+        except Exception:           # noqa: BLE001 — a deleted array
+            shards = None           # must not kill the probe
+        if shards:
+            for sh in shards:
+                d = sh.device
+                key = "%s:%d" % (getattr(d, "platform", "dev"),
+                                 getattr(d, "id", 0))
+                per_dev[key] = per_dev.get(key, 0) \
+                    + int(sh.data.nbytes)
+            continue
+        try:
+            nb = int(arr.nbytes)
+            devs = list(arr.devices())
+        except Exception:           # noqa: BLE001
+            continue
+        for d in devs:
+            key = "%s:%d" % (getattr(d, "platform", "dev"),
+                             getattr(d, "id", 0))
+            per_dev[key] = per_dev.get(key, 0) + nb // max(1,
+                                                          len(devs))
+    want = None
+    if devices is not None:
+        want = set()
+        for d in devices:
+            d = getattr(d, "jax_device", d)
+            want.add("%s:%d" % (getattr(d, "platform", "dev"),
+                                getattr(d, "id", 0)))
+    out = []
+    for key in sorted(per_dev):
+        if want is not None and key not in want:
+            continue
+        used = per_dev[key]
+        counters.observe("mem.bytes_in_use", used)
+        counters.observe("mem.peak_bytes", used)
+        out.append({"device": key, "bytes_in_use": used,
+                    "peak_bytes": used, "bytes_limit": 0,
+                    "source": "live_arrays"})
     return out
 
 

@@ -59,6 +59,7 @@ from . import costs
 from . import flightrec
 from . import fleet
 from . import history
+from . import memwatch
 from . import slo
 from .fleet import (FleetReporter, FleetTelemetry, FleetView,
                     StragglerDetector)
@@ -71,7 +72,8 @@ __all__ = ["SpanContext", "TraceContext", "span", "current", "enable",
            "get_global_step", "emit_foreign", "MetricsExporter",
            "StepTelemetry", "start", "stop", "get_exporter",
            "snapshot_dict", "costs", "flightrec", "fleet", "history",
-           "slo", "FleetReporter", "FleetView", "FleetTelemetry",
+           "memwatch", "slo",
+           "FleetReporter", "FleetView", "FleetTelemetry",
            "StragglerDetector", "ThresholdRule", "BurnRateRule",
            "AnomalyRule", "register_rule", "dump_blackbox",
            "install_crash_hooks"]
@@ -79,7 +81,8 @@ __all__ = ["SpanContext", "TraceContext", "span", "current", "enable",
 #: counter families the condensed snapshot (bench.py JSON) carries
 SNAPSHOT_PREFIXES = ("serve.", "feed.", "train.", "aot.",
                      "resilience.", "mem.", "fault.", "blackbox.",
-                     "mesh.", "fleet.", "slo.", "history.")
+                     "mesh.", "fleet.", "slo.", "history.",
+                     "memwatch.")
 
 _exporter = None
 

@@ -190,6 +190,51 @@ class MetricsExporter:
                     % (mp, base, ex.get("rid"), esc(ph), _fmt(us)))
         return lines + (phase_lines if len(phase_lines) > 1 else [])
 
+    @staticmethod
+    def _memwatch_lines(prefix):
+        """The memory observatory (ISSUE 20) as gauge families:
+        ``<prefix>hbm_used_bytes{device=,source=}`` from the newest
+        sample, ``<prefix>hbm_peak_bytes{device=,phase=}`` from the
+        per-phase watermarks, and
+        ``<prefix>hbm_committed_bytes{device=,tenant=}`` from the
+        attribution join — so a dashboard plots committed vs measured
+        vs peak on one axis.  Guarded on memwatch being ALREADY
+        imported: a scrape never pulls the observatory in just to say
+        'no samples'."""
+        import sys as _sys
+        mw = _sys.modules.get("incubator_mxnet_tpu.telemetry.memwatch")
+        if mw is None:
+            return []
+        smp = mw.last_sample()
+        if smp is None:
+            return []
+        esc = MetricsExporter._escape_label
+        lines = []
+        m = _metric_name(prefix, "hbm_used_bytes")
+        lines.append("# TYPE %s gauge" % m)
+        for dev, d in sorted(smp.get("devices", {}).items()):
+            lines.append('%s{device="%s",source="%s"} %s'
+                         % (m, esc(dev), esc(d.get("source", "?")),
+                            _fmt(d.get("used_bytes", 0))))
+        marks = mw.watermarks()
+        if marks:
+            mp = _metric_name(prefix, "hbm_peak_bytes")
+            lines.append("# TYPE %s gauge" % mp)
+            for ph in sorted(marks):
+                for dev, b in sorted(marks[ph].items()):
+                    lines.append('%s{device="%s",phase="%s"} %s'
+                                 % (mp, esc(dev), esc(ph), _fmt(b)))
+        rows = mw.attribution()
+        if rows:
+            mc = _metric_name(prefix, "hbm_committed_bytes")
+            lines.append("# TYPE %s gauge" % mc)
+            for r in rows:
+                lines.append('%s{device="%s",tenant="%s"} %s'
+                             % (mc, esc(r.get("device")),
+                                esc(r.get("tenant")),
+                                _fmt(r.get("committed_bytes", 0))))
+        return lines
+
     def prometheus_text(self) -> str:
         """Prometheus exposition text (version 0.0.4): counters +
         quantile summaries for every observed sample series (labeled
@@ -266,6 +311,10 @@ class MetricsExporter:
                 lines += self._reqtrace_lines(self._prefix)
             except Exception:       # noqa: BLE001 — exemplars must
                 pass                # never break a scrape either
+            try:
+                lines += self._memwatch_lines(self._prefix)
+            except Exception:       # noqa: BLE001 — the memory
+                pass                # observatory must not either
         return "\n".join(lines) + "\n"
 
     def json_dict(self) -> dict:
@@ -332,6 +381,18 @@ class MetricsExporter:
                         out["reqtrace"] = rblock
             except Exception:       # noqa: BLE001
                 pass
+            # the memory observatory (ISSUE 20) — same guard; teletop
+            # renders the memory pane from this block
+            try:
+                import sys as _sys
+                mw = _sys.modules.get(
+                    "incubator_mxnet_tpu.telemetry.memwatch")
+                if mw is not None:
+                    mblock = mw.block()
+                    if mblock:
+                        out["memwatch"] = mblock
+            except Exception:       # noqa: BLE001
+                pass
         return out
 
     def json_text(self) -> str:
@@ -374,6 +435,15 @@ class MetricsExporter:
                 from . import flightrec as _bb
                 _bb.sample_counters()
                 _bb.hbm_sample(tag="export")
+            except Exception:           # noqa: BLE001
+                pass
+            try:
+                # the memory observatory samples at exactly this
+                # cadence (ISSUE 20) — tick time is its ONLY periodic
+                # hook, so MXNET_MEMWATCH never touches a request or
+                # step path
+                from . import memwatch as _mw
+                _mw.sample(tag="export")
             except Exception:           # noqa: BLE001
                 pass
             # the durable layer rides the same cadence (ISSUE 12):

@@ -209,17 +209,23 @@ def ring_snapshot(last=None):
 def hbm_sample(tag="sample", force=False):
     """Sample per-device HBM via `storage.memory_events` (which posts
     the `mem.*` series on monitor.events), update the per-device peak
-    watermarks, and append one ring event per device.  Degrades to a
-    no-op (no event, no crash) on backends whose `memory_stats` returns
-    None — the axon plugin (ndarray.py:77).  Gated on `enabled()` (the
+    watermarks, and append one ring event per device.  Backends whose
+    PJRT `memory_stats` returns None — CPU jax, the axon plugin
+    (ndarray.py:77) — used to silently no-op here; they now fall back
+    to the `jax.live_arrays()` per-device byte sum
+    (`storage.live_arrays_events`), each event tagged
+    ``source="live_arrays"`` so a dump never mistakes the committed-
+    buffer sum for an allocator report.  Gated on `enabled()` (the
     MXNET_BLACKBOX=0 contract is a single bool read per hook);
     `force=True` is the dump path, which samples even when an explicit
     dump was requested on a disarmed recorder."""
     if not (enabled() or force):
         return []
     try:
-        from ..storage import memory_events
+        from ..storage import live_arrays_events, memory_events
         stats = memory_events()
+        if not stats:
+            stats = live_arrays_events()
     except Exception:               # noqa: BLE001 — forensics must
         return []                   # never take the run down
     for s in stats:
@@ -229,7 +235,8 @@ def hbm_sample(tag="sample", force=False):
                        s.get("peak_bytes", 0), s["bytes_in_use"])
             _HBM_PEAK[dev] = peak
         record("hbm", dev, tag=tag, bytes_in_use=s["bytes_in_use"],
-               peak_bytes=peak, bytes_limit=s.get("bytes_limit", 0))
+               peak_bytes=peak, bytes_limit=s.get("bytes_limit", 0),
+               **({"source": s["source"]} if "source" in s else {}))
     return stats
 
 
@@ -406,6 +413,19 @@ def dump_blackbox(path=None, reason="manual", exc=None, last=None):
             rt_block = rt_mod.block() or None
     except Exception:               # noqa: BLE001
         rt_block = None
+    # the memory observatory (ISSUE 20): same already-imported guard;
+    # the dump takes one sample first (when armed) so the block shows
+    # the corpse's residency, not a stale tick — the OOM path already
+    # forced its own sample before reaching here
+    mw_block = None
+    try:
+        mw_mod = sys.modules.get(
+            "incubator_mxnet_tpu.telemetry.memwatch")
+        if mw_mod is not None:
+            mw_mod.sample(tag="dump")
+            mw_block = mw_mod.block() or None
+    except Exception:               # noqa: BLE001
+        mw_block = None
     evs = ring_snapshot(last=last)
     doc = {
         "schema": SCHEMA,
@@ -423,6 +443,7 @@ def dump_blackbox(path=None, reason="manual", exc=None, last=None):
         "controlplane": ctl_block,
         "autotune": tune_block,
         "reqtrace": rt_block,
+        "memwatch": mw_block,
         "hbm": {"peaks": hbm_peaks()},
         "events": evs,
         "trace": {"traceEvents": _chrome_view(evs),

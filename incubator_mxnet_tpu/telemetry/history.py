@@ -52,6 +52,10 @@ Model:
              under ``phases`` — written at retire time, tail/failure
              requests only, so slow-request autopsies survive the
              process and query across runs
+  memwatch   per-device peak-watermark rows (telemetry/memwatch.py):
+             ``v`` = peak used bytes, ``labels`` = {device, phase,
+             source} — written only when a watermark RISES, so the
+             cross-run memory envelope queries by run id
   =========  ==========================================================
 
 - **Bounded**: a shard past ``MXNET_HISTORY_SHARD_KB`` is COMPACTED in

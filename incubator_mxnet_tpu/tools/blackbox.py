@@ -67,6 +67,17 @@ firing lane alert attaches to its own dump:
     python -m ... autopsy dump.json               # the worst one
     python -m ... autopsy dump.json --rid 42
     python -m ... autopsy dump.json --lane high --all
+
+`memautopsy` (ISSUE 20) renders a dump's memwatch block as an OOM /
+memory-drift post-mortem: the last per-device sample (with its
+source — PJRT memory_stats or the live_arrays fallback), the rolling
+per-phase peak watermarks, the committed-vs-measured tenant
+attribution join, the recent allocation-lifecycle timeline, and a
+verdict naming the tenant whose footprint drifted furthest from its
+ledger commitment:
+
+    python -m ... memautopsy dump.json
+    python -m ... memautopsy dump.json --top 10
 """
 from __future__ import annotations
 
@@ -76,12 +87,12 @@ import sys
 import time
 
 from .teletop import (_autotune_lines, _fleet_lines, _fmt_qty,
-                      _reqtrace_lines, _slo_lines)
+                      _memwatch_lines, _reqtrace_lines, _slo_lines)
 
 __all__ = ["load_dump", "render", "suspected_cause", "merge_traces",
            "verify_main", "merge_main", "history_main", "sparkline",
            "autopsy_main", "autopsy_lines", "slow_request_family",
-           "main"]
+           "memautopsy_main", "memautopsy_lines", "main"]
 
 
 def load_dump(path: str) -> dict:
@@ -133,6 +144,26 @@ def slow_request_family(exemplar: dict):
                                 "waterfall"))
 
 
+def _worst_drifter(mw):
+    """The attribution row whose measured share strayed furthest from
+    its ledger commitment (either direction), ties broken by measured
+    bytes — the tenant `memautopsy` and the memwatch: suspected-cause
+    line both name.  None when the block carries no judgeable row."""
+    rows = [r for r in (mw or {}).get("attribution") or []
+            if r.get("committed_bytes", 0) > 0
+            and r.get("measured_bytes") is not None]
+
+    def score(r):
+        m = float(r.get("measured_bytes", 0))
+        c = float(r.get("committed_bytes", 1))
+        return ((m / c) if m >= c else
+                (float("inf") if m <= 0 else c / m))
+    if not rows:
+        return None
+    return max(rows, key=lambda r: (score(r),
+                                    r.get("measured_bytes", 0)))
+
+
 def suspected_cause(doc: dict) -> str:
     """One line: what the evidence points at, strongest signal first.
     A heuristic, not a verdict — the timeline is the ground truth."""
@@ -141,6 +172,26 @@ def suspected_cause(doc: dict) -> str:
     kinds = [e.get("kind") for e in evs]
     exc = doc.get("exception")
     reason = doc.get("reason", "")
+    if reason.startswith("memwatch:"):
+        # proactive OOM-forensics dump (ISSUE 20): the memwatch block
+        # was captured BEFORE the unwind freed the arrays, so the
+        # attribution join can still name the tenant — checked ahead
+        # of the generic exception line, which would otherwise claim
+        # this dump as a mere uncaught RESOURCE_EXHAUSTED
+        worst = _worst_drifter(doc.get("memwatch"))
+        site = reason.split(":", 2)[-1]
+        if worst is not None:
+            return ("allocation failure at %r: tenant %r on %s held "
+                    "%s measured vs %s committed (%.2fx its ledger "
+                    "row) — the leading suspect; run `blackbox "
+                    "memautopsy <dump>` for the full join"
+                    % (site, worst.get("tenant"), worst.get("device"),
+                       _fmt_qty(worst.get("measured_bytes", 0), "B"),
+                       _fmt_qty(worst.get("committed_bytes", 0), "B"),
+                       worst.get("drift") or 0.0))
+        return ("allocation failure at %r — no tenant attribution "
+                "available (memwatch block empty or no committed "
+                "rows); read the hbm peaks and the timeline" % site)
     if exc:
         return ("uncaught %s: %s" % (exc.get("type"),
                                      (exc.get("message") or "")[:120]))
@@ -356,6 +407,9 @@ def render(doc: dict, events_tail=40) -> str:
     # the request journals + promoted slow-request exemplars (ISSUE
     # 19) — `blackbox autopsy` renders one exemplar's full waterfall
     lines += _reqtrace_lines(doc.get("reqtrace"))
+    # the memory-observatory block (ISSUE 20) — `blackbox memautopsy`
+    # renders the full committed-vs-measured post-mortem
+    lines += _memwatch_lines(doc.get("memwatch"))
 
     lines += ["", "suspected cause: " + suspected_cause(doc)]
     return "\n".join(lines)
@@ -872,6 +926,128 @@ def autopsy_main(argv) -> int:
     return 0
 
 
+# -- memautopsy (ISSUE 20) ---------------------------------------------
+def memautopsy_lines(doc: dict, top=10) -> list:
+    """A dump's memwatch block as an OOM / drift post-mortem: the
+    per-device sample (with source), the per-phase peak watermarks,
+    the committed-vs-measured tenant join, the recent allocation
+    lifecycle, and the verdict naming the worst drifter."""
+    mw = doc.get("memwatch") or {}
+    smp = mw.get("sample") or {}
+    head = "memautopsy — reason=%s phase=%s %s" % (
+        doc.get("reason"), mw.get("phase", "?"),
+        time.strftime("%Y-%m-%d %H:%M:%S",
+                      time.localtime(doc.get("ts", 0))))
+    lines = [head, "=" * len(head)]
+    exc = doc.get("exception")
+    if exc:
+        lines.append("exception: %s: %s"
+                     % (exc.get("type"),
+                        (exc.get("message") or "")[:200]))
+    if not smp:
+        lines += ["", "no memwatch sample in this dump — memwatch "
+                      "was disabled, or the dump predates the first "
+                      "sample"]
+        return lines
+
+    devices = smp.get("devices") or {}
+    lines += ["", "devices (sample tag=%s%s)"
+              % (smp.get("tag", "?"),
+                 "" if mw.get("fresh", True) else ", STALE"),
+              "%-12s %10s %10s %10s %-12s"
+              % ("device", "used", "peak", "limit", "source"),
+              "-" * 60]
+    for dev in sorted(devices):
+        row = devices[dev]
+        lim = row.get("limit_bytes", 0)
+        lines.append("%-12s %10s %10s %10s %-12s"
+                     % (dev[:12],
+                        _fmt_qty(row.get("used_bytes", 0), "B"),
+                        _fmt_qty(row.get("peak_bytes", 0), "B"),
+                        _fmt_qty(lim, "B") if lim else "-",
+                        str(row.get("source", "?"))[:12]))
+
+    marks = mw.get("watermarks") or {}
+    if any(marks.values()):
+        lines += ["", "peak watermarks (per phase)", "-" * 27]
+        for phase in sorted(marks):
+            for dev in sorted(marks[phase]):
+                lines.append("%-10s %-12s %s"
+                             % (phase, dev[:12],
+                                _fmt_qty(marks[phase][dev], "B")))
+
+    attr = (mw.get("attribution") or [])[:max(1, int(top))]
+    if attr:
+        lines += ["", "tenant attribution (committed vs measured)",
+                  "%-24s %-10s %10s %10s %7s %-6s %-10s"
+                  % ("tenant", "device", "committed", "measured",
+                     "drift", "kind", "basis"),
+                  "-" * 78]
+        for r in attr:
+            drift = r.get("drift")
+            lines.append(
+                "%-24s %-10s %10s %10s %7s %-6s %-10s"
+                % (str(r.get("tenant", "?"))[:24],
+                   str(r.get("device", "?"))[:10],
+                   _fmt_qty(r.get("committed_bytes", 0), "B"),
+                   _fmt_qty(r.get("measured_bytes", 0), "B"),
+                   "-" if drift is None else "%.2fx" % drift,
+                   str(r.get("kind", ""))[:6],
+                   str(r.get("basis", ""))[:10]))
+
+    evs = mw.get("events") or []
+    if evs:
+        lines += ["", "allocation lifecycle (last %d)" % len(evs),
+                  "-" * 30]
+        for e in evs:
+            extra = " ".join(
+                "%s=%s" % (k, e[k]) for k in sorted(e)
+                if k not in ("ts", "tid", "kind", "name"))
+            lines.append("%-12s %-28s %s"
+                         % (e.get("kind", "?"), e.get("name", "?"),
+                            extra[:36]))
+
+    worst = _worst_drifter(mw)
+    if worst is not None:
+        lines += ["", "verdict: tenant %r on %s drifted %.2fx from "
+                      "its ledger row (%s measured vs %s committed) "
+                      "— re-reconcile it (registry.reconcile) or "
+                      "lower its admission footprint"
+                  % (worst.get("tenant"), worst.get("device"),
+                     worst.get("drift") or 0.0,
+                     _fmt_qty(worst.get("measured_bytes", 0), "B"),
+                     _fmt_qty(worst.get("committed_bytes", 0), "B"))]
+    else:
+        lines += ["", "verdict: no judgeable tenant row (nothing "
+                      "committed, or no fresh measurement) — read "
+                      "the device table and the timeline"]
+    return lines
+
+
+def memautopsy_main(argv) -> int:
+    """``blackbox memautopsy`` body: render a dump's memwatch block
+    as a memory post-mortem.  rc 0 = rendered (even without a
+    sample); 1 = unreadable dump."""
+    ap = argparse.ArgumentParser(
+        prog="blackbox memautopsy",
+        description="OOM / memory-drift post-mortem from a dump's "
+                    "memwatch block: per-device sample, phase "
+                    "watermarks, committed-vs-measured tenant join, "
+                    "verdict naming the worst drifter")
+    ap.add_argument("dump", help="black-box dump JSON path")
+    ap.add_argument("--top", type=int, default=10, metavar="N",
+                    help="attribution rows to show (default 10)")
+    args = ap.parse_args(argv)
+    try:
+        doc = load_dump(args.dump)
+    except Exception as e:          # noqa: BLE001 — operator tool
+        print("blackbox: cannot read %s: %s" % (args.dump, e),
+              file=sys.stderr)
+        return 1
+    print("\n".join(memautopsy_lines(doc, top=args.top)))
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "verify":
@@ -882,11 +1058,14 @@ def main(argv=None) -> int:
         return history_main(argv[1:])
     if argv and argv[0] == "autopsy":
         return autopsy_main(argv[1:])
+    if argv and argv[0] == "memautopsy":
+        return memautopsy_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="blackbox",
         description="summarize a flight-recorder black-box dump "
                     "(or: blackbox verify <ckpt_dir> / "
-                    "blackbox merge <dumps...> / blackbox history)")
+                    "blackbox merge <dumps...> / blackbox history / "
+                    "blackbox autopsy / blackbox memautopsy)")
     ap.add_argument("dump", help="black-box dump JSON path")
     ap.add_argument("--events", type=int, default=40, metavar="N",
                     help="timeline tail length (default 40)")

@@ -268,6 +268,55 @@ def _reqtrace_lines(rt):
     return lines
 
 
+def _memwatch_lines(mw):
+    """The memory-observatory block (ISSUE 20) as table rows: one
+    line per device (used / peak watermark / limit, with the sampling
+    source — ``memory_stats`` vs the ``live_arrays`` fallback —
+    spelled out), then the tenant attribution join: committed ledger
+    bytes vs the measured share, and the drift ratio an operator
+    reads before the MemDriftRule pages them."""
+    if not mw or not mw.get("sample"):
+        return []
+    smp = mw.get("sample") or {}
+    devices = smp.get("devices") or {}
+    marks = mw.get("watermarks") or {}
+    lines = ["", "memwatch (phase=%s, sample %s%s)"
+             % (mw.get("phase", "?"), smp.get("tag", "?"),
+                "" if mw.get("fresh", True) else ", STALE"),
+             "%-12s %10s %10s %10s %-12s"
+             % ("device", "used", "peak", "limit", "source"),
+             "-" * 60]
+    for dev in sorted(devices):
+        row = devices[dev]
+        # the highest watermark across phases — the per-phase split
+        # lives in the block for the autopsy
+        peak = max([row.get("peak_bytes", 0)] +
+                   [m.get(dev, 0) for m in marks.values()])
+        lim = row.get("limit_bytes", 0)
+        lines.append("%-12s %10s %10s %10s %-12s"
+                     % (dev[:12], _fmt_qty(row.get("used_bytes", 0), "B"),
+                        _fmt_qty(peak, "B"),
+                        _fmt_qty(lim, "B") if lim else "-",
+                        str(row.get("source", "?"))[:12]))
+    attr = mw.get("attribution") or []
+    if attr:
+        lines += ["%-22s %-10s %10s %10s %7s %-6s"
+                  % ("tenant", "device", "committed", "measured",
+                     "drift", "kind"),
+                  "-" * 72]
+        for r in attr[:12]:
+            drift = r.get("drift")
+            lines.append(
+                "%-22s %-10s %10s %10s %7s %-6s"
+                % (str(r.get("tenant", "?"))[:22],
+                   str(r.get("device", "?"))[:10],
+                   _fmt_qty(r.get("committed_bytes", 0), "B"),
+                   _fmt_qty(r.get("measured_bytes", 0), "B"),
+                   "-" if drift is None else "%.2fx" % drift,
+                   str(r.get("kind", ""))[:6]))
+    return lines
+
+
 def render(snap: dict, prefix: str = "") -> str:
     """The snapshot as one fixed-width table block."""
     counters = {k: v for k, v in snap.get("counters", {}).items()
@@ -317,6 +366,7 @@ def render(snap: dict, prefix: str = "") -> str:
     lines += _fleet_lines(snap.get("fleet"))
     lines += _slo_lines(snap.get("slo"))
     lines += _reqtrace_lines(snap.get("reqtrace"))
+    lines += _memwatch_lines(snap.get("memwatch"))
 
     derived = _derived(snap.get("counters", {}))
     if derived:

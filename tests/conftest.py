@@ -145,6 +145,12 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "reqtrace: request-journal / exemplar / autopsy "
         "tests (CPU-fast, run in tier-1 by default)")
+    # memory observatory (ISSUE 20): sampled HBM watermarks, tenant
+    # attribution join, drift rule, OOM forensics / memautopsy
+    config.addinivalue_line(
+        "markers", "memwatch: memory-observatory (watermark / "
+        "attribution / drift / OOM-autopsy) tests (CPU-fast, run in "
+        "tier-1 by default)")
 
 
 @pytest.fixture(autouse=True)

@@ -1,6 +1,7 @@
 """Model-level convergence smokes (ref: tests/python/train/ — small
 end-to-end training with an accuracy/loss threshold)."""
 import numpy as np
+import pytest
 
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import nd, gluon, autograd as ag
@@ -371,12 +372,17 @@ def test_transformer_nmt_fused_head_matches_dense():
                                    err_msg="grad #%d" % i)
 
 
+@pytest.mark.slow
 def test_quality_config_converges_and_matches_r5_shape():
     """The bench quality config (internal quality-regression baseline,
     tests/assets/r5/quality_curve.json) must converge directionally at
     reduced scale on the CPU corpus: loss strictly drops, accuracy
-    clearly beats chance."""
-    import json
+    clearly beats chance.
+
+    slow-marked: ~200s of CPU training is nightly-tier budget — inside
+    the 870s tier-1 cap it was starving the tail of the corpus of any
+    run time at all.  The r5 reference-artifact checks stay in tier-1
+    below."""
     import os
     import sys
     # bench.py's module-level env setup (AOT cache dir etc.) must not
@@ -407,7 +413,14 @@ def test_quality_config_converges_and_matches_r5_shape():
     curve = out["quality_loss_curve"]
     assert curve[-1] < curve[0] * 0.8, curve
     assert out["quality_resnet18_synth_eval_acc"] > 0.7, out
-    # the committed r5 reference artifact is well-formed
+
+
+def test_quality_r5_reference_artifact_well_formed():
+    """The committed r5 reference artifact is well-formed (the cheap
+    half of the quality tier — the ~200s convergence run above is
+    slow-marked)."""
+    import json
+    import os
     ref_path = os.path.join(os.path.dirname(__file__), "..", "..",
                             "assets", "r5", "quality_curve.json")
     with open(ref_path) as f:

@@ -201,11 +201,14 @@ def test_top_lane_displaces_low_on_full_queue():
                           lanes=("hi", "lo"), lane_quotas=(1.0, 1.0))
     try:
         eng.warmup(example_shape=(8,), wire_dtype="float32")
+        # the stall must outlive every assertion below that needs the
+        # queue STILL full — 0.4s flaked under full-corpus load (the
+        # QueueFull probe ran after the dispatcher drained)
         fault.install("serve.infer", at_calls=[2], times=1,
-                      seconds=0.4)
+                      seconds=3.0)
         x = _data(8)
         f0 = eng.submit(x[0], lane="lo")    # dispatcher stalls on it
-        time.sleep(0.1)
+        time.sleep(0.25)
         lo = [eng.submit(x[i], lane="lo") for i in (1, 2, 3)]  # full
         fh = eng.submit(x[4], lane="hi")    # displaces newest lo
         with pytest.raises(Shed):

@@ -11,12 +11,15 @@ exits nonzero when the measured overhead exceeds the threshold
     python tools/check_overhead.py --steps 200 --threshold 2.0
     python tools/check_overhead.py --what serve   # reqtrace gate only
 
-Two gates share the harness (ISSUE 19): the train loop measures the
-flight recorder (`flightrec.enable`), and the serving loop measures
-the per-request tracer (`reqtrace.enable`) over submit→result round
-trips — the <2%% tracing-overhead contract reqtrace.py promises.
-Each writes its own gate_report artifact (`check_overhead`,
-`check_overhead_reqtrace`).
+Three gates share the harness: the train loop measures the flight
+recorder (`flightrec.enable`, ISSUE 19's harness), the serving loop
+measures the per-request tracer (`reqtrace.enable`) over
+submit→result round trips, and `--what mem` re-runs the serving loop
+with the memory observatory (`memwatch.enable`, ISSUE 20) toggled —
+one forced sample per resolve window, the observatory's realistic
+worst-case cadence — against the same <2%% contract.  Each writes its
+own gate_report artifact (`check_overhead`, `check_overhead_reqtrace`,
+`check_overhead_memwatch`).
 
 Methodology: each mode gets its own freshly-built trainer (so compile
 cost is identical and excluded by warmup), modes run interleaved
@@ -138,6 +141,37 @@ def _timed_serve_loop(tracing_on, requests, warmup, window=64):
         reqtrace.enable(prev)
 
 
+def _timed_mem_loop(mem_on, requests, warmup, window=64):
+    """One memwatch trial half: the reqtrace serving loop with the
+    memory observatory forced on or off.  The on-half also takes one
+    forced sample per resolved window — a HIGHER sampling cadence
+    than production (exporter tick / phase transitions / dump time),
+    so the gate bounds the worst case, not the steady state."""
+    from incubator_mxnet_tpu.telemetry import memwatch
+    prev = memwatch.enable(bool(mem_on))
+    eng = None
+    try:
+        eng, x = _build_engine()
+        for f in [eng.submit(x) for _ in range(max(1, warmup))]:
+            f.result(timeout=30)        # compile + warm the path
+        t0 = time.perf_counter()
+        pend = []
+        for _ in range(requests):
+            pend.append(eng.submit(x))
+            if len(pend) >= window:
+                for f in pend:
+                    f.result(timeout=30)
+                pend = []
+                memwatch.sample(tag="gate")   # no-op when disabled
+        for f in pend:
+            f.result(timeout=30)
+        return time.perf_counter() - t0
+    finally:
+        if eng is not None:
+            eng.close()
+        memwatch.enable(prev)
+
+
 def _run_gate(gate, what, run_one, args):
     """One best-of-`--trials` interleaved off/on gate: `run_one(mode)`
     returns the timed wall with the instrumented path off (False) or
@@ -190,12 +224,14 @@ def main(argv=None) -> int:
         description="fail (rc!=0) when the flight recorder (train "
         "loop) or the request tracer (serving loop) costs more than "
         "--threshold %%")
-    ap.add_argument("--what", choices=("train", "serve", "all"),
+    ap.add_argument("--what", choices=("train", "serve", "mem", "all"),
                     default="all",
                     help="train = flight-recorder overhead on the "
                     "synthetic train loop; serve = reqtrace overhead "
-                    "on a serving submit/result loop; all = both "
-                    "gates (default)")
+                    "on a serving submit/result loop; mem = memwatch "
+                    "overhead on the same serving loop (one forced "
+                    "sample per resolve window); all = every gate "
+                    "(default)")
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--requests", type=int, default=600,
                     help="serving-loop submit/result round trips per "
@@ -232,6 +268,15 @@ def main(argv=None) -> int:
         if failed:
             print("FAIL: request-tracing overhead above threshold in "
                   "all trial(s)", file=sys.stderr)
+            rc = 1
+    if args.what in ("mem", "all"):
+        failed = _run_gate(
+            "check_overhead_memwatch", "memwatch",
+            lambda mode: _timed_mem_loop(mode, args.requests,
+                                         args.warmup), args)
+        if failed:
+            print("FAIL: memwatch overhead above threshold in all "
+                  "trial(s)", file=sys.stderr)
             rc = 1
     print("OK" if rc == 0 else "FAILED")
     return rc
